@@ -1,0 +1,36 @@
+"""lakesoul_tpu — TPU-native lakehouse & AI data framework.
+
+A brand-new framework with the capabilities of LakeSoul (ACID lakehouse tables on
+object storage, PostgreSQL/SQLite-backed metadata, LSM-style upserts on
+hash-bucketed primary-key tables with merge-on-read, compaction, snapshot and
+incremental reads, CDC ingest, RBAC, and an IVF+RaBitQ ANN vector index),
+designed idiomatically for JAX/XLA/Pallas on TPU:
+
+- The data plane delivers merged Arrow RecordBatches straight into TPU HBM via
+  double-buffered ``jax.device_put`` prefetch.
+- Tables shard across a TPU pod by ``jax.process_index()`` over
+  (range-partition, hash-bucket) scan units — no torch.distributed in the loop.
+- The ANN vector scan (packed RaBitQ codes, brute force, top-k) runs on-chip
+  via Pallas/XLA kernels on the MXU.
+- Merge/bucketing hot loops run in a C++ native core with vectorized-numpy
+  fallbacks; hashing is byte-compatible with Spark Murmur3 (seed 42) so tables
+  interoperate with reference-written data.
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy imports keep `import lakesoul_tpu` cheap (no jax/pyarrow load)
+    if name in ("LakeSoulCatalog", "LakeSoulTable", "LakeSoulScan"):
+        from lakesoul_tpu import catalog
+
+        return getattr(catalog, name)
+    raise AttributeError(name)
+
+__all__ = [
+    "LakeSoulCatalog",
+    "LakeSoulTable",
+    "LakeSoulScan",
+    "__version__",
+]

@@ -1,0 +1,45 @@
+"""Framework-wide error types.
+
+Mirrors the error surface of the reference's ``LakeSoulMetaDataError`` /
+``LakeSoulError`` enums (rust/lakesoul-metadata/src/error.rs,
+rust/lakesoul-io/src/lakesoul_io_config.rs) with idiomatic Python exceptions.
+"""
+
+
+class LakeSoulError(Exception):
+    """Base class for all lakesoul_tpu errors."""
+
+
+class MetadataError(LakeSoulError):
+    """Metadata-layer failure (DAO op, schema, store IO)."""
+
+
+class CommitConflictError(MetadataError):
+    """Optimistic-concurrency conflict: another writer committed the same
+    (table_id, partition_desc, version) first.  Callers re-read the current
+    partition version and retry (the reference delegates this to a PG primary
+    key conflict; see metadata_client.rs:467 and meta_init.sql:95-99)."""
+
+
+class TableNotFoundError(MetadataError):
+    pass
+
+
+class TableAlreadyExistsError(MetadataError):
+    pass
+
+
+class IOError_(LakeSoulError):
+    """Data-plane IO failure (read/write/merge)."""
+
+
+class ConfigError(LakeSoulError):
+    pass
+
+
+class RBACError(LakeSoulError):
+    """Permission denied by domain-based RBAC."""
+
+
+class VectorIndexError(LakeSoulError):
+    pass

@@ -1,0 +1,27 @@
+from lakesoul_tpu.meta.entity import (
+    CommitOp,
+    DataCommitInfo,
+    DataFileOp,
+    FileOp,
+    MetaInfo,
+    Namespace,
+    PartitionInfo,
+    TableInfo,
+)
+from lakesoul_tpu.meta.client import MetaDataClient, ScanPlanPartition
+from lakesoul_tpu.meta.store import MetadataStore, SqliteMetadataStore
+
+__all__ = [
+    "CommitOp",
+    "DataCommitInfo",
+    "DataFileOp",
+    "FileOp",
+    "MetaInfo",
+    "Namespace",
+    "PartitionInfo",
+    "TableInfo",
+    "MetaDataClient",
+    "ScanPlanPartition",
+    "MetadataStore",
+    "SqliteMetadataStore",
+]

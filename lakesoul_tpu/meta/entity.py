@@ -1,0 +1,210 @@
+"""Shared entity model.
+
+Python-native equivalent of the reference's protobuf entity model
+(rust/proto/src/entity.proto:13-186) — the same logical messages used across
+every layer: TableInfo, PartitionInfo, DataCommitInfo, DataFileOp, MetaInfo.
+Arrow schemas travel as IPC bytes (full fidelity, like
+``table_schema_arrow_ipc`` in entity.proto:21-44) with a JSON mirror for
+debuggability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+
+# partition-string encoding shared with the reference:
+#   "<range_col1>,<range_col2>;<pk1>,<pk2>"  (transfusion.rs:367)
+RANGE_HASH_SPLITTER = ";"
+PARTITION_SPLITTER = ","
+# partition_desc encoding: "k=v,k=v"; the no-partition sentinel used throughout
+# the reference metadata layer:
+NO_PARTITION_DESC = "-5"
+
+# table property keys (reference: DBConfig / catalog.py)
+PROP_HASH_BUCKET_NUM = "hashBucketNum"
+PROP_CDC_CHANGE_COLUMN = "lakesoul_cdc_change_column"
+CDC_DEFAULT_COLUMN = "rowKinds"
+
+
+class CommitOp(str, enum.Enum):
+    """Commit operations (entity.proto CommitOp)."""
+
+    APPEND = "AppendCommit"
+    COMPACTION = "CompactionCommit"
+    UPDATE = "UpdateCommit"
+    MERGE = "MergeCommit"
+    DELETE = "DeleteCommit"
+
+    @classmethod
+    def from_str(cls, s: str) -> "CommitOp":
+        return cls(s)
+
+
+class FileOp(str, enum.Enum):
+    """File operations inside a commit (entity.proto FileOp {add, del})."""
+
+    ADD = "add"
+    DEL = "del"
+
+
+@dataclass(frozen=True)
+class DataFileOp:
+    path: str
+    file_op: FileOp = FileOp.ADD
+    size: int = 0
+    file_exist_cols: str = ""
+
+    def __post_init__(self):
+        if not isinstance(self.file_op, FileOp):
+            object.__setattr__(self, "file_op", FileOp(self.file_op))
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "file_op": self.file_op.value,
+            "size": self.size,
+            "file_exist_cols": self.file_exist_cols,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "DataFileOp":
+        return cls(d["path"], FileOp(d["file_op"]), d.get("size", 0), d.get("file_exist_cols", ""))
+
+
+@dataclass
+class DataCommitInfo:
+    """One atomic batch of file operations (entity.proto:94-133)."""
+
+    table_id: str
+    partition_desc: str
+    commit_id: str
+    file_ops: list[DataFileOp] = field(default_factory=list)
+    commit_op: CommitOp = CommitOp.APPEND
+    committed: bool = False
+    timestamp: int = 0  # epoch millis
+    domain: str = "public"
+
+    @staticmethod
+    def new_commit_id() -> str:
+        return str(uuid.uuid4())
+
+
+@dataclass
+class PartitionInfo:
+    """One version in a partition's version chain (entity.proto:46-65).
+
+    ``snapshot`` is the ordered list of data-commit UUIDs whose files make up
+    the partition at this version; Append/Merge extends it, Compaction/Update
+    replaces it, Delete clears it (metadata_client.rs:467-634)."""
+
+    table_id: str
+    partition_desc: str
+    version: int = -1
+    commit_op: CommitOp = CommitOp.APPEND
+    timestamp: int = 0
+    snapshot: list[str] = field(default_factory=list)
+    expression: str = ""
+    domain: str = "public"
+
+    def clone(self) -> "PartitionInfo":
+        return dataclasses.replace(self, snapshot=list(self.snapshot))
+
+
+@dataclass
+class TableInfo:
+    """Table metadata (entity.proto:21-44)."""
+
+    table_id: str
+    table_namespace: str = "default"
+    table_name: str = ""
+    table_path: str = ""
+    table_schema: str = ""  # Arrow schema as JSON (debug mirror)
+    table_schema_arrow_ipc: bytes = b""  # full-fidelity Arrow IPC schema
+    properties: dict = field(default_factory=dict)
+    partitions: str = ";"  # "range_cols;hash_cols"
+    domain: str = "public"
+
+    @staticmethod
+    def new_table_id() -> str:
+        return "table_" + uuid.uuid4().hex
+
+    @property
+    def arrow_schema(self) -> pa.Schema:
+        if self.table_schema_arrow_ipc:
+            return pa.ipc.read_schema(pa.BufferReader(self.table_schema_arrow_ipc))
+        raise ValueError(f"table {self.table_name} has no arrow schema")
+
+    @property
+    def range_partition_columns(self) -> list[str]:
+        part = self.partitions.split(RANGE_HASH_SPLITTER)[0]
+        return [c for c in part.split(PARTITION_SPLITTER) if c]
+
+    @property
+    def primary_keys(self) -> list[str]:
+        parts = self.partitions.split(RANGE_HASH_SPLITTER)
+        if len(parts) < 2:
+            return []
+        return [c for c in parts[1].split(PARTITION_SPLITTER) if c]
+
+    @property
+    def hash_bucket_num(self) -> int:
+        raw = self.properties.get(PROP_HASH_BUCKET_NUM, "1")
+        try:
+            n = int(raw)
+        except (TypeError, ValueError):
+            raise ValueError(f"invalid hashBucketNum table property: {raw!r}")
+        if n < 1:
+            raise ValueError(f"invalid hashBucketNum table property: {raw!r}")
+        return n
+
+    @property
+    def cdc_column(self) -> str | None:
+        return self.properties.get(PROP_CDC_CHANGE_COLUMN)
+
+
+@dataclass
+class MetaInfo:
+    """Commit envelope: partitions being written, the table, and (for
+    Compaction/Update/Delete) the partition versions that were read."""
+
+    table_info: TableInfo | None = None
+    list_partition: list[PartitionInfo] = field(default_factory=list)
+    read_partition_info: list[PartitionInfo] = field(default_factory=list)
+
+
+@dataclass
+class Namespace:
+    namespace: str
+    properties: str = "{}"
+    comment: str = ""
+    domain: str = "public"
+
+
+def encode_partitions_field(range_cols: list[str], primary_keys: list[str]) -> str:
+    return PARTITION_SPLITTER.join(range_cols) + RANGE_HASH_SPLITTER + PARTITION_SPLITTER.join(primary_keys)
+
+
+def schema_to_ipc(schema: pa.Schema) -> bytes:
+    return schema.serialize().to_pybytes()
+
+
+def schema_to_json(schema: pa.Schema) -> str:
+    return json.dumps(
+        {
+            "fields": [
+                {"name": f.name, "type": str(f.type), "nullable": f.nullable}
+                for f in schema
+            ]
+        }
+    )
+
+
+def now_millis() -> int:
+    return int(time.time() * 1000)

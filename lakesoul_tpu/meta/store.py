@@ -1,0 +1,623 @@
+"""Metadata stores.
+
+The reference keeps all metadata in PostgreSQL with the schema in
+``script/meta_init.sql`` and relies on the ``partition_info`` primary key
+``(table_id, partition_desc, version)`` for optimistic concurrency: two
+writers committing the same new version conflict on PK insert and one of them
+retries (metadata_client.rs:467, meta_init.sql:95-99).
+
+This module reproduces that design over a pluggable ``MetadataStore``:
+
+- ``SqliteMetadataStore`` (default): file-backed SQLite with the same logical
+  schema, WAL mode, ACID transactions, and PK-conflict semantics.  A SQLite
+  file on a shared filesystem (or one per-host store fronted by the Flight
+  gateway) plays PostgreSQL's role on a TPU pod slice where installing PG is
+  not possible.
+- A PostgreSQL store can implement the same interface (same SQL, psycopg)
+  when the driver is available; the client code is backend-agnostic.
+
+The pg_notify-based compaction trigger (meta_init.sql:101-150) is reproduced
+as a synchronous hook: after a partition_info insert where the version gap
+since the last CompactionCommit reaches the trigger threshold, registered
+listeners receive a ``CompactionEvent`` (see lakesoul_tpu/compaction).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sqlite3
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from lakesoul_tpu.errors import CommitConflictError, MetadataError
+from lakesoul_tpu.meta.entity import (
+    CommitOp,
+    DataCommitInfo,
+    DataFileOp,
+    Namespace,
+    PartitionInfo,
+    TableInfo,
+    now_millis,
+)
+
+COMPACTION_TRIGGER_VERSION_GAP = 10  # matches meta_init.sql trigger (version % gap)
+
+
+@dataclass(frozen=True)
+class CompactionEvent:
+    """Equivalent of the `lakesoul_compaction_notify` pg_notify payload."""
+
+    table_id: str
+    table_path: str
+    table_namespace: str
+    partition_desc: str
+    version: int
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS namespace (
+    namespace  TEXT PRIMARY KEY,
+    properties TEXT DEFAULT '{}',
+    comment    TEXT DEFAULT '',
+    domain     TEXT DEFAULT 'public'
+);
+CREATE TABLE IF NOT EXISTS table_info (
+    table_id        TEXT PRIMARY KEY,
+    table_namespace TEXT DEFAULT 'default',
+    table_name      TEXT,
+    table_path      TEXT,
+    table_schema    TEXT,
+    table_schema_arrow_ipc BLOB,
+    properties      TEXT DEFAULT '{}',
+    partitions      TEXT,
+    domain          TEXT DEFAULT 'public'
+);
+CREATE INDEX IF NOT EXISTS table_info_name_index ON table_info (table_namespace, table_name);
+CREATE INDEX IF NOT EXISTS table_info_path_index ON table_info (table_path);
+CREATE TABLE IF NOT EXISTS table_name_id (
+    table_name      TEXT,
+    table_id        TEXT,
+    table_namespace TEXT DEFAULT 'default',
+    domain          TEXT DEFAULT 'public',
+    PRIMARY KEY (table_name, table_namespace)
+);
+CREATE TABLE IF NOT EXISTS table_path_id (
+    table_path      TEXT PRIMARY KEY,
+    table_id        TEXT,
+    table_namespace TEXT DEFAULT 'default',
+    domain          TEXT DEFAULT 'public'
+);
+CREATE TABLE IF NOT EXISTS data_commit_info (
+    table_id       TEXT,
+    partition_desc TEXT,
+    commit_id      TEXT,
+    file_ops       TEXT,
+    commit_op      TEXT,
+    committed      INTEGER DEFAULT 0,
+    timestamp      INTEGER,
+    domain         TEXT DEFAULT 'public',
+    PRIMARY KEY (table_id, partition_desc, commit_id)
+);
+CREATE TABLE IF NOT EXISTS partition_info (
+    table_id       TEXT,
+    partition_desc TEXT,
+    version        INTEGER,
+    commit_op      TEXT,
+    timestamp      INTEGER,
+    snapshot       TEXT,
+    expression     TEXT DEFAULT '',
+    domain         TEXT DEFAULT 'public',
+    PRIMARY KEY (table_id, partition_desc, version)
+);
+CREATE INDEX IF NOT EXISTS partition_info_timestamp ON partition_info (timestamp);
+CREATE TABLE IF NOT EXISTS global_config (
+    key   TEXT PRIMARY KEY,
+    value TEXT
+);
+CREATE TABLE IF NOT EXISTS discard_compressed_file_info (
+    file_path   TEXT PRIMARY KEY,
+    table_path  TEXT,
+    partition_desc TEXT,
+    timestamp   INTEGER,
+    t_date      TEXT
+);
+"""
+
+
+class MetadataStore:
+    """Abstract metadata backend. All methods are synchronous and thread-safe."""
+
+    def transaction_insert_partition_info(self, partitions: list[PartitionInfo]) -> None:
+        raise NotImplementedError
+
+    # ... the concrete store defines the full DAO surface; kept on one class
+    # rather than the reference's numbered DaoType dispatch (lib.rs:122) —
+    # Python needs no prepared-statement indirection.
+
+
+class SqliteMetadataStore(MetadataStore):
+    def __init__(self, db_path: str | os.PathLike = ":memory:"):
+        self.db_path = str(db_path)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._compaction_listeners: list[Callable[[CompactionEvent], None]] = []
+        conn = self._conn()
+        with conn:
+            conn.executescript(_SCHEMA)
+            conn.execute(
+                "INSERT OR IGNORE INTO namespace(namespace, properties, comment) VALUES ('default', '{}', '')"
+            )
+
+    # -- connection handling -------------------------------------------------
+    def _conn(self) -> sqlite3.Connection:
+        if self.db_path == ":memory:":
+            # a single shared connection for in-memory DBs
+            with self._lock:
+                if not hasattr(self, "_mem_conn"):
+                    self._mem_conn = sqlite3.connect(
+                        ":memory:", check_same_thread=False
+                    )
+                    self._mem_conn.execute("PRAGMA foreign_keys=ON")
+                return self._mem_conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.db_path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            with conn:
+                conn.executescript(_SCHEMA)
+            self._local.conn = conn
+        return conn
+
+    @contextlib.contextmanager
+    def _txn(self):
+        """Write transaction.  In-memory stores share one connection across
+        threads, so multi-statement transactions must be serialized by a lock
+        to keep atomicity (file-backed stores get a connection per thread and
+        rely on SQLite's own locking)."""
+        conn = self._conn()
+        if self.db_path == ":memory:":
+            with self._lock:
+                with conn:
+                    yield conn
+        else:
+            with conn:
+                yield conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # -- namespaces ----------------------------------------------------------
+    def insert_namespace(self, ns: Namespace) -> None:
+        try:
+            with self._txn() as conn:
+                conn.execute(
+                    "INSERT INTO namespace(namespace, properties, comment, domain) VALUES (?,?,?,?)",
+                    (ns.namespace, ns.properties, ns.comment, ns.domain),
+                )
+        except sqlite3.IntegrityError as e:
+            raise MetadataError(f"namespace {ns.namespace} already exists") from e
+
+    def get_namespace(self, name: str) -> Namespace | None:
+        row = self._conn().execute(
+            "SELECT namespace, properties, comment, domain FROM namespace WHERE namespace=?",
+            (name,),
+        ).fetchone()
+        return Namespace(*row) if row else None
+
+    def list_namespaces(self) -> list[str]:
+        return [r[0] for r in self._conn().execute("SELECT namespace FROM namespace")]
+
+    def delete_namespace(self, name: str) -> None:
+        with self._txn() as conn:
+            conn.execute("DELETE FROM namespace WHERE namespace=?", (name,))
+
+    # -- table info ----------------------------------------------------------
+    def insert_table_info(self, info: TableInfo) -> None:
+        """Insert table_info + name/path mappings in one transaction
+        (reference: create_table → TableInfo/TableNameId/TablePathId DAOs)."""
+        try:
+            with self._txn() as conn:
+                conn.execute(
+                    "INSERT INTO table_info(table_id, table_namespace, table_name, table_path,"
+                    " table_schema, table_schema_arrow_ipc, properties, partitions, domain)"
+                    " VALUES (?,?,?,?,?,?,?,?,?)",
+                    (
+                        info.table_id,
+                        info.table_namespace,
+                        info.table_name,
+                        info.table_path,
+                        info.table_schema,
+                        info.table_schema_arrow_ipc,
+                        json.dumps(info.properties),
+                        info.partitions,
+                        info.domain,
+                    ),
+                )
+                if info.table_name:
+                    conn.execute(
+                        "INSERT INTO table_name_id(table_name, table_id, table_namespace, domain) VALUES (?,?,?,?)",
+                        (info.table_name, info.table_id, info.table_namespace, info.domain),
+                    )
+                if info.table_path:
+                    conn.execute(
+                        "INSERT INTO table_path_id(table_path, table_id, table_namespace, domain) VALUES (?,?,?,?)",
+                        (info.table_path, info.table_id, info.table_namespace, info.domain),
+                    )
+        except sqlite3.IntegrityError as e:
+            raise MetadataError(
+                f"table {info.table_namespace}.{info.table_name} already exists"
+            ) from e
+
+    def _row_to_table_info(self, row) -> TableInfo:
+        return TableInfo(
+            table_id=row[0],
+            table_namespace=row[1],
+            table_name=row[2],
+            table_path=row[3],
+            table_schema=row[4],
+            table_schema_arrow_ipc=row[5] or b"",
+            properties=json.loads(row[6] or "{}"),
+            partitions=row[7],
+            domain=row[8],
+        )
+
+    _TI_COLS = (
+        "table_id, table_namespace, table_name, table_path, table_schema,"
+        " table_schema_arrow_ipc, properties, partitions, domain"
+    )
+
+    def get_table_info_by_id(self, table_id: str) -> TableInfo | None:
+        row = self._conn().execute(
+            f"SELECT {self._TI_COLS} FROM table_info WHERE table_id=?", (table_id,)
+        ).fetchone()
+        return self._row_to_table_info(row) if row else None
+
+    def get_table_info_by_name(self, name: str, namespace: str = "default") -> TableInfo | None:
+        row = self._conn().execute(
+            f"SELECT {self._TI_COLS} FROM table_info WHERE table_name=? AND table_namespace=?",
+            (name, namespace),
+        ).fetchone()
+        return self._row_to_table_info(row) if row else None
+
+    def get_table_info_by_path(self, path: str) -> TableInfo | None:
+        row = self._conn().execute(
+            f"SELECT {self._TI_COLS} FROM table_info WHERE table_path=?", (path,)
+        ).fetchone()
+        return self._row_to_table_info(row) if row else None
+
+    def list_tables(self, namespace: str = "default") -> list[str]:
+        return [
+            r[0]
+            for r in self._conn().execute(
+                "SELECT table_name FROM table_info WHERE table_namespace=? AND table_name != ''",
+                (namespace,),
+            )
+        ]
+
+    def update_table_properties(self, table_id: str, properties: dict) -> None:
+        with self._txn() as conn:
+            conn.execute(
+                "UPDATE table_info SET properties=? WHERE table_id=?",
+                (json.dumps(properties), table_id),
+            )
+
+    def update_table_schema(self, table_id: str, schema_json: str, schema_ipc: bytes) -> None:
+        with self._txn() as conn:
+            conn.execute(
+                "UPDATE table_info SET table_schema=?, table_schema_arrow_ipc=? WHERE table_id=?",
+                (schema_json, schema_ipc, table_id),
+            )
+
+    def delete_table(self, table_id: str) -> None:
+        with self._txn() as conn:
+            conn.execute("DELETE FROM table_name_id WHERE table_id=?", (table_id,))
+            conn.execute("DELETE FROM table_path_id WHERE table_id=?", (table_id,))
+            conn.execute("DELETE FROM partition_info WHERE table_id=?", (table_id,))
+            conn.execute("DELETE FROM data_commit_info WHERE table_id=?", (table_id,))
+            conn.execute("DELETE FROM table_info WHERE table_id=?", (table_id,))
+
+    # -- data commit info ----------------------------------------------------
+    def insert_data_commit_info(self, commits: list[DataCommitInfo]) -> int:
+        with self._txn() as conn:
+            for c in commits:
+                conn.execute(
+                    "INSERT INTO data_commit_info(table_id, partition_desc, commit_id, file_ops,"
+                    " commit_op, committed, timestamp, domain) VALUES (?,?,?,?,?,?,?,?)",
+                    (
+                        c.table_id,
+                        c.partition_desc,
+                        c.commit_id,
+                        json.dumps([f.to_json() for f in c.file_ops]),
+                        c.commit_op.value,
+                        1 if c.committed else 0,
+                        c.timestamp or now_millis(),
+                        c.domain,
+                    ),
+                )
+        return len(commits)
+
+    def _row_to_commit(self, row) -> DataCommitInfo:
+        return DataCommitInfo(
+            table_id=row[0],
+            partition_desc=row[1],
+            commit_id=row[2],
+            file_ops=[DataFileOp.from_json(d) for d in json.loads(row[3] or "[]")],
+            commit_op=CommitOp(row[4]),
+            committed=bool(row[5]),
+            timestamp=row[6],
+            domain=row[7],
+        )
+
+    def get_data_commit_info(
+        self, table_id: str, partition_desc: str, commit_ids: list[str]
+    ) -> list[DataCommitInfo]:
+        """Fetch commits preserving the order of ``commit_ids`` (snapshot order
+        defines merge order for MOR reads)."""
+        if not commit_ids:
+            return []
+        qmarks = ",".join("?" for _ in commit_ids)
+        rows = self._conn().execute(
+            "SELECT table_id, partition_desc, commit_id, file_ops, commit_op, committed,"
+            f" timestamp, domain FROM data_commit_info WHERE table_id=? AND partition_desc=?"
+            f" AND commit_id IN ({qmarks})",
+            (table_id, partition_desc, *commit_ids),
+        ).fetchall()
+        by_id = {r[2]: self._row_to_commit(r) for r in rows}
+        missing = [cid for cid in commit_ids if cid not in by_id]
+        if missing:
+            raise MetadataError(
+                f"snapshot refers to missing data commits {missing} in {table_id}/{partition_desc}"
+            )
+        return [by_id[cid] for cid in commit_ids]
+
+    def mark_committed(self, table_id: str, partition_desc: str, commit_ids: list[str]) -> None:
+        qmarks = ",".join("?" for _ in commit_ids)
+        with self._txn() as conn:
+            conn.execute(
+                f"UPDATE data_commit_info SET committed=1 WHERE table_id=? AND partition_desc=?"
+                f" AND commit_id IN ({qmarks})",
+                (table_id, partition_desc, *commit_ids),
+            )
+
+    def commit_exists(self, table_id: str, partition_desc: str, commit_id: str) -> bool:
+        row = self._conn().execute(
+            "SELECT 1 FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id=?",
+            (table_id, partition_desc, commit_id),
+        ).fetchone()
+        return row is not None
+
+    def commit_state(self, table_id: str, partition_desc: str, commit_id: str) -> bool | None:
+        """None if the commit row doesn't exist, else its ``committed`` flag.
+        Distinguishes a fully-durable commit from one that crashed between
+        phase 1 (data commit insert) and phase 2 (partition version bump)."""
+        row = self._conn().execute(
+            "SELECT committed FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id=?",
+            (table_id, partition_desc, commit_id),
+        ).fetchone()
+        return None if row is None else bool(row[0])
+
+    def delete_data_commit_info(self, table_id: str, partition_desc: str, commit_ids: list[str]) -> None:
+        qmarks = ",".join("?" for _ in commit_ids)
+        with self._txn() as conn:
+            conn.execute(
+                f"DELETE FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id IN ({qmarks})",
+                (table_id, partition_desc, *commit_ids),
+            )
+
+    # -- partition info ------------------------------------------------------
+    def _row_to_partition(self, row) -> PartitionInfo:
+        return PartitionInfo(
+            table_id=row[0],
+            partition_desc=row[1],
+            version=row[2],
+            commit_op=CommitOp(row[3]),
+            timestamp=row[4],
+            snapshot=json.loads(row[5] or "[]"),
+            expression=row[6] or "",
+            domain=row[7],
+        )
+
+    _PI_COLS = "table_id, partition_desc, version, commit_op, timestamp, snapshot, expression, domain"
+
+    def transaction_insert_partition_info(self, partitions: list[PartitionInfo]) -> None:
+        """Atomically insert new partition versions.  A PK conflict on
+        (table_id, partition_desc, version) raises CommitConflictError —
+        the optimistic-concurrency mechanism of the reference."""
+        conn = self._conn()
+        try:
+            with conn:
+                for p in partitions:
+                    if p.version < 0:  # skip the sentinel Default row the protocol appends
+                        continue
+                    conn.execute(
+                        "INSERT INTO partition_info(table_id, partition_desc, version, commit_op,"
+                        " timestamp, snapshot, expression, domain) VALUES (?,?,?,?,?,?,?,?)",
+                        (
+                            p.table_id,
+                            p.partition_desc,
+                            p.version,
+                            p.commit_op.value,
+                            p.timestamp or now_millis(),
+                            json.dumps(p.snapshot),
+                            p.expression,
+                            p.domain,
+                        ),
+                    )
+        except sqlite3.IntegrityError as e:
+            raise CommitConflictError(
+                f"concurrent commit conflict on {[(p.partition_desc, p.version) for p in partitions]}"
+            ) from e
+        self._fire_compaction_triggers(partitions)
+
+    def _fire_compaction_triggers(self, partitions: list[PartitionInfo]) -> None:
+        """Python-side reproduction of the partition_insert() PG trigger
+        (meta_init.sql:101-150): for non-compaction commits, if the version
+        gap since the last CompactionCommit ≥ threshold, notify listeners."""
+        if not self._compaction_listeners:
+            return
+        conn = self._conn()
+        for p in partitions:
+            if p.version < 0 or p.commit_op == CommitOp.COMPACTION:
+                continue
+            row = conn.execute(
+                "SELECT MAX(version) FROM partition_info WHERE table_id=? AND partition_desc=?"
+                " AND commit_op=?",
+                (p.table_id, p.partition_desc, CommitOp.COMPACTION.value),
+            ).fetchone()
+            last_compact = row[0] if row and row[0] is not None else -1
+            if p.version - last_compact >= COMPACTION_TRIGGER_VERSION_GAP:
+                ti = self.get_table_info_by_id(p.table_id)
+                event = CompactionEvent(
+                    table_id=p.table_id,
+                    table_path=ti.table_path if ti else "",
+                    table_namespace=ti.table_namespace if ti else "default",
+                    partition_desc=p.partition_desc,
+                    version=p.version,
+                )
+                for listener in self._compaction_listeners:
+                    listener(event)
+
+    def add_compaction_listener(self, fn: Callable[[CompactionEvent], None]) -> None:
+        self._compaction_listeners.append(fn)
+
+    def remove_compaction_listener(self, fn: Callable[[CompactionEvent], None]) -> None:
+        self._compaction_listeners.remove(fn)
+
+    def get_latest_partition_info(self, table_id: str, partition_desc: str) -> PartitionInfo | None:
+        row = self._conn().execute(
+            f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
+            " ORDER BY version DESC LIMIT 1",
+            (table_id, partition_desc),
+        ).fetchone()
+        return self._row_to_partition(row) if row else None
+
+    def get_partition_info_at_version(
+        self, table_id: str, partition_desc: str, version: int
+    ) -> PartitionInfo | None:
+        row = self._conn().execute(
+            f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=? AND version=?",
+            (table_id, partition_desc, version),
+        ).fetchone()
+        return self._row_to_partition(row) if row else None
+
+    def get_all_latest_partition_info(self, table_id: str) -> list[PartitionInfo]:
+        """Latest version per partition_desc."""
+        rows = self._conn().execute(
+            f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND version ="
+            " (SELECT MAX(version) FROM partition_info p2 WHERE p2.table_id=partition_info.table_id"
+            "  AND p2.partition_desc=partition_info.partition_desc)",
+            (table_id,),
+        ).fetchall()
+        return [self._row_to_partition(r) for r in rows]
+
+    def get_partition_versions(
+        self, table_id: str, partition_desc: str, start_version: int = 0, end_version: int | None = None
+    ) -> list[PartitionInfo]:
+        if end_version is None:
+            rows = self._conn().execute(
+                f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
+                " AND version >= ? ORDER BY version",
+                (table_id, partition_desc, start_version),
+            ).fetchall()
+        else:
+            rows = self._conn().execute(
+                f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
+                " AND version >= ? AND version <= ? ORDER BY version",
+                (table_id, partition_desc, start_version, end_version),
+            ).fetchall()
+        return [self._row_to_partition(r) for r in rows]
+
+    def get_partition_at_timestamp(
+        self, table_id: str, partition_desc: str, timestamp_ms: int
+    ) -> PartitionInfo | None:
+        """Time travel: the newest version with timestamp ≤ the given instant
+        (reference: SnapshotManagement / for_path_snapshot)."""
+        row = self._conn().execute(
+            f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
+            " AND timestamp <= ? ORDER BY version DESC LIMIT 1",
+            (table_id, partition_desc, timestamp_ms),
+        ).fetchone()
+        return self._row_to_partition(row) if row else None
+
+    def delete_partition_versions_before(
+        self, table_id: str, partition_desc: str, before_version: int
+    ) -> list[PartitionInfo]:
+        """Cleaner support: drop expired versions, returning them so the
+        caller can delete orphaned data files."""
+        with self._txn() as conn:
+            # SELECT and DELETE must share one transaction: a row inserted
+            # between them would be deleted without being reported, orphaning
+            # its data files forever
+            rows = conn.execute(
+                f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=? AND version < ?",
+                (table_id, partition_desc, before_version),
+            ).fetchall()
+            conn.execute(
+                "DELETE FROM partition_info WHERE table_id=? AND partition_desc=? AND version < ?",
+                (table_id, partition_desc, before_version),
+            )
+        return [self._row_to_partition(r) for r in rows]
+
+    # -- global config -------------------------------------------------------
+    def get_global_config(self, key: str, default: str | None = None) -> str | None:
+        row = self._conn().execute("SELECT value FROM global_config WHERE key=?", (key,)).fetchone()
+        return row[0] if row else default
+
+    def set_global_config(self, key: str, value: str) -> None:
+        with self._txn() as conn:
+            conn.execute(
+                "INSERT INTO global_config(key, value) VALUES (?,?)"
+                " ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (key, value),
+            )
+
+    # -- discard (compaction garbage) ---------------------------------------
+    def insert_discard_file(self, file_path: str, table_path: str, partition_desc: str) -> None:
+        with self._txn() as conn:
+            conn.execute(
+                "INSERT OR REPLACE INTO discard_compressed_file_info(file_path, table_path,"
+                " partition_desc, timestamp, t_date) VALUES (?,?,?,?,date('now'))",
+                (file_path, table_path, partition_desc, now_millis()),
+            )
+
+    def list_discard_files(self, older_than_ms: int | None = None) -> list[tuple[str, str, str]]:
+        if older_than_ms is None:
+            rows = self._conn().execute(
+                "SELECT file_path, table_path, partition_desc FROM discard_compressed_file_info"
+            ).fetchall()
+        else:
+            rows = self._conn().execute(
+                "SELECT file_path, table_path, partition_desc FROM discard_compressed_file_info WHERE timestamp < ?",
+                (older_than_ms,),
+            ).fetchall()
+        return rows
+
+    def delete_discard_files(self, file_paths: list[str]) -> None:
+        if not file_paths:
+            return
+        qmarks = ",".join("?" for _ in file_paths)
+        with self._txn() as conn:
+            conn.execute(
+                f"DELETE FROM discard_compressed_file_info WHERE file_path IN ({qmarks})",
+                tuple(file_paths),
+            )
+
+    # -- test support (reference: clean_meta_for_test) -----------------------
+    def clean_all_for_test(self) -> None:
+        with self._txn() as conn:
+            for t in (
+                "table_info",
+                "table_name_id",
+                "table_path_id",
+                "data_commit_info",
+                "partition_info",
+                "discard_compressed_file_info",
+            ):
+                conn.execute(f"DELETE FROM {t}")

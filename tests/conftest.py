@@ -1,0 +1,31 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding logic is validated on
+``xla_force_host_platform_device_count=8`` CPU devices, mirroring how the
+reference fakes "multi-node" with many clients on one PG instance
+(SURVEY.md §4 takeaway).  Must run before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+@pytest.fixture()
+def tmp_warehouse(tmp_path):
+    """A throwaway warehouse dir + metadata db for catalog tests."""
+    wh = tmp_path / "warehouse"
+    wh.mkdir()
+    return wh

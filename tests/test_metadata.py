@@ -1,0 +1,250 @@
+"""Metadata layer tests: DDL, commit protocol, optimistic concurrency,
+scan-plan construction, time travel, incremental reads."""
+
+import threading
+
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu.errors import CommitConflictError, MetadataError, TableNotFoundError
+from lakesoul_tpu.meta import (
+    CommitOp,
+    DataCommitInfo,
+    DataFileOp,
+    MetaDataClient,
+    MetaInfo,
+    PartitionInfo,
+)
+from lakesoul_tpu.meta.client import extract_hash_bucket_id, partition_desc_to_dict
+from lakesoul_tpu.meta.store import SqliteMetadataStore
+
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float32()), ("date", pa.string())])
+
+
+@pytest.fixture()
+def client(tmp_path):
+    return MetaDataClient(db_path=str(tmp_path / "meta.db"))
+
+
+def make_table(client, name="t1", pks=("id",), ranges=()):
+    return client.create_table(
+        name,
+        f"/tmp/wh/{name}",
+        SCHEMA,
+        primary_keys=list(pks),
+        range_partitions=list(ranges),
+    )
+
+
+def append_files(client, info, desc, paths, op=CommitOp.APPEND):
+    return client.commit_data_files(
+        info, {desc: [DataFileOp(path=p, size=100) for p in paths]}, op
+    )
+
+
+class TestDDL:
+    def test_create_get_drop(self, client):
+        info = make_table(client)
+        got = client.get_table_info_by_name("t1")
+        assert got.table_id == info.table_id
+        assert got.primary_keys == ["id"]
+        assert got.hash_bucket_num == 4  # default when PKs present
+        assert got.arrow_schema == SCHEMA
+        client.drop_table("t1")
+        with pytest.raises(TableNotFoundError):
+            client.get_table_info_by_name("t1")
+
+    def test_duplicate_name_rejected(self, client):
+        make_table(client)
+        with pytest.raises(MetadataError):
+            make_table(client)
+
+    def test_partitions_field_round_trip(self, client):
+        info = make_table(client, name="t2", pks=("id",), ranges=("date",))
+        assert info.partitions == "date;id"
+        assert info.range_partition_columns == ["date"]
+        assert info.primary_keys == ["id"]
+
+    def test_namespaces(self, client):
+        assert "default" in client.list_namespaces()
+        client.create_namespace("ns1")
+        assert "ns1" in client.list_namespaces()
+
+
+class TestCommitProtocol:
+    def test_append_versions_accumulate(self, client):
+        info = make_table(client)
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        append_files(client, info, "-5", ["/f/part-b_0000.parquet"])
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert head.version == 1
+        assert len(head.snapshot) == 2  # append extends the snapshot
+
+    def test_compaction_replaces_snapshot(self, client):
+        info = make_table(client)
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        append_files(client, info, "-5", ["/f/part-b_0000.parquet"])
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        client.commit_data_files(
+            info,
+            {"-5": [DataFileOp(path="/f/part-compact_0000.parquet")]},
+            CommitOp.COMPACTION,
+            read_partition_info=[head],
+        )
+        new_head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert new_head.version == 2
+        assert len(new_head.snapshot) == 1
+        assert new_head.commit_op == CommitOp.COMPACTION
+
+    def test_compaction_conflict_detected(self, client):
+        info = make_table(client)
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        stale = client.store.get_latest_partition_info(info.table_id, "-5")
+        # concurrent append advances the partition
+        append_files(client, info, "-5", ["/f/part-b_0000.parquet"])
+        with pytest.raises(CommitConflictError):
+            client.commit_data_files(
+                info,
+                {"-5": [DataFileOp(path="/f/part-compact_0000.parquet")]},
+                CommitOp.COMPACTION,
+                read_partition_info=[stale],
+            )
+
+    def test_delete_clears_snapshot(self, client):
+        info = make_table(client)
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        client.commit_data(
+            MetaInfo(
+                table_info=info,
+                list_partition=[PartitionInfo(info.table_id, "-5")],
+            ),
+            CommitOp.DELETE,
+        )
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert head.snapshot == []
+
+    def test_idempotent_commit_replay(self, client):
+        info = make_table(client)
+        cid = DataCommitInfo.new_commit_id()
+        c1 = client.commit_data_files(
+            info,
+            {"-5": [DataFileOp(path="/f/part-a_0000.parquet")]},
+            CommitOp.APPEND,
+            commit_id_by_partition={"-5": cid},
+        )
+        c2 = client.commit_data_files(
+            info,
+            {"-5": [DataFileOp(path="/f/part-a_0000.parquet")]},
+            CommitOp.APPEND,
+            commit_id_by_partition={"-5": cid},
+        )
+        assert len(c1) == 1 and c2 == []  # replay is a no-op
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert head.version == 0
+
+    def test_concurrent_appends_all_land(self, tmp_path):
+        # many writers on one store: optimistic retry must serialize them
+        store = SqliteMetadataStore(str(tmp_path / "meta.db"))
+        client = MetaDataClient(store=store)
+        info = make_table(client)
+        errs = []
+
+        def writer(i):
+            try:
+                c = MetaDataClient(store=SqliteMetadataStore(str(tmp_path / "meta.db")))
+                append_files(c, info, "-5", [f"/f/part-w{i}_0000.parquet"])
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert head.version == 7
+        assert len(head.snapshot) == 8
+
+
+class TestScanPlan:
+    def test_bucket_grouping_and_pks(self, client):
+        info = make_table(client)
+        append_files(
+            client, info, "-5", ["/f/part-a_0000.parquet", "/f/part-b_0001.parquet"]
+        )
+        append_files(client, info, "-5", ["/f/part-c_0000.parquet"])
+        plan = client.get_scan_plan_partitions("t1")
+        by_bucket = {p.bucket_id: p for p in plan}
+        assert set(by_bucket) == {0, 1}
+        assert by_bucket[0].data_files == [
+            "/f/part-a_0000.parquet",
+            "/f/part-c_0000.parquet",
+        ]
+        assert by_bucket[0].primary_keys == ["id"]
+
+    def test_pks_dropped_after_compaction(self, client):
+        info = make_table(client)
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        client.commit_data_files(
+            info,
+            {"-5": [DataFileOp(path="/f/part-comp_0000.parquet")]},
+            CommitOp.COMPACTION,
+            read_partition_info=[head],
+        )
+        plan = client.get_scan_plan_partitions("t1")
+        assert len(plan) == 1
+        assert plan[0].primary_keys == []  # merge skipped on compacted head
+
+    def test_del_file_ops_drop_files(self, client):
+        info = make_table(client, name="nopk", pks=())
+        append_files(client, info, "-5", ["/f/a.parquet", "/f/b.parquet"])
+        client.commit_data_files(
+            info,
+            {"-5": [DataFileOp(path="/f/a.parquet", file_op="del")]},
+            CommitOp.APPEND,
+        )
+        plan = client.get_scan_plan_partitions("nopk")
+        assert plan[0].data_files == ["/f/b.parquet"]
+
+    def test_range_partition_filter(self, client):
+        info = make_table(client, name="t3", pks=("id",), ranges=("date",))
+        append_files(client, info, "date=2024-01-01", ["/f/part-a_0000.parquet"])
+        append_files(client, info, "date=2024-01-02", ["/f/part-b_0000.parquet"])
+        plan = client.get_scan_plan_partitions("t3", partitions={"date": "2024-01-01"})
+        assert len(plan) == 1
+        assert plan[0].partition_values == {"date": "2024-01-01"}
+
+
+class TestTimeTravel:
+    def test_snapshot_and_incremental(self, client):
+        info = make_table(client)
+        import time
+
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        t0 = client.store.get_latest_partition_info(info.table_id, "-5").timestamp
+        time.sleep(0.002)
+        append_files(client, info, "-5", ["/f/part-b_0000.parquet"])
+
+        snap = client.get_snapshot_at_timestamp("t1", t0)
+        assert len(snap) == 1 and snap[0].version == 0
+
+        inc = client.get_incremental_partitions("t1", t0)
+        assert len(inc) == 1
+        head, commits = inc[0]
+        assert len(commits) == 1  # only the second commit is in the window
+        plan = client.incremental_scan_plan("t1", t0)
+        assert plan[0].data_files == ["/f/part-b_0000.parquet"]
+
+
+def test_extract_hash_bucket_id():
+    assert extract_hash_bucket_id("/p/part-AbC_0042.parquet") == 42
+    assert extract_hash_bucket_id("part-x_7") == 7
+    assert extract_hash_bucket_id("no-bucket.parquet") is None
+
+
+def test_partition_desc_to_dict():
+    assert partition_desc_to_dict("-5") == {}
+    assert partition_desc_to_dict("a=1,b=x") == {"a": "1", "b": "x"}
