@@ -1,0 +1,3 @@
+from lakesoul_tpu.data.jax_iter import JaxBatchIterator
+
+__all__ = ["JaxBatchIterator"]

@@ -1,0 +1,177 @@
+"""JAX delivery: stream a table scan into TPU HBM.
+
+This is the north-star path (BASELINE.json): merged RecordBatches from the
+host data plane are re-batched to a fixed size (jit needs static shapes),
+converted zero-copy to numpy, and moved to device with **double-buffered
+``jax.device_put``** so host decode/merge overlaps the device step — the
+role CUDA pinned-memory staging plays for the reference's GPU loaders.
+
+Pipeline:  scan units → [background thread: read + merge + collate]
+           → bounded queue → [foreground: device_put k batches ahead]
+           → training loop
+
+Sharding: ``LakeSoulScan.shard()/auto_shard()`` splits scan units across
+processes (data parallelism over the pod); within a process, batches can be
+placed on a ``jax.sharding.Sharding`` (e.g. batch-sharded over a local mesh)
+so a ``pjit`` step consumes them without resharding.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+
+_SENTINEL = object()
+
+
+def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
+    """Arrow → dict of numpy arrays (zero-copy where possible).  Fixed-width
+    columns map directly; strings stay as object arrays (caller should
+    tokenize/encode upstream for TPU consumption)."""
+    out: dict[str, np.ndarray] = {}
+    table = pa.table(batch) if isinstance(batch, pa.RecordBatch) else batch
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out
+
+
+class _Rebatcher:
+    """Accumulate arrow batches and emit fixed-size row windows."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self._pending: list[pa.Table] = []
+        self._rows = 0
+
+    def push(self, batch: pa.RecordBatch | pa.Table) -> Iterator[pa.Table]:
+        t = pa.table(batch) if isinstance(batch, pa.RecordBatch) else batch
+        self._pending.append(t)
+        self._rows += len(t)
+        while self._rows >= self.batch_size:
+            yield self._pop(self.batch_size)
+
+    def _pop(self, n: int) -> pa.Table:
+        big = pa.concat_tables(self._pending)
+        out = big.slice(0, n)
+        rest = big.slice(n)
+        self._pending = [rest] if len(rest) else []
+        self._rows = len(rest)
+        return out
+
+    def tail(self) -> pa.Table | None:
+        if self._rows == 0:
+            return None
+        out = pa.concat_tables(self._pending)
+        self._pending, self._rows = [], 0
+        return out
+
+
+class JaxBatchIterator:
+    """Iterator of device-resident, fixed-size batches.
+
+    Args:
+        scan: a LakeSoulScan (its batch_size sets the emitted batch size).
+        collate_fn: arrow table → pytree of numpy arrays.  Default: dict of
+            per-column arrays.
+        transform: optional numpy-level pytree transform (e.g. tokenize,
+            reshape features) applied on the host thread.
+        device_put: move batches to device (default True; False yields host
+            numpy pytrees — useful for tests and CPU pipelines).
+        sharding: optional jax.sharding.Sharding for the device placement
+            (e.g. NamedSharding(mesh, P("dp")) to batch-shard locally).
+        prefetch: queue depth for the host pipeline (decode ahead).
+        device_prefetch: how many batches to keep resident on device ahead of
+            the consumer (double buffering = 2).
+        drop_remainder: drop the final short batch (jit-friendly default True).
+    """
+
+    def __init__(
+        self,
+        scan,
+        *,
+        collate_fn: Callable[[pa.Table], Any] | None = None,
+        transform: Callable[[Any], Any] | None = None,
+        device_put: bool = True,
+        sharding=None,
+        prefetch: int = 4,
+        device_prefetch: int = 2,
+        drop_remainder: bool = True,
+    ):
+        self._scan = scan
+        self._collate = collate_fn or _default_collate
+        self._transform = transform
+        self._device_put = device_put
+        self._sharding = sharding
+        self._prefetch = max(1, prefetch)
+        self._device_prefetch = max(1, device_prefetch)
+        self._drop_remainder = drop_remainder
+
+    # ------------------------------------------------------------- pipeline
+    def _producer(self, q: queue.Queue, stop: threading.Event) -> None:
+        try:
+            rb = _Rebatcher(self._scan._batch_size)
+            for arrow_batch in self._scan.to_batches():
+                for window in rb.push(arrow_batch):
+                    if stop.is_set():
+                        return
+                    q.put(self._host_batch(window))
+            if not self._drop_remainder:
+                tail = rb.tail()
+                if tail is not None:
+                    q.put(self._host_batch(tail))
+            q.put(_SENTINEL)
+        except BaseException as e:  # surface errors to the consumer
+            q.put(e)
+
+    def _host_batch(self, window: pa.Table):
+        batch = self._collate(window)
+        if self._transform is not None:
+            batch = self._transform(batch)
+        return batch
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+        thread = threading.Thread(target=self._producer, args=(q, stop), daemon=True)
+        thread.start()
+
+        def host_iter():
+            try:
+                while True:
+                    item = q.get()
+                    if item is _SENTINEL:
+                        return
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+            finally:
+                stop.set()
+
+        if not self._device_put:
+            yield from host_iter()
+            return
+
+        import jax
+
+        put = (
+            (lambda b: jax.device_put(b, self._sharding))
+            if self._sharding is not None
+            else jax.device_put
+        )
+        # double buffering: keep device_prefetch transfers in flight so the
+        # H2D copy of batch k+1 overlaps the step on batch k
+        buf: list = []
+        for host_batch in host_iter():
+            buf.append(put(host_batch))
+            if len(buf) > self._device_prefetch:
+                yield buf.pop(0)
+        yield from buf

@@ -1,0 +1,11 @@
+from lakesoul_tpu.io.config import IOConfig
+from lakesoul_tpu.io.writer import FlushOutput, TableWriter
+from lakesoul_tpu.io.reader import read_scan_unit, iter_scan_unit_batches
+
+__all__ = [
+    "IOConfig",
+    "TableWriter",
+    "FlushOutput",
+    "read_scan_unit",
+    "iter_scan_unit_batches",
+]

@@ -1,0 +1,97 @@
+"""IO configuration.
+
+One config drives both the reader and writer stacks, like the reference's
+``LakeSoulIOConfig`` (rust/lakesoul-io/src/config/mod.rs:40) and its builder.
+Free-form ``options`` mirror config/options.rs (OPTION_KEY_*).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+
+from lakesoul_tpu.errors import ConfigError
+
+# option keys (reference: config/options.rs:6-45)
+OPTION_SKIP_MERGE_ON_READ = "skip_merge_on_read"
+OPTION_COMPRESSION = "compression"
+OPTION_COMPRESSION_LEVEL = "compression_level"
+OPTION_MAX_ROW_GROUP_SIZE = "max_row_group_size"
+OPTION_VECTOR_SEARCH_COLUMN = "vector_search_column"
+OPTION_VECTOR_SEARCH_QUERY = "vector_search_query"
+OPTION_VECTOR_SEARCH_TOP_K = "vector_search_top_k"
+OPTION_VECTOR_SEARCH_NPROBE = "vector_search_nprobe"
+
+DEFAULT_BATCH_SIZE = 8192
+DEFAULT_MAX_ROW_GROUP_SIZE = 250_000
+
+
+@dataclass
+class IOConfig:
+    """Reader+writer configuration for one table.
+
+    ``schema`` is the full table schema *including* range-partition columns;
+    like the reference, partition columns are directory-encoded and filled
+    back on read (stream/default_column.rs), not stored in data files."""
+
+    schema: pa.Schema | None = None
+    files: list[str] = field(default_factory=list)
+    primary_keys: list[str] = field(default_factory=list)
+    range_partitions: list[str] = field(default_factory=list)
+    hash_bucket_num: int = 1
+    hash_bucket_id: int = -1
+    cdc_column: str | None = None
+    # per-column merge operators: {"col": "SumAll", ...}; default UseLast
+    merge_operators: dict[str, str] = field(default_factory=dict)
+    batch_size: int = DEFAULT_BATCH_SIZE
+    prefetch_size: int = 2
+    # parquet write options — reference writes zstd(1) without dictionary
+    # (writer/mod.rs:215-240)
+    compression: str = "zstd"
+    compression_level: int = 1
+    max_row_group_size: int = DEFAULT_MAX_ROW_GROUP_SIZE
+    # target max rows per staged file before rolling to a new one
+    max_file_rows: int = 5_000_000
+    # free-form option map + object-store options (endpoint, keys, ...)
+    options: dict[str, str] = field(default_factory=dict)
+    object_store_options: dict[str, str] = field(default_factory=dict)
+    # schema-evolution default fills: {"col": value}
+    default_column_values: dict[str, object] = field(default_factory=dict)
+
+    def validate_for_write(self) -> None:
+        if self.schema is None:
+            raise ConfigError("writer requires a schema")
+        names = set(self.schema.names)
+        for c in self.primary_keys + self.range_partitions:
+            if c not in names:
+                raise ConfigError(f"column {c!r} not in schema")
+        if self.primary_keys and self.hash_bucket_num < 1:
+            raise ConfigError("primary-key table needs hash_bucket_num >= 1")
+        if set(self.primary_keys) & set(self.range_partitions):
+            raise ConfigError("a column cannot be both primary key and range partition")
+        if self.cdc_column and self.cdc_column not in names:
+            raise ConfigError(f"cdc column {self.cdc_column!r} not in schema")
+
+    @property
+    def data_schema(self) -> pa.Schema:
+        """Schema actually stored in data files: table schema minus
+        range-partition columns (directory-encoded)."""
+        if self.schema is None:
+            raise ConfigError("schema not set")
+        keep = [f for f in self.schema if f.name not in self.range_partitions]
+        return pa.schema(keep, metadata=self.schema.metadata)
+
+    @classmethod
+    def for_table(cls, table_info, **overrides) -> "IOConfig":
+        """Build a config from a TableInfo (lakesoul_tpu.meta.entity)."""
+        cfg = cls(
+            schema=table_info.arrow_schema,
+            primary_keys=table_info.primary_keys,
+            range_partitions=table_info.range_partition_columns,
+            hash_bucket_num=table_info.hash_bucket_num,
+            cdc_column=table_info.cdc_column,
+        )
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg

@@ -1,0 +1,177 @@
+"""Engine-portable filter expressions.
+
+The reference serializes filters once as Substrait plan bytes and re-parses
+them in the native core so every engine gets identical semantics
+(rust/lakesoul-io/src/filter/parser.rs).  Here the portable encoding is a
+small JSON expression tree — same role, no Substrait dependency — compiled to
+``pyarrow.compute.Expression`` for pushdown into Parquet scans.
+
+Also provides the OR-conjunctive PK-equality analysis used for hash-bucket
+pruning (reference: helpers/mod.rs collect_or_conjunctive_filter_expressions,
+reader.rs:164-225).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any
+
+import pyarrow.compute as pc
+import pyarrow.dataset as pads
+
+_COMPARES = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+@dataclass(frozen=True)
+class Filter:
+    """One node of the filter tree."""
+
+    op: str
+    col: str | None = None
+    value: Any = None
+    args: tuple["Filter", ...] = ()
+
+    # -- construction --------------------------------------------------------
+    def __and__(self, other: "Filter") -> "Filter":
+        return Filter(op="and", args=(self, other))
+
+    def __or__(self, other: "Filter") -> "Filter":
+        return Filter(op="or", args=(self, other))
+
+    def __invert__(self) -> "Filter":
+        return Filter(op="not", args=(self,))
+
+    # -- compilation ---------------------------------------------------------
+    def to_arrow(self) -> pc.Expression:
+        f = pads.field
+        if self.op in _COMPARES:
+            lhs = f(self.col)
+            rhs = pads.scalar(self.value)
+            return {
+                "eq": lhs == rhs,
+                "ne": lhs != rhs,
+                "lt": lhs < rhs,
+                "le": lhs <= rhs,
+                "gt": lhs > rhs,
+                "ge": lhs >= rhs,
+            }[self.op]
+        if self.op == "in":
+            return f(self.col).isin(list(self.value))
+        if self.op == "is_null":
+            return f(self.col).is_null()
+        if self.op == "not_null":
+            return ~f(self.col).is_null()
+        if self.op == "and":
+            out = self.args[0].to_arrow()
+            for a in self.args[1:]:
+                out = out & a.to_arrow()
+            return out
+        if self.op == "or":
+            out = self.args[0].to_arrow()
+            for a in self.args[1:]:
+                out = out | a.to_arrow()
+            return out
+        if self.op == "not":
+            return ~self.args[0].to_arrow()
+        raise ValueError(f"unknown filter op {self.op}")
+
+    # -- serde ---------------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(self._to_dict())
+
+    def _to_dict(self) -> dict:
+        d: dict[str, Any] = {"op": self.op}
+        if self.col is not None:
+            d["col"] = self.col
+        if self.value is not None or self.op == "eq":
+            d["value"] = self.value
+        if self.args:
+            d["args"] = [a._to_dict() for a in self.args]
+        return d
+
+    @classmethod
+    def from_json(cls, s: str) -> "Filter":
+        return cls._from_dict(json.loads(s))
+
+    @classmethod
+    def _from_dict(cls, d: dict) -> "Filter":
+        return cls(
+            op=d["op"],
+            col=d.get("col"),
+            value=d.get("value"),
+            args=tuple(cls._from_dict(a) for a in d.get("args", ())),
+        )
+
+
+class col:
+    """Filter builder: ``col("id") == 5``, ``col("x").is_in([1,2])``."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __eq__(self, v):  # type: ignore[override]
+        return Filter(op="eq", col=self.name, value=v)
+
+    def __ne__(self, v):  # type: ignore[override]
+        return Filter(op="ne", col=self.name, value=v)
+
+    def __lt__(self, v):
+        return Filter(op="lt", col=self.name, value=v)
+
+    def __le__(self, v):
+        return Filter(op="le", col=self.name, value=v)
+
+    def __gt__(self, v):
+        return Filter(op="gt", col=self.name, value=v)
+
+    def __ge__(self, v):
+        return Filter(op="ge", col=self.name, value=v)
+
+    def is_in(self, values):
+        return Filter(op="in", col=self.name, value=list(values))
+
+    def is_null(self):
+        return Filter(op="is_null", col=self.name)
+
+    def not_null(self):
+        return Filter(op="not_null", col=self.name)
+
+
+def conjoin(filters: list[Filter]) -> Filter | None:
+    if not filters:
+        return None
+    out = filters[0]
+    for f in filters[1:]:
+        out = out & f
+    return out
+
+
+def extract_pk_equalities(flt: Filter | None, primary_keys: list[str]) -> list[tuple[str, Any]]:
+    """If the filter is a pure OR-tree of PK equality comparisons (or a single
+    equality / IN on a PK), return the (col, value) pairs — the reader can
+    then prune whole hash buckets.  Any non-conforming node → [] (no pruning).
+    Mirrors helpers/mod.rs:collect_or_conjunctive_filter_expressions."""
+    if flt is None:
+        return []
+
+    out: list[tuple[str, Any]] = []
+
+    def walk(f: Filter) -> bool:
+        if f.op == "or":
+            return all(walk(a) for a in f.args)
+        if f.op == "eq":
+            if f.col in primary_keys:
+                out.append((f.col, f.value))
+                return True
+            return False
+        if f.op == "in":
+            if f.col in primary_keys:
+                out.extend((f.col, v) for v in f.value)
+                return True
+            return False
+        return False
+
+    if walk(flt):
+        return out
+    return []

@@ -1,0 +1,235 @@
+"""Merge-on-read: LSM-style k-way merge of sorted file runs on primary keys.
+
+Design note (TPU-first, intentionally different from the reference): the
+reference merges with a streaming loser-tree over k sorted streams
+(merge/sorted/v2/loser_tree_merger.rs) because its consumers are row engines.
+Our consumer is a batch-oriented accelerator pipeline, so the merge is
+expressed as **vectorized array ops** instead of a per-row compare loop:
+
+    concat file runs (file order = version order)
+      → stable multi-key argsort (ties keep file order)
+      → group-boundary detection by vectorized neighbor compare
+      → per-column segment reduction (UseLast = gather at group tails;
+        SumAll = reduceat; UseLastNotNull = segmented max-scan of valid row
+        indices; ...)
+
+This is O(n log n) numpy/Arrow kernel work with no Python-per-row cost, and
+the same formulation maps directly to a future on-chip Pallas segmented-scan
+kernel.  Capability parity targets: merge semantics of
+merge/sorted/sorted_stream_merger.rs + merge_operator.rs:22-165 (UseLast,
+UseLastNotNull, SumAll, SumLast, JoinedLastBy*, JoinedAllBy*), CDC delete
+semantics, and schema evolution via null-fill/cast (file_format.rs:211
+CanCastSchemaBuilder, stream/default_column.rs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from lakesoul_tpu.errors import IOError_
+
+MERGE_OPERATORS = {
+    "UseLast",
+    "UseLastNotNull",
+    "SumAll",
+    "SumLast",
+    "JoinedLastByComma",
+    "JoinedLastBySemicolon",
+    "JoinedAllByComma",
+    "JoinedAllBySemicolon",
+}
+
+CDC_DELETE = "delete"
+
+
+def uniform_table(table: pa.Table, target_schema: pa.Schema, defaults: dict | None = None) -> pa.Table:
+    """Schema evolution: reorder/cast columns to the target schema, filling
+    missing columns with defaults (or nulls)."""
+    defaults = defaults or {}
+    n = len(table)
+    cols = []
+    for fld in target_schema:
+        if fld.name in table.column_names:
+            c = table.column(fld.name)
+            if c.type != fld.type:
+                c = pc.cast(c, fld.type)
+            cols.append(c)
+        elif fld.name in defaults:
+            cols.append(pa.array([defaults[fld.name]] * n, type=fld.type))
+        else:
+            cols.append(pa.nulls(n, type=fld.type))
+    return pa.table(cols, schema=target_schema)
+
+
+def _group_boundaries(sorted_keys: list[np.ndarray | pa.Array], n: int) -> np.ndarray:
+    """Boolean array: True where row i starts a new PK group (row 0 = True)."""
+    starts = np.zeros(n, dtype=bool)
+    if n == 0:
+        return starts
+    starts[0] = True
+    for k in sorted_keys:
+        if isinstance(k, np.ndarray):
+            neq = k[1:] != k[:-1]
+        else:  # arrow array (strings etc.)
+            neq = np.asarray(pc.not_equal(k.slice(1), k.slice(0, len(k) - 1)))
+            neq = np.where(np.isnan(neq.astype(float)), True, neq).astype(bool) if neq.dtype != bool else neq
+        starts[1:] |= neq
+    return starts
+
+
+def _key_column(arr: pa.ChunkedArray | pa.Array):
+    """Key column as a zero-copy-ish comparable array for boundary detection."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    if (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_date(t)
+        or pa.types.is_time(t)
+        or pa.types.is_timestamp(t)
+    ):
+        return np.asarray(arr)
+    return arr  # strings/binary: compare with arrow kernels
+
+
+def _segmented_last_valid(valid: np.ndarray, group_id: np.ndarray, n: int) -> np.ndarray:
+    """For each row (in sorted order), the index of the last valid row seen so
+    far within its group, or -1.  One maximum.accumulate over an offset
+    encoding keeps it fully vectorized."""
+    idx = np.where(valid, np.arange(n, dtype=np.int64), -1)
+    offset = group_id.astype(np.int64) * np.int64(n + 1)
+    running = np.maximum.accumulate(idx + offset) - offset
+    return running  # -1 where no valid row yet in this group
+
+
+def merge_sorted_tables(
+    tables: list[pa.Table],
+    primary_keys: list[str],
+    *,
+    merge_operators: dict[str, str] | None = None,
+    target_schema: pa.Schema | None = None,
+    defaults: dict | None = None,
+) -> pa.Table:
+    """Merge file runs (ordered oldest → newest) into one deduplicated table.
+
+    Rows are grouped by primary key; within a group the *later* (newer) row
+    wins for UseLast semantics.  Input tables need not be pre-sorted — the
+    merge does one stable multi-key sort (ties preserve input order, which
+    encodes file version order)."""
+    merge_operators = merge_operators or {}
+    for colname, op in merge_operators.items():
+        if op not in MERGE_OPERATORS:
+            raise IOError_(f"unknown merge operator {op!r} for column {colname!r}")
+        if colname in primary_keys:
+            raise IOError_(f"merge operator on primary key column {colname!r}")
+
+    if target_schema is None:
+        target_schema = tables[0].schema
+    uniformed = [uniform_table(t, target_schema, defaults) for t in tables]
+    big = pa.concat_tables(uniformed).combine_chunks()
+    n = len(big)
+    if n == 0:
+        return big
+    if not primary_keys:
+        return big
+
+    # sort by PK columns with an explicit row-order tiebreaker: pyarrow's sort
+    # is not documented stable, and ties must keep concat order (= file
+    # version order) for "last wins" semantics
+    order = pa.array(np.arange(n, dtype=np.int64))
+    big_with_order = big.append_column("__row_order", order)
+    sort_idx = np.asarray(
+        pc.sort_indices(
+            big_with_order,
+            sort_keys=[(k, "ascending") for k in primary_keys] + [("__row_order", "ascending")],
+        )
+    ).astype(np.int64)
+
+    sorted_keys = [_key_column(big.column(k).take(pa.array(sort_idx))) for k in primary_keys]
+    starts = _group_boundaries(sorted_keys, n)
+    group_id = np.cumsum(starts) - 1
+    num_groups = int(group_id[-1]) + 1
+    group_start_pos = np.nonzero(starts)[0]
+    group_end_pos = np.append(group_start_pos[1:], n) - 1
+
+    # rows chosen by plain UseLast: the newest row of each group
+    last_row_idx = sort_idx[group_end_pos]
+    base = big.take(pa.array(last_row_idx))
+
+    if not merge_operators:
+        return base
+
+    # source-file id per original row (for SumLast / JoinedLast sub-grouping)
+    file_lengths = np.array([len(t) for t in uniformed], dtype=np.int64)
+    file_offsets = np.cumsum(file_lengths)
+    file_id_of_row = np.searchsorted(file_offsets, np.arange(n, dtype=np.int64), side="right")
+
+    out_columns = {}
+    for colname, op in merge_operators.items():
+        column = big.column(colname).combine_chunks()
+        col_sorted = column.take(pa.array(sort_idx))
+        if op == "UseLast":
+            continue  # base already has it
+        if op == "UseLastNotNull":
+            valid = np.asarray(col_sorted.is_valid())
+            last_valid = _segmented_last_valid(valid, group_id, n)[group_end_pos]
+            has_value = last_valid >= 0
+            gather = np.where(has_value, last_valid, 0)
+            vals = col_sorted.take(pa.array(gather))
+            if not has_value.all():
+                vals = pc.if_else(pa.array(has_value), vals, pa.nulls(num_groups, column.type))
+            out_columns[colname] = vals
+        elif op in ("SumAll", "SumLast"):
+            npvals = np.asarray(col_sorted.fill_null(0))
+            valid = np.asarray(col_sorted.is_valid())
+            if op == "SumLast":
+                # only rows from the newest file present in each group count
+                sorted_file_id = file_id_of_row[sort_idx]
+                last_file = sorted_file_id[group_end_pos]  # per group
+                keep = sorted_file_id == last_file[group_id]
+                npvals = np.where(keep, npvals, 0)
+                valid = valid & keep
+            sums = np.add.reduceat(npvals, group_start_pos)
+            any_valid = np.bitwise_or.reduceat(valid, group_start_pos)
+            arr = pa.array(sums).cast(column.type)
+            if not any_valid.all():
+                arr = pc.if_else(pa.array(any_valid), arr, pa.nulls(num_groups, column.type))
+            out_columns[colname] = arr
+        elif op.startswith("Joined"):
+            sep = "," if op.endswith("Comma") else ";"
+            last_only = "Last" in op
+            pyvals = col_sorted.to_pylist()
+            sorted_file_id = file_id_of_row[sort_idx]
+            joined: list[str | None] = []
+            for g in range(num_groups):
+                s, e = group_start_pos[g], group_end_pos[g] + 1
+                rows = range(s, e)
+                if last_only:
+                    lf = sorted_file_id[e - 1]
+                    rows = [i for i in rows if sorted_file_id[i] == lf]
+                vals = [pyvals[i] for i in rows if pyvals[i] is not None]
+                joined.append(sep.join(map(str, vals)) if vals else None)
+            out_columns[colname] = pa.array(joined, type=pa.string())
+        else:  # pragma: no cover
+            raise IOError_(f"unhandled merge operator {op}")
+
+    if out_columns:
+        arrays = []
+        for fld in base.schema:
+            arrays.append(out_columns.get(fld.name, base.column(fld.name)))
+        base = pa.table(arrays, schema=base.schema)
+    return base
+
+
+def apply_cdc_filter(table: pa.Table, cdc_column: str) -> pa.Table:
+    """Drop rows whose CDC row-kind marks a delete (after merge, a key whose
+    newest row is a delete disappears from the read)."""
+    if cdc_column not in table.column_names:
+        return table
+    mask = pc.not_equal(table.column(cdc_column), pa.scalar(CDC_DELETE))
+    mask = pc.fill_null(mask, True)
+    return table.filter(mask)

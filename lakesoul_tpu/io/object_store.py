@@ -1,0 +1,50 @@
+"""Object-store abstraction.
+
+The reference registers per-table object stores (S3/HDFS/local) behind the
+``object_store`` crate (rust/lakesoul-io/src/object_store.rs:185).  Here the
+same role is played by fsspec: local paths, ``gs://`` (gcsfs), ``s3://``,
+``memory://`` — whatever fsspec resolves — handed directly to
+pyarrow.parquet, which understands fsspec filesystems natively.
+"""
+
+from __future__ import annotations
+
+import os
+
+import fsspec
+
+
+def filesystem_for(path: str, storage_options: dict | None = None):
+    """Resolve (fs, normalized_path) for a file or directory path."""
+    fs, p = fsspec.core.url_to_fs(path, **(storage_options or {}))
+    return fs, p
+
+
+def ensure_dir(path: str, storage_options: dict | None = None) -> None:
+    fs, p = filesystem_for(path, storage_options)
+    if isinstance(fs, fsspec.implementations.local.LocalFileSystem):
+        os.makedirs(p, exist_ok=True)
+    else:
+        try:
+            fs.makedirs(p, exist_ok=True)
+        except Exception:
+            pass  # object stores have no real directories
+
+
+def delete_file(path: str, storage_options: dict | None = None, missing_ok: bool = True) -> None:
+    fs, p = filesystem_for(path, storage_options)
+    try:
+        fs.rm_file(p)
+    except FileNotFoundError:
+        if not missing_ok:
+            raise
+
+
+def file_size(path: str, storage_options: dict | None = None) -> int:
+    fs, p = filesystem_for(path, storage_options)
+    return fs.size(p)
+
+
+def exists(path: str, storage_options: dict | None = None) -> bool:
+    fs, p = filesystem_for(path, storage_options)
+    return fs.exists(p)

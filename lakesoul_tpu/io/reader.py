@@ -1,0 +1,185 @@
+"""Scan-unit reader with merge-on-read.
+
+Reads one scan unit — the files of a single (range-partition, hash-bucket)
+cell — applying filter pushdown, LSM merge on primary keys, merge operators,
+CDC delete filtering, schema evolution fill, and partition-column
+reconstruction.  Capability parity with LakeSoulReader::start →
+build_physical_plan (reader.rs:148-246, session.rs:794-1036), minus the
+DataFusion plumbing: the plan here *is* the code path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
+from lakesoul_tpu.io.object_store import filesystem_for
+
+
+def _read_one_file(
+    path: str,
+    *,
+    columns: list[str] | None,
+    arrow_filter,
+    storage_options: dict | None,
+) -> pa.Table:
+    fs, p = filesystem_for(path, storage_options)
+    if arrow_filter is not None:
+        ds = pads.dataset(p, format="parquet", filesystem=fs)
+        return ds.to_table(columns=columns, filter=arrow_filter)
+    return pq.read_table(p, columns=columns, filesystem=fs)
+
+
+def read_scan_unit(
+    files: list[str],
+    primary_keys: list[str],
+    *,
+    schema: pa.Schema | None = None,
+    partition_values: dict[str, str] | None = None,
+    filter: Filter | None = None,
+    merge_operators: dict[str, str] | None = None,
+    cdc_column: str | None = None,
+    drop_cdc_deletes: bool = True,
+    columns: list[str] | None = None,
+    defaults: dict | None = None,
+    storage_options: dict | None = None,
+) -> pa.Table:
+    """Read + merge one scan unit into a single Arrow table.
+
+    ``schema`` is the full table schema (incl. range-partition columns);
+    ``partition_values`` fills the directory-encoded columns back in
+    (reference: stream/default_column.rs)."""
+    partition_values = partition_values or {}
+    arrow_filter = filter.to_arrow() if filter is not None else None
+
+    # columns that must be read even if projected away later: PKs for the
+    # merge, the CDC column for delete filtering (session.rs merged_projection)
+    read_columns = None
+    if columns is not None:
+        need = list(columns)
+        for k in primary_keys:
+            if k not in need:
+                need.append(k)
+        if cdc_column and cdc_column not in need:
+            need.append(cdc_column)
+        read_columns = [c for c in need if c not in partition_values]
+
+    # file-level schema: table schema minus directory-encoded partition cols
+    file_schema = None
+    if schema is not None:
+        file_schema = pa.schema(
+            [f for f in schema if f.name not in partition_values]
+        )
+        if read_columns is not None:
+            file_schema = pa.schema([f for f in file_schema if f.name in read_columns])
+
+    # Pushdown safety: pre-merge filtering may only remove *whole PK groups*,
+    # otherwise it could drop the newest version of a row and resurrect a
+    # stale one through the merge.  So for PK tables the filter is pushed into
+    # the file scan only when it references PK columns exclusively; it is
+    # always re-applied after the merge.  Partition columns aren't stored in
+    # files, so filters referencing them can never push down.
+    file_filter = None
+    post_filter = arrow_filter
+    if arrow_filter is not None:
+        refs = _filter_column_names(filter)
+        if refs & set(partition_values):
+            file_filter = None
+        elif primary_keys and not refs <= set(primary_keys):
+            file_filter = None
+        else:
+            file_filter = arrow_filter
+            if not primary_keys:
+                post_filter = None  # exact pushdown already applied
+
+    tables = []
+    for path in files:
+        t = _read_one_file(
+            path,
+            columns=read_columns,
+            arrow_filter=file_filter,
+            storage_options=storage_options,
+        )
+        if file_schema is not None:
+            t = uniform_table(t, file_schema, defaults)
+        tables.append(t)
+
+    if primary_keys and len(tables) >= 1:
+        merged = merge_sorted_tables(
+            tables,
+            primary_keys,
+            merge_operators=merge_operators,
+            target_schema=file_schema,
+            defaults=defaults,
+        )
+    else:
+        merged = pa.concat_tables(tables) if tables else pa.table({})
+
+    # fill directory-encoded partition columns back in
+    if partition_values and schema is not None:
+        n = len(merged)
+        arrays, names = [], []
+        for fld in schema:
+            if columns is not None and fld.name not in columns and fld.name in partition_values:
+                continue
+            if fld.name in merged.column_names:
+                arrays.append(merged.column(fld.name))
+                names.append(fld.name)
+            elif fld.name in partition_values:
+                val = partition_values[fld.name]
+                scalar = None if val == "__NULL__" else val
+                arr = pa.array([scalar] * n, type=pa.string()).cast(fld.type)
+                arrays.append(arr)
+                names.append(fld.name)
+        merged = pa.table(dict(zip(names, arrays)))
+
+    if cdc_column and drop_cdc_deletes:
+        merged = apply_cdc_filter(merged, cdc_column)
+
+    # apply (or re-apply) the filter post-merge for exact semantics
+    if post_filter is not None and len(merged) > 0:
+        merged = pads.dataset(merged).to_table(filter=post_filter)
+
+    if columns is not None:
+        keep = [c for c in columns if c in merged.column_names]
+        merged = merged.select(keep)
+    return merged
+
+
+def iter_scan_unit_batches(
+    files: list[str],
+    primary_keys: list[str],
+    *,
+    batch_size: int = 8192,
+    **kwargs,
+) -> Iterator[pa.RecordBatch]:
+    """Stream one scan unit as RecordBatches.
+
+    Non-PK units stream file-by-file without materializing the whole unit;
+    PK units must merge the unit first (bounded by bucket size — the
+    reference has the same property per bucket)."""
+    if not primary_keys and kwargs.get("merge_operators") is None:
+        for path in files:
+            t = read_scan_unit([path], [], **kwargs)
+            yield from t.to_batches(max_chunksize=batch_size)
+        return
+    table = read_scan_unit(files, primary_keys, **kwargs)
+    yield from table.to_batches(max_chunksize=batch_size)
+
+
+def _filter_column_names(flt: Filter) -> set[str]:
+    names: set[str] = set()
+
+    def walk(f: Filter):
+        if f.col:
+            names.add(f.col)
+        for a in f.args:
+            walk(a)
+
+    walk(flt)
+    return names

@@ -1,0 +1,197 @@
+"""Range+hash partitioned Parquet writer.
+
+Capability parity with the reference writer stack (create_writer decision
+tree, writer/mod.rs:83-151): rows are split by range-partition values and
+Spark-Murmur3 hash buckets, PK-table cells are sorted by primary key before
+writing, parquet files are zstd(1) without dictionary encoding
+(writer/mod.rs:215-240), file names carry the ``part-<token>_NNNN.parquet``
+bucket suffix the scan planner depends on, and ``flush()`` returns the
+FlushOutput list the commit protocol consumes (writer/mod.rs:372-430).
+
+Design note: instead of the reference's async exchange
+(RepartitionByRangeAndHashExec + channels), the split is one vectorized
+hash + argsort per incoming batch — the grouping itself is array work, which
+keeps the Python layer thin and lets the C++ core / Pallas take it over
+without changing the algorithm.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from lakesoul_tpu.errors import IOError_
+from lakesoul_tpu.io.config import IOConfig
+from lakesoul_tpu.io.object_store import delete_file, ensure_dir, filesystem_for
+from lakesoul_tpu.meta.entity import NO_PARTITION_DESC
+from lakesoul_tpu.utils import spark_hash
+
+
+@dataclass
+class FlushOutput:
+    """One staged file, ready to be committed (reference: FlushOutput list
+    returned by SyncSendableMutableLakeSoulWriter::flush_and_close)."""
+
+    partition_desc: str
+    path: str
+    size: int
+    row_count: int
+    file_exist_cols: str = ""
+    bucket_id: int = -1
+
+
+def _file_token() -> str:
+    return secrets.token_hex(8)
+
+
+class TableWriter:
+    """Buffering writer for one table path.
+
+    write_batch() splits rows into (range-partition, hash-bucket) cells;
+    flush() sorts PK cells, writes one parquet file per cell, and returns
+    FlushOutputs for the metadata commit.  abort() deletes staged files
+    (reference: abort_and_close, writer/mod.rs:432)."""
+
+    def __init__(self, config: IOConfig, table_path: str):
+        config.validate_for_write()
+        self.config = config
+        self.table_path = table_path.rstrip("/")
+        self._cells: dict[tuple[str, int], list[pa.Table]] = {}
+        self._staged: list[FlushOutput] = []
+        self._closed = False
+
+    # ------------------------------------------------------------------ write
+    def write_batch(self, batch: pa.RecordBatch | pa.Table) -> None:
+        if self._closed:
+            raise IOError_("writer is closed")
+        table = pa.table(batch) if isinstance(batch, pa.RecordBatch) else batch
+        # align to declared schema (cast, fill missing nullable columns)
+        from lakesoul_tpu.io.merge import uniform_table
+
+        table = uniform_table(table, self.config.schema, self.config.default_column_values)
+        if len(table) == 0:
+            return
+        for (desc, bucket), piece in self._split(table).items():
+            self._cells.setdefault((desc, bucket), []).append(piece)
+
+    def _split(self, table: pa.Table) -> dict[tuple[str, int], pa.Table]:
+        cfg = self.config
+        n = len(table)
+        # hash buckets from PK columns (Spark-Murmur3 seed 42, chained)
+        if cfg.primary_keys and cfg.hash_bucket_num > 1:
+            hashes = spark_hash.hash_columns(
+                [table.column(k) for k in cfg.primary_keys], num_rows=n
+            )
+            buckets = spark_hash.bucket_ids(hashes, cfg.hash_bucket_num)
+        elif cfg.primary_keys:
+            buckets = np.zeros(n, dtype=np.int64)
+        else:
+            buckets = np.full(n, -1, dtype=np.int64)
+
+        # range partition descs from partition-column values
+        if cfg.range_partitions:
+            descs = self._partition_descs(table, n)
+            desc_codes, desc_uniques = _factorize(descs)
+        else:
+            desc_codes = np.zeros(n, dtype=np.int64)
+            desc_uniques = [NO_PARTITION_DESC]
+
+        out: dict[tuple[str, int], pa.Table] = {}
+        combined = desc_codes * np.int64(max(cfg.hash_bucket_num, 1) + 1) + (buckets + 1)
+        for code in np.unique(combined):
+            mask = combined == code
+            desc = desc_uniques[int(code) // (max(cfg.hash_bucket_num, 1) + 1)]
+            bucket = int(code) % (max(cfg.hash_bucket_num, 1) + 1) - 1
+            idx = np.nonzero(mask)[0]
+            out[(desc, bucket)] = table.take(pa.array(idx))
+        return out
+
+    def _partition_descs(self, table: pa.Table, n: int) -> np.ndarray:
+        parts = []
+        for c in self.config.range_partitions:
+            vals = table.column(c).cast(pa.string()).fill_null("__NULL__")
+            parts.append(np.asarray(vals, dtype=object))
+        descs = np.empty(n, dtype=object)
+        for i in range(n):
+            descs[i] = ",".join(
+                f"{c}={parts[j][i]}" for j, c in enumerate(self.config.range_partitions)
+            )
+        return descs
+
+    # ------------------------------------------------------------------ flush
+    def flush(self) -> list[FlushOutput]:
+        """Write every buffered cell to its parquet file and return the staged
+        file list.  The writer can keep receiving batches afterwards (each
+        flush stages a new set of files)."""
+        outputs: list[FlushOutput] = []
+        cfg = self.config
+        for (desc, bucket), pieces in sorted(self._cells.items()):
+            cell = pa.concat_tables(pieces).combine_chunks()
+            if cfg.primary_keys:
+                order = pa.array(np.arange(len(cell), dtype=np.int64))
+                sort_idx = pc.sort_indices(
+                    cell.append_column("__row_order", order),
+                    sort_keys=[(k, "ascending") for k in cfg.primary_keys]
+                    + [("__row_order", "ascending")],
+                )
+                cell = cell.take(sort_idx)
+            # partition columns are directory-encoded, not stored in the file
+            file_table = cell.select(
+                [f.name for f in cfg.schema if f.name not in cfg.range_partitions]
+            )
+            path = self._target_path(desc, bucket)
+            fs, p = filesystem_for(path, cfg.object_store_options)
+            pq.write_table(
+                file_table,
+                p,
+                filesystem=fs,
+                compression=cfg.compression,
+                compression_level=cfg.compression_level,
+                use_dictionary=False,
+                row_group_size=cfg.max_row_group_size,
+            )
+            out = FlushOutput(
+                partition_desc=desc,
+                path=path,
+                size=fs.size(p),
+                row_count=len(file_table),
+                file_exist_cols=",".join(file_table.column_names),
+                bucket_id=bucket,
+            )
+            outputs.append(out)
+            self._staged.append(out)
+        self._cells.clear()
+        return outputs
+
+    def _target_path(self, desc: str, bucket: int) -> str:
+        dir_path = self.table_path
+        if desc != NO_PARTITION_DESC:
+            dir_path = f"{dir_path}/{desc.replace(',', '/')}"
+        ensure_dir(dir_path, self.config.object_store_options)
+        suffix = max(bucket, 0)
+        return f"{dir_path}/part-{_file_token()}_{suffix:04d}.parquet"
+
+    # ------------------------------------------------------------------ close
+    def close(self) -> list[FlushOutput]:
+        """Flush pending data and close; returns ALL staged outputs."""
+        self.flush()
+        self._closed = True
+        return list(self._staged)
+
+    def abort(self) -> None:
+        """Discard buffers and delete every staged file."""
+        self._cells.clear()
+        for out in self._staged:
+            delete_file(out.path, self.config.object_store_options, missing_ok=True)
+        self._staged.clear()
+        self._closed = True
+
+
+def _factorize(values: np.ndarray) -> tuple[np.ndarray, list]:
+    uniques, codes = np.unique(values, return_inverse=True)
+    return codes.astype(np.int64), list(uniques)

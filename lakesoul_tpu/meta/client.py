@@ -199,8 +199,14 @@ class MetaDataClient:
             for p in meta_info.list_partition:
                 cur = cur_map.get(p.partition_desc)
                 if cur is not None:
+                    # idempotence: a replayed commit id that already made it
+                    # into the snapshot must not be appended twice (crash
+                    # between phase 2 and mark_committed, or a racing replay)
+                    fresh = [c for c in p.snapshot if c not in cur.snapshot]
+                    if not fresh:
+                        continue
                     nxt = cur.clone()
-                    nxt.snapshot.extend(p.snapshot)
+                    nxt.snapshot.extend(fresh)
                     nxt.version += 1
                 else:
                     nxt = PartitionInfo(
@@ -436,7 +442,8 @@ class MetaDataClient:
         incremental, LakeSoulOptions.scala:128-134).  Returns (version-head,
         new_commit_ids) pairs."""
         table_info = self.get_table_info_by_name(table_name, namespace)
-        end_timestamp_ms = end_timestamp_ms or now_millis()
+        if end_timestamp_ms is None:
+            end_timestamp_ms = now_millis()
         out: list[tuple[PartitionInfo, list[str]]] = []
         for head in self.store.get_all_latest_partition_info(table_info.table_id):
             versions = self.store.get_partition_versions(
@@ -493,7 +500,11 @@ class MetaDataClient:
             by_bucket: dict[int, list[str]] = {}
             for f in files:
                 bucket = extract_hash_bucket_id(f.path)
-                by_bucket.setdefault(bucket if bucket is not None else -1, []).append(f.path)
+                if bucket is None:
+                    raise MetadataError(
+                        f"cannot determine bucket id from file name {f.path}"
+                    )
+                by_bucket.setdefault(bucket, []).append(f.path)
             for bucket_id, bucket_files in sorted(by_bucket.items()):
                 plan.append(
                     ScanPlanPartition(

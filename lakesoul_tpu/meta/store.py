@@ -328,7 +328,9 @@ class SqliteMetadataStore(MetadataStore):
         with self._txn() as conn:
             for c in commits:
                 conn.execute(
-                    "INSERT INTO data_commit_info(table_id, partition_desc, commit_id, file_ops,"
+                    # OR IGNORE: concurrent replays of the same commit id are
+                    # an idempotent no-op, not an IntegrityError crash
+                    "INSERT OR IGNORE INTO data_commit_info(table_id, partition_desc, commit_id, file_ops,"
                     " commit_op, committed, timestamp, domain) VALUES (?,?,?,?,?,?,?,?)",
                     (
                         c.table_id,
@@ -378,6 +380,8 @@ class SqliteMetadataStore(MetadataStore):
         return [by_id[cid] for cid in commit_ids]
 
     def mark_committed(self, table_id: str, partition_desc: str, commit_ids: list[str]) -> None:
+        if not commit_ids:
+            return
         qmarks = ",".join("?" for _ in commit_ids)
         with self._txn() as conn:
             conn.execute(
@@ -404,6 +408,8 @@ class SqliteMetadataStore(MetadataStore):
         return None if row is None else bool(row[0])
 
     def delete_data_commit_info(self, table_id: str, partition_desc: str, commit_ids: list[str]) -> None:
+        if not commit_ids:
+            return
         qmarks = ",".join("?" for _ in commit_ids)
         with self._txn() as conn:
             conn.execute(
@@ -430,9 +436,8 @@ class SqliteMetadataStore(MetadataStore):
         """Atomically insert new partition versions.  A PK conflict on
         (table_id, partition_desc, version) raises CommitConflictError —
         the optimistic-concurrency mechanism of the reference."""
-        conn = self._conn()
         try:
-            with conn:
+            with self._txn() as conn:
                 for p in partitions:
                     if p.version < 0:  # skip the sentinel Default row the protocol appends
                         continue

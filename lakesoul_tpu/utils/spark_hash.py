@@ -157,13 +157,17 @@ def hash_bytes_list(values, seeds=None) -> np.ndarray:
     return out
 
 
-def hash_array(arr: pa.Array, seeds=None) -> np.ndarray:
-    """Hash one Arrow array; null rows keep their seed-buffer value unchanged
-    (0 for the first column), matching hash_array_primitive in the reference."""
+def hash_array(arr: pa.Array, seeds=None, *, null_values: np.ndarray | None = None) -> np.ndarray:
+    """Hash one Arrow array; null rows keep their hash-buffer value unchanged
+    (0 for the first column — the reference zero-initializes the buffer,
+    repartition/mod.rs:246), matching hash_array_primitive in the reference.
+    ``seeds`` seeds the hash of valid rows; ``null_values`` supplies the
+    passthrough value for null rows (defaults to ``seeds``)."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     n = len(arr)
     seeds_arr = _seed_array(n, seeds)
+    null_arr = seeds_arr if null_values is None else np.asarray(null_values, dtype=np.uint32)
     t = arr.type
     valid = np.ones(n, dtype=bool)
     if arr.null_count:
@@ -175,7 +179,7 @@ def hash_array(arr: pa.Array, seeds=None) -> np.ndarray:
 
     if pa.types.is_dictionary(t):
         # hash the decoded values (same logical value → same hash)
-        return hash_array(arr.cast(t.value_type), seeds)
+        return hash_array(arr.cast(t.value_type), seeds, null_values=null_values)
 
     def _dispatch(a: pa.Array, s: np.ndarray) -> np.ndarray:
         ty = a.type
@@ -224,7 +228,7 @@ def hash_array(arr: pa.Array, seeds=None) -> np.ndarray:
         raise TypeError(f"Unsupported data type in hasher: {ty}")
 
     if arr.null_count:
-        out = seeds_arr.copy()
+        out = null_arr.copy()
         out[valid] = _dispatch(filled, seeds_arr[valid])
         return out
     return _dispatch(filled, seeds_arr)
@@ -232,20 +236,17 @@ def hash_array(arr: pa.Array, seeds=None) -> np.ndarray:
 
 def hash_columns(columns, num_rows: int | None = None) -> np.ndarray:
     """Hash one row-hash per row across columns, chaining like the reference's
-    create_hashes (utils/hash/mod.rs:304): column 0 seeds with 42, column i>0
-    seeds each row with the running hash.  First-column null rows hash to 0."""
+    create_hashes (utils/hash/mod.rs:304): column 0 seeds valid rows with 42,
+    column i>0 seeds each row with the running hash.  Null rows pass the
+    buffer through unchanged, so a first-column null hashes to 0 (the
+    reference zero-initializes the buffer)."""
     cols = list(columns)
     if not cols:
         raise ValueError("hash_columns needs at least one column")
     n = num_rows if num_rows is not None else len(cols[0])
-    h = np.zeros(n, dtype=np.uint32)
-    first = True
-    for col in cols:
-        if first:
-            h = hash_array(col, None)
-            first = False
-        else:
-            h = hash_array(col, h)
+    h = hash_array(cols[0], None, null_values=np.zeros(n, dtype=np.uint32))
+    for col in cols[1:]:
+        h = hash_array(col, h)
     return h
 
 

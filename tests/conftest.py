@@ -8,12 +8,19 @@ reference fakes "multi-node" with many clients on one PG instance
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the session env points JAX at a real TPU: the axon boot
+# hook (sitecustomize) sets jax.config jax_platforms="axon,cpu", which beats
+# the env var — override the config itself before any backend initializes
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

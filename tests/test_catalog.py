@@ -1,0 +1,214 @@
+"""End-to-end catalog tests: table lifecycle, upsert + merge-on-read,
+compaction, CDC, sharding, time travel, JAX delivery."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.io.filters import col
+from lakesoul_tpu.meta.entity import CommitOp
+
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("name", pa.string())])
+
+
+@pytest.fixture()
+def catalog(tmp_warehouse):
+    return LakeSoulCatalog(str(tmp_warehouse))
+
+
+def seed_pk_table(catalog, name="t", buckets=2):
+    t = catalog.create_table(name, SCHEMA, primary_keys=["id"], hash_bucket_num=buckets)
+    t.write_arrow(
+        pa.table({"id": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0], "name": ["a", "b", "c", "d"]})
+    )
+    return t
+
+
+class TestEndToEnd:
+    def test_write_read_round_trip(self, catalog):
+        t = catalog.create_table("plain", SCHEMA)
+        t.write_arrow(pa.table({"id": [1, 2], "v": [0.5, 1.5], "name": ["x", "y"]}))
+        got = t.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2]
+        assert got.column("v").to_pylist() == [0.5, 1.5]
+
+    def test_upsert_merge_on_read(self, catalog):
+        t = seed_pk_table(catalog)
+        t.upsert(pa.table({"id": [2, 5], "v": [20.0, 5.0], "name": ["B", "e"]}))
+        got = t.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2, 3, 4, 5]
+        assert got.column("v").to_pylist() == [1.0, 20.0, 3.0, 4.0, 5.0]
+        assert got.column("name").to_pylist()[1] == "B"
+
+    def test_filter_and_projection(self, catalog):
+        t = seed_pk_table(catalog)
+        got = t.scan().filter(col("v") >= 3.0).select(["id", "v"]).to_arrow().sort_by("id")
+        assert got.column_names == ["id", "v"]
+        assert got.column("id").to_pylist() == [3, 4]
+
+    def test_bucket_pruning_reads_fewer_units(self, catalog):
+        t = seed_pk_table(catalog, buckets=4)
+        scan_all = t.scan()
+        scan_pruned = t.scan().filter(col("id") == 2)
+        assert len(scan_pruned.scan_plan()) < len(scan_all.scan_plan())
+        got = scan_pruned.to_arrow()
+        assert got.column("id").to_pylist() == [2]
+
+    def test_range_partitions_and_partition_filter(self, catalog):
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("date", pa.string())])
+        t = catalog.create_table(
+            "events", schema, primary_keys=["id"], range_partitions=["date"], hash_bucket_num=2
+        )
+        t.write_arrow(
+            pa.table(
+                {"id": [1, 2, 3], "v": [1.0, 2.0, 3.0], "date": ["d1", "d1", "d2"]}
+            )
+        )
+        got = t.scan().partitions({"date": "d1"}).to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2]
+        assert got.column("date").to_pylist() == ["d1", "d1"]  # filled back in
+        # filter on partition column works too
+        got2 = t.scan().filter(col("date") == "d2").to_arrow()
+        assert got2.column("id").to_pylist() == [3]
+
+    def test_compaction_preserves_data_and_drops_merge(self, catalog):
+        t = seed_pk_table(catalog)
+        t.upsert(pa.table({"id": [1], "v": [100.0], "name": ["A"]}))
+        before = t.to_arrow().sort_by("id")
+        n_compacted = t.compact()
+        assert n_compacted == 1
+        plan = t.scan().scan_plan()
+        assert all(u.primary_keys == [] for u in plan)  # merge skipped now
+        assert all(len(u.data_files) == 1 for u in plan)
+        after = t.to_arrow().sort_by("id")
+        assert after.equals(before)
+        # discard list captured replaced files for the cleaner
+        assert len(catalog.client.store.list_discard_files()) > 0
+
+    def test_cdc_table_delete_row(self, catalog):
+        t = catalog.create_table("cdc_t", SCHEMA, primary_keys=["id"], cdc=True)
+        rk = t.info.cdc_column
+        t.write_arrow(
+            pa.table({"id": [1, 2], "v": [1.0, 2.0], "name": ["a", "b"], rk: ["insert", "insert"]})
+        )
+        t.write_arrow(pa.table({"id": [1], "v": [0.0], "name": ["a"], rk: ["delete"]}))
+        got = t.to_arrow()
+        assert got.column("id").to_pylist() == [2]
+        # CDC consumers can keep the delete rows
+        raw = t.scan().with_cdc_deletes().to_arrow().sort_by("id")
+        assert raw.column("id").to_pylist() == [1, 2]
+
+    def test_delete_partitions(self, catalog):
+        t = seed_pk_table(catalog)
+        t.delete_partitions()
+        assert t.to_arrow().num_rows == 0
+
+
+class TestSharding:
+    def test_shard_partitions_scan_units(self, catalog):
+        t = seed_pk_table(catalog, buckets=4)
+        all_units = t.scan().scan_plan()
+        u0 = t.scan().shard(0, 2).scan_plan()
+        u1 = t.scan().shard(1, 2).scan_plan()
+        assert len(u0) + len(u1) == len(all_units)
+        rows0 = t.scan().shard(0, 2).to_arrow().num_rows
+        rows1 = t.scan().shard(1, 2).to_arrow().num_rows
+        assert rows0 + rows1 == 4
+
+    def test_auto_shard_single_process_noop(self, catalog):
+        t = seed_pk_table(catalog)
+        assert len(t.scan().auto_shard().scan_plan()) == len(t.scan().scan_plan())
+
+
+class TestTimeTravelScan:
+    def test_snapshot_and_incremental_scan(self, catalog):
+        import time
+
+        t = seed_pk_table(catalog)
+        ts0 = catalog.client.store.get_latest_partition_info(t.info.table_id, "-5").timestamp
+        time.sleep(0.002)
+        t.upsert(pa.table({"id": [9], "v": [9.0], "name": ["z"]}))
+        snap = t.scan().snapshot_at(ts0).to_arrow()
+        assert snap.num_rows == 4
+        inc = t.scan().incremental(ts0).to_arrow()
+        assert inc.column("id").to_pylist() == [9]
+
+
+class TestJaxDelivery:
+    def test_host_iter_fixed_batches(self, catalog):
+        t = catalog.create_table("big", SCHEMA)
+        n = 1000
+        t.write_arrow(
+            pa.table(
+                {"id": np.arange(n), "v": np.arange(n, dtype=np.float64), "name": ["x"] * n}
+            )
+        )
+        it = t.scan().batch_size(128).to_jax_iter(device_put=False)
+        batches = list(it)
+        assert all(len(b["id"]) == 128 for b in batches)
+        assert len(batches) == n // 128  # drop_remainder default
+        total = np.concatenate([b["id"] for b in batches])
+        assert len(np.unique(total)) == len(total)
+
+    def test_device_put_and_transform(self, catalog):
+        import jax
+
+        t = catalog.create_table("feat", SCHEMA)
+        t.write_arrow(
+            pa.table({"id": np.arange(64), "v": np.ones(64), "name": ["x"] * 64})
+        )
+
+        def transform(b):
+            return {"x": np.stack([b["id"].astype(np.float32), b["v"].astype(np.float32)], 1)}
+
+        it = t.scan().batch_size(32).to_jax_iter(transform=transform)
+        batches = list(it)
+        assert len(batches) == 2
+        assert isinstance(batches[0]["x"], jax.Array)
+        assert batches[0]["x"].shape == (32, 2)
+
+    def test_sharded_device_put(self, catalog):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        assert len(jax.devices()) == 8  # conftest forces 8 CPU devices
+        t = catalog.create_table("shardme", SCHEMA)
+        t.write_arrow(
+            pa.table({"id": np.arange(128), "v": np.ones(128), "name": ["x"] * 128})
+        )
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        sharding = NamedSharding(mesh, P("dp"))
+
+        def transform(b):
+            return b["v"].astype(np.float32)
+
+        it = t.scan().batch_size(64).to_jax_iter(transform=transform, sharding=sharding)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].sharding == sharding
+        assert batches[0].shape == (64,)
+
+    def test_producer_error_propagates(self, catalog):
+        t = seed_pk_table(catalog)
+
+        def bad_transform(b):
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(t.scan().batch_size(2).to_jax_iter(device_put=False, transform=bad_transform))
+
+
+class TestAdapters:
+    def test_torch_adapter(self, catalog):
+        t = seed_pk_table(catalog)
+        ds = t.scan().to_torch()
+        rows = sum(len(b) for b in ds)
+        assert rows == 4
+
+    def test_hf_adapter(self, catalog):
+        pytest.importorskip("datasets")
+        t = seed_pk_table(catalog)
+        ds = t.scan().to_huggingface()
+        assert len(list(ds)) == 4

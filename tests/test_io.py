@@ -1,0 +1,207 @@
+"""IO layer tests: partitioned writer, merge-on-read, merge operators,
+filters, CDC, schema evolution."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lakesoul_tpu.io import IOConfig, TableWriter, read_scan_unit
+from lakesoul_tpu.io.filters import Filter, col, extract_pk_equalities
+from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
+from lakesoul_tpu.meta.client import extract_hash_bucket_id
+from lakesoul_tpu.utils import spark_hash
+
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("name", pa.string())])
+
+
+def make_writer(tmp_path, **cfg_kwargs):
+    cfg = IOConfig(schema=SCHEMA, **cfg_kwargs)
+    return TableWriter(cfg, str(tmp_path / "tbl")), cfg
+
+
+class TestWriter:
+    def test_plain_write(self, tmp_path):
+        w, _ = make_writer(tmp_path)
+        w.write_batch(pa.table({"id": [1, 2], "v": [1.0, 2.0], "name": ["a", "b"]}))
+        outs = w.close()
+        assert len(outs) == 1
+        t = pq.read_table(outs[0].path)
+        assert t.num_rows == 2
+        assert outs[0].row_count == 2 and outs[0].size > 0
+
+    def test_hash_bucketing_matches_scalar_hash(self, tmp_path):
+        w, cfg = make_writer(tmp_path, primary_keys=["id"], hash_bucket_num=4)
+        ids = list(range(100))
+        w.write_batch(pa.table({"id": ids, "v": [float(i) for i in ids], "name": ["x"] * 100}))
+        outs = w.close()
+        assert len(outs) >= 2  # multiple buckets hit
+        for out in outs:
+            bucket = extract_hash_bucket_id(out.path)
+            assert bucket == out.bucket_id
+            t = pq.read_table(out.path)
+            for v in t.column("id").to_pylist():
+                assert spark_hash.bucket_id_for_scalar(v, 4, pa.int64()) == bucket
+            # PK cells are written sorted
+            vals = t.column("id").to_pylist()
+            assert vals == sorted(vals)
+
+    def test_range_partitioning_drops_partition_cols(self, tmp_path):
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("date", pa.string())])
+        cfg = IOConfig(schema=schema, range_partitions=["date"])
+        w = TableWriter(cfg, str(tmp_path / "tbl"))
+        w.write_batch(
+            pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0], "date": ["d1", "d1", "d2"]})
+        )
+        outs = w.close()
+        descs = sorted(o.partition_desc for o in outs)
+        assert descs == ["date=d1", "date=d2"]
+        t = pq.read_table([o for o in outs if o.partition_desc == "date=d1"][0].path)
+        assert "date" not in t.column_names  # directory-encoded
+        assert t.num_rows == 2
+        assert "date=d1" in outs[0].path
+
+    def test_abort_deletes_staged_files(self, tmp_path):
+        import os
+
+        w, _ = make_writer(tmp_path)
+        w.write_batch(pa.table({"id": [1], "v": [1.0], "name": ["a"]}))
+        outs = w.flush()
+        assert os.path.exists(outs[0].path)
+        w.abort()
+        assert not os.path.exists(outs[0].path)
+
+
+class TestMerge:
+    def test_use_last_wins(self):
+        t1 = pa.table({"id": [1, 2, 3], "v": [10.0, 20.0, 30.0]})
+        t2 = pa.table({"id": [2, 4], "v": [99.0, 40.0]})
+        m = merge_sorted_tables([t1, t2], ["id"])
+        assert m.column("id").to_pylist() == [1, 2, 3, 4]
+        assert m.column("v").to_pylist() == [10.0, 99.0, 30.0, 40.0]
+
+    def test_use_last_includes_null(self):
+        t1 = pa.table({"id": [1], "v": [10.0]})
+        t2 = pa.table({"id": [1], "v": pa.array([None], type=pa.float64())})
+        m = merge_sorted_tables([t1, t2], ["id"])
+        assert m.column("v").to_pylist() == [None]
+        m2 = merge_sorted_tables([t1, t2], ["id"], merge_operators={"v": "UseLastNotNull"})
+        assert m2.column("v").to_pylist() == [10.0]
+
+    def test_sum_all_and_sum_last(self):
+        t1 = pa.table({"id": [1, 1, 2], "v": [1, 2, 5]})
+        t2 = pa.table({"id": [1, 2], "v": [10, 7]})
+        m = merge_sorted_tables([t1, t2], ["id"], merge_operators={"v": "SumAll"})
+        assert m.column("v").to_pylist() == [13, 12]
+        m2 = merge_sorted_tables([t1, t2], ["id"], merge_operators={"v": "SumLast"})
+        # SumLast sums only rows from the newest file present in each group
+        assert m2.column("v").to_pylist() == [10, 7]
+
+    def test_joined_operators(self):
+        t1 = pa.table({"id": [1, 1], "s": ["a", "b"]})
+        t2 = pa.table({"id": [1], "s": ["c"]})
+        m = merge_sorted_tables([t1, t2], ["id"], merge_operators={"s": "JoinedAllByComma"})
+        assert m.column("s").to_pylist() == ["a,b,c"]
+        m2 = merge_sorted_tables(
+            [t1, t2], ["id"], merge_operators={"s": "JoinedLastBySemicolon"}
+        )
+        assert m2.column("s").to_pylist() == ["c"]
+
+    def test_multi_pk_and_string_keys(self):
+        t1 = pa.table({"k1": ["a", "a", "b"], "k2": [1, 2, 1], "v": [1, 2, 3]})
+        t2 = pa.table({"k1": ["a", "b"], "k2": [2, 1], "v": [20, 30]})
+        m = merge_sorted_tables([t1, t2], ["k1", "k2"])
+        assert m.column("v").to_pylist() == [1, 20, 30]
+
+    def test_unsorted_input_ok(self):
+        # vectorized merge does its own stable sort
+        t1 = pa.table({"id": [3, 1, 2], "v": [3.0, 1.0, 2.0]})
+        m = merge_sorted_tables([t1], ["id"])
+        assert m.column("id").to_pylist() == [1, 2, 3]
+
+    def test_schema_evolution_fill(self):
+        target = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("extra", pa.string())])
+        t_old = pa.table({"id": [1], "v": [1.0]})
+        u = uniform_table(t_old, target)
+        assert u.column("extra").to_pylist() == [None]
+        u2 = uniform_table(t_old, target, defaults={"extra": "dflt"})
+        assert u2.column("extra").to_pylist() == ["dflt"]
+
+    def test_cdc_delete_filter(self):
+        t1 = pa.table({"id": [1, 2], "rowKinds": ["insert", "insert"], "v": [1, 2]})
+        t2 = pa.table({"id": [1], "rowKinds": ["delete"], "v": [0]})
+        m = merge_sorted_tables([t1, t2], ["id"])
+        filtered = apply_cdc_filter(m, "rowKinds")
+        assert filtered.column("id").to_pylist() == [2]
+
+
+class TestReader:
+    def test_round_trip_with_merge(self, tmp_path):
+        w, cfg = make_writer(tmp_path, primary_keys=["id"], hash_bucket_num=2)
+        w.write_batch(pa.table({"id": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0], "name": ["a", "b", "c", "d"]}))
+        out1 = w.flush()
+        w.write_batch(pa.table({"id": [2, 3], "v": [20.0, 30.0], "name": ["B", "C"]}))
+        out2 = w.flush()
+        # per-bucket merge: bucket files from both flushes, older first
+        rows = {}
+        for bucket in {o.bucket_id for o in out1 + out2}:
+            files = [o.path for o in out1 if o.bucket_id == bucket] + [
+                o.path for o in out2 if o.bucket_id == bucket
+            ]
+            t = read_scan_unit(files, ["id"], schema=SCHEMA)
+            for r in t.to_pylist():
+                rows[r["id"]] = r
+        assert rows[1]["v"] == 1.0 and rows[2]["v"] == 20.0 and rows[3]["name"] == "C"
+        assert len(rows) == 4
+
+    def test_filter_pushdown_and_projection(self, tmp_path):
+        w, _ = make_writer(tmp_path)
+        w.write_batch(pa.table({"id": list(range(10)), "v": [float(i) for i in range(10)], "name": ["n"] * 10}))
+        outs = w.close()
+        t = read_scan_unit(
+            [outs[0].path], [], schema=SCHEMA, filter=col("v") > 5.0, columns=["id"]
+        )
+        assert t.column_names == ["id"]
+        assert t.column("id").to_pylist() == [6, 7, 8, 9]
+
+    def test_non_pk_filter_not_pushed_premerge(self, tmp_path):
+        # filter on v must not resurrect the stale version of id=1
+        w, cfg = make_writer(tmp_path, primary_keys=["id"], hash_bucket_num=1)
+        w.write_batch(pa.table({"id": [1], "v": [10.0], "name": ["old"]}))
+        o1 = w.flush()
+        w.write_batch(pa.table({"id": [1], "v": [3.0], "name": ["new"]}))
+        o2 = w.flush()
+        t = read_scan_unit(
+            [o1[0].path, o2[0].path], ["id"], schema=SCHEMA, filter=col("v") > 5.0
+        )
+        assert t.num_rows == 0  # newest version (v=3) excluded; old must NOT appear
+
+    def test_partition_value_fill(self, tmp_path):
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("date", pa.string())])
+        cfg = IOConfig(schema=schema, range_partitions=["date"])
+        w = TableWriter(cfg, str(tmp_path / "tbl"))
+        w.write_batch(pa.table({"id": [1], "v": [1.0], "date": ["2024-01-01"]}))
+        outs = w.close()
+        t = read_scan_unit(
+            [outs[0].path],
+            [],
+            schema=schema,
+            partition_values={"date": "2024-01-01"},
+        )
+        assert t.column("date").to_pylist() == ["2024-01-01"]
+
+
+class TestFilters:
+    def test_json_round_trip(self):
+        f = (col("id") == 5) | (col("name") != "x") & (col("v") > 1.5)
+        f2 = Filter.from_json(f.to_json())
+        assert f2 == f
+
+    def test_extract_pk_equalities(self):
+        f = (col("id") == 1) | (col("id") == 2)
+        assert extract_pk_equalities(f, ["id"]) == [("id", 1), ("id", 2)]
+        assert extract_pk_equalities(col("id").is_in([3, 4]), ["id"]) == [("id", 3), ("id", 4)]
+        # non-PK column breaks pruning
+        assert extract_pk_equalities((col("id") == 1) | (col("v") == 2), ["id"]) == []
+        assert extract_pk_equalities(col("id") > 5, ["id"]) == []

@@ -248,3 +248,63 @@ def test_extract_hash_bucket_id():
 def test_partition_desc_to_dict():
     assert partition_desc_to_dict("-5") == {}
     assert partition_desc_to_dict("a=1,b=x") == {"a": "1", "b": "x"}
+
+
+class TestReplayIdempotence:
+    def test_crash_between_phase2_and_mark_committed(self, client):
+        info = make_table(client, name="replay_t")
+        cid = DataCommitInfo.new_commit_id()
+        # full phase 1 + phase 2, but "crash" before mark_committed
+        client.store.insert_data_commit_info(
+            [DataCommitInfo(info.table_id, "-5", cid, [DataFileOp("/f/part-a_0000.parquet")], CommitOp.APPEND)]
+        )
+        client.commit_data(
+            MetaInfo(
+                table_info=info,
+                list_partition=[PartitionInfo(info.table_id, "-5", snapshot=[cid])],
+            ),
+            CommitOp.APPEND,
+        )
+        # replay must not double-append the commit id or bump the version
+        client.commit_data_files(
+            info,
+            {"-5": [DataFileOp("/f/part-a_0000.parquet")]},
+            CommitOp.APPEND,
+            commit_id_by_partition={"-5": cid},
+        )
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert head.version == 0
+        assert head.snapshot == [cid]
+        assert client.store.commit_state(info.table_id, "-5", cid) is True
+
+    def test_empty_commit_id_lists_are_noops(self, client):
+        info = make_table(client, name="noop_t")
+        client.store.mark_committed(info.table_id, "-5", [])
+        client.store.delete_data_commit_info(info.table_id, "-5", [])
+
+    def test_concurrent_appends_memory_store(self):
+        # the shared-connection :memory: store must serialize transactions
+        store = SqliteMetadataStore(":memory:")
+        client = MetaDataClient(store=store)
+        info = make_table(client)
+        errs = []
+
+        def writer(i):
+            try:
+                append_files(client, info, "-5", [f"/f/part-m{i}_0000.parquet"])
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        head = client.store.get_latest_partition_info(info.table_id, "-5")
+        assert len(head.snapshot) == 8
+
+    def test_incremental_end_zero_is_empty_window(self, client):
+        info = make_table(client, name="w0")
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        assert client.get_incremental_partitions("w0", 0, 0) == []

@@ -101,10 +101,16 @@ class TestVectorized:
 
     def test_nulls_leave_buffer_unchanged(self):
         arr = pa.array([1, None, 3], type=pa.int32())
-        h = sh.hash_array(arr)
-        assert int(h[1]) == 42  # null row keeps its seed (first col seed = 42)
+        # first-column nulls keep the zero-initialized buffer → hash 0
+        # (reference: repartition/mod.rs resizes the buffer with 0 and nulls
+        # never update it; the dict-array test asserts hash 0 for nulls)
         h0 = sh.hash_columns([arr])
-        assert int(h0[1]) == 42
+        assert int(h0[1]) == 0
+        # chained column: null keeps the running hash from previous columns
+        other = pa.array([7, 7, 7], type=pa.int32())
+        h1 = sh.hash_columns([other, arr])
+        base = sh.hash_columns([other])
+        assert int(h1[1]) == int(base[1])
 
     def test_multi_column_chaining(self):
         a = pa.array([1, 2], type=pa.int32())
