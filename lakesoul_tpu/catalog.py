@@ -189,12 +189,16 @@ class LakeSoulTable:
                     file_exist_cols=out.file_exist_cols,
                 )
             )
-        self.catalog.client.commit_data_files(
-            self._info,
-            files_by_partition,
-            op,
-            commit_id_by_partition=commit_id_by_partition,
-        )
+        try:
+            self.catalog.client.commit_data_files(
+                self._info,
+                files_by_partition,
+                op,
+                commit_id_by_partition=commit_id_by_partition,
+            )
+        except Exception:
+            writer.abort()  # don't orphan staged files on commit failure
+            raise
         return [f for ops in files_by_partition.values() for f in ops]
 
     def upsert(self, data) -> list[DataFileOp]:
@@ -356,6 +360,12 @@ class LakeSoulScan:
                 info.table_name, self._incremental[0], self._incremental[1],
                 namespace=info.table_namespace,
             )
+            if self._partitions:
+                units = [
+                    u
+                    for u in units
+                    if all(u.partition_values.get(k) == v for k, v in self._partitions.items())
+                ]
         elif self._snapshot_ts is not None:
             snapshot = client.get_snapshot_at_timestamp(
                 info.table_name, self._snapshot_ts, namespace=info.table_namespace

@@ -10,7 +10,10 @@ def _generate_rows(units: list[dict]):
     from lakesoul_tpu.io.reader import read_scan_unit
 
     for u in units:
-        table = read_scan_unit(u.pop("data_files"), u.pop("primary_keys"), **u)
+        # no mutation: datasets re-invokes the generator every epoch with the
+        # same gen_kwargs dicts
+        kwargs = {k: v for k, v in u.items() if k not in ("data_files", "primary_keys")}
+        table = read_scan_unit(u["data_files"], u["primary_keys"], **kwargs)
         yield from table.to_pylist()
 
 

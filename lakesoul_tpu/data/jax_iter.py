@@ -117,20 +117,31 @@ class JaxBatchIterator:
 
     # ------------------------------------------------------------- pipeline
     def _producer(self, q: queue.Queue, stop: threading.Event) -> None:
+        def put(item) -> bool:
+            # never park forever on a full queue: an abandoned consumer (early
+            # break from the training loop) sets `stop` and we must exit
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         try:
             rb = _Rebatcher(self._scan._batch_size)
             for arrow_batch in self._scan.to_batches():
                 for window in rb.push(arrow_batch):
-                    if stop.is_set():
+                    if not put(self._host_batch(window)):
                         return
-                    q.put(self._host_batch(window))
             if not self._drop_remainder:
                 tail = rb.tail()
                 if tail is not None:
-                    q.put(self._host_batch(tail))
-            q.put(_SENTINEL)
+                    if not put(self._host_batch(tail)):
+                        return
+            put(_SENTINEL)
         except BaseException as e:  # surface errors to the consumer
-            q.put(e)
+            put(e)
 
     def _host_batch(self, window: pa.Table):
         batch = self._collate(window)

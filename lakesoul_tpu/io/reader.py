@@ -58,15 +58,19 @@ def read_scan_unit(
     arrow_filter = filter.to_arrow() if filter is not None else None
 
     # columns that must be read even if projected away later: PKs for the
-    # merge, the CDC column for delete filtering (session.rs merged_projection)
+    # merge, the CDC column for delete filtering (session.rs merged_projection),
+    # and any column the filter references
     read_columns = None
     if columns is not None:
         need = list(columns)
-        for k in primary_keys:
+        extra = list(primary_keys)
+        if cdc_column:
+            extra.append(cdc_column)
+        if filter is not None:
+            extra.extend(_filter_column_names(filter))
+        for k in extra:
             if k not in need:
                 need.append(k)
-        if cdc_column and cdc_column not in need:
-            need.append(cdc_column)
         read_columns = [c for c in need if c not in partition_values]
 
     # file-level schema: table schema minus directory-encoded partition cols
@@ -120,13 +124,13 @@ def read_scan_unit(
     else:
         merged = pa.concat_tables(tables) if tables else pa.table({})
 
-    # fill directory-encoded partition columns back in
+    # fill directory-encoded partition columns back in (all of them — the
+    # post-merge filter may reference partition columns that the final
+    # projection drops)
     if partition_values and schema is not None:
         n = len(merged)
         arrays, names = [], []
         for fld in schema:
-            if columns is not None and fld.name not in columns and fld.name in partition_values:
-                continue
             if fld.name in merged.column_names:
                 arrays.append(merged.column(fld.name))
                 names.append(fld.name)

@@ -1,0 +1,11 @@
+from lakesoul_tpu.models.bert import BertConfig, bert_forward, bert_mlm_loss, init_bert_params
+from lakesoul_tpu.models.mlp import init_mlp_params, mlp_forward
+
+__all__ = [
+    "BertConfig",
+    "init_bert_params",
+    "bert_forward",
+    "bert_mlm_loss",
+    "init_mlp_params",
+    "mlp_forward",
+]

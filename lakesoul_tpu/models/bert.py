@@ -1,0 +1,190 @@
+"""BERT-style encoder for MLM training, written functionally (param pytrees +
+pure apply fns) so sharding is explicit and pjit/GSPMD-friendly.
+
+This is the flagship model the data plane feeds (BASELINE.json config 3:
+C4 → BERT-base MLM).  Parallelism:
+
+- dp: batch dimension
+- tp: attention heads and FFN hidden sharded (Megatron-style column/row split;
+  XLA inserts the psum for the row-parallel matmuls from sharding constraints)
+- sp: sequence dimension via ring attention (lakesoul_tpu.parallel.ring_attention)
+
+All matmuls run in bfloat16 with float32 accumulation (MXU-native).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    ff: int = 3072
+    max_len: int = 512
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 1024, max_len: int = 128) -> "BertConfig":
+        return BertConfig(
+            vocab_size=vocab_size, hidden=128, layers=2, heads=4, ff=256, max_len=max_len
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+def init_bert_params(cfg: BertConfig, key: jax.Array) -> dict:
+    """Initialize a parameter pytree.  Layers are stacked on a leading axis so
+    the encoder runs as one lax.scan (fast compile, XLA-friendly)."""
+    k_emb, k_pos, k_layers, k_head = jax.random.split(key, 4)
+    h, f, L = cfg.hidden, cfg.ff, cfg.layers
+    std = 0.02
+
+    def norm(key, shape):
+        return (jax.random.normal(key, shape) * std).astype(jnp.float32)
+
+    ks = jax.random.split(k_layers, 8)
+    params = {
+        "tok_emb": norm(k_emb, (cfg.vocab_size, h)),
+        "pos_emb": norm(k_pos, (cfg.max_len, h)),
+        "emb_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+        "layers": {
+            "wq": norm(ks[0], (L, h, h)),
+            "wk": norm(ks[1], (L, h, h)),
+            "wv": norm(ks[2], (L, h, h)),
+            "wo": norm(ks[3], (L, h, h)),
+            "w1": norm(ks[4], (L, h, f)),
+            "w2": norm(ks[5], (L, f, h)),
+            "b1": jnp.zeros((L, f)),
+            "b2": jnp.zeros((L, h)),
+            "ln1": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+            "ln2": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+        },
+        "mlm_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+        "mlm_bias": jnp.zeros((cfg.vocab_size,)),
+    }
+    return params
+
+
+def param_sharding_rules(plan) -> dict:
+    """PartitionSpecs per parameter path for a MeshPlan: FFN and QKV/out
+    projections tensor-sharded over 'tp' (Megatron column/row split),
+    embeddings replicated."""
+    rules = {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "emb_ln": {"scale": P(), "bias": P()},
+        "layers": {
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w1": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+            "b1": P(None, "tp"),
+            "b2": P(None, None),
+            "ln1": {"scale": P(), "bias": P()},
+            "ln2": {"scale": P(), "bias": P()},
+        },
+        "mlm_ln": {"scale": P(), "bias": P()},
+        "mlm_bias": P(),
+    }
+    return rules
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def bert_forward(
+    params: dict,
+    input_ids: jax.Array,
+    attn_mask: jax.Array | None = None,
+    *,
+    cfg: BertConfig,
+    attention_fn=None,
+) -> jax.Array:
+    """Encoder forward → MLM logits [B, T, vocab].
+
+    ``attention_fn(q, k, v, mask)`` defaults to plain full attention;
+    pass ``make_ring_attention(mesh)`` for sequence parallelism."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = input_ids.shape
+    if attn_mask is None:
+        attn_mask = jnp.ones((B, T), dtype=bool)
+    else:
+        attn_mask = attn_mask.astype(bool)
+
+    x = params["tok_emb"][input_ids] + params["pos_emb"][:T][None, :, :]
+    x = _layer_norm(x, params["emb_ln"]["scale"], params["emb_ln"]["bias"]).astype(dtype)
+
+    H, D = cfg.heads, cfg.head_dim
+
+    if attention_fn is None:
+
+        def attention_fn(q, k, v, mask):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+            s = s / np.sqrt(D)
+            s = jnp.where(mask[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32).astype(v.dtype)
+
+    def layer(x, lp):
+        # pre-LN transformer block
+        y = _layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+        q = (y @ lp["wq"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        k = (y @ lp["wk"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        v = (y @ lp["wv"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        a = attention_fn(q, k, v, attn_mask)
+        a = a.transpose(0, 2, 1, 3).reshape(B, T, cfg.hidden)
+        x = x + (a @ lp["wo"].astype(dtype))
+        y = _layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
+        hdn = jax.nn.gelu(y @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
+        x = x + (hdn @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype))
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+
+    x = _layer_norm(x, params["mlm_ln"]["scale"], params["mlm_ln"]["bias"])
+    # weight-tied MLM head
+    logits = jnp.einsum(
+        "bth,vh->btv", x.astype(jnp.float32), params["tok_emb"], preferred_element_type=jnp.float32
+    ) + params["mlm_bias"]
+    return logits
+
+
+def bert_mlm_loss(
+    params: dict,
+    input_ids: jax.Array,
+    labels: jax.Array,
+    attn_mask: jax.Array | None = None,
+    *,
+    cfg: BertConfig,
+    attention_fn=None,
+) -> jax.Array:
+    """Masked-LM loss: labels == -100 are ignored."""
+    logits = bert_forward(params, input_ids, attn_mask, cfg=cfg, attention_fn=attention_fn)
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)

@@ -1,0 +1,35 @@
+"""Small MLP for tabular training — the Titanic-style e2e config
+(BASELINE.json config 1, reference: python/examples Titanic MLP)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_params(key, in_dim: int, hidden: int = 64, out_dim: int = 2, layers: int = 2):
+    params = []
+    dims = [in_dim] + [hidden] * (layers - 1) + [out_dim]
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        params.append(
+            {
+                "w": jax.random.normal(sub, (a, b)) * (2.0 / a) ** 0.5,
+                "b": jnp.zeros((b,)),
+            }
+        )
+    return params
+
+
+def mlp_forward(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, x, y):
+    logits = mlp_forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))

@@ -1,0 +1,118 @@
+"""ResNet-50 in functional JAX (param pytrees + pure apply), bf16 compute.
+
+The ImageNet consumer of the data plane (BASELINE.json config 2: ImageNet
+parquet → sharded scan → ResNet-50 train loop on a TPU pod).  Convolutions
+are NHWC (TPU-native layout); BatchNorm uses per-batch statistics folded into
+the train step (simple, XLA-fusable) with running stats carried in state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+BLOCKS = {  # ResNet-50 stage configuration
+    50: (3, 4, 6, 3),
+}
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+
+
+def _conv_init(key, shape):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape) * (2.0 / fan_in) ** 0.5
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def init_resnet_params(cfg: ResNetConfig, key) -> dict:
+    keys = iter(jax.random.split(key, 256))
+    w = cfg.width
+    params: dict = {
+        "stem": {"conv": _conv_init(next(keys), (7, 7, 3, w)), "bn": _bn_init(w)},
+        "stages": [],
+        "head": {
+            "w": jax.random.normal(next(keys), (w * 32, cfg.num_classes)) * 0.01,
+            "b": jnp.zeros((cfg.num_classes,)),
+        },
+    }
+    in_c = w
+    for stage, nblocks in enumerate(BLOCKS[cfg.depth]):
+        mid = w * (2**stage)
+        out_c = mid * 4
+        blocks = []
+        for b in range(nblocks):
+            blk = {
+                "conv1": _conv_init(next(keys), (1, 1, in_c, mid)),
+                "bn1": _bn_init(mid),
+                "conv2": _conv_init(next(keys), (3, 3, mid, mid)),
+                "bn2": _bn_init(mid),
+                "conv3": _conv_init(next(keys), (1, 1, mid, out_c)),
+                "bn3": _bn_init(out_c),
+            }
+            if b == 0:
+                blk["proj"] = _conv_init(next(keys), (1, 1, in_c, out_c))
+                blk["proj_bn"] = _bn_init(out_c)
+            blocks.append(blk)
+            in_c = out_c
+        params["stages"].append(blocks)
+    return params
+
+
+def _bn(x, p):
+    # per-batch statistics over N, H, W (training mode)
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(0, 1, 2), keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def resnet_forward(params: dict, images: jax.Array, *, cfg: ResNetConfig) -> jax.Array:
+    """images [B, H, W, 3] → logits [B, num_classes]."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = images.astype(dtype)
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for stage, blocks in enumerate(params["stages"]):
+        for b, blk in enumerate(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            resid = x
+            y = jax.nn.relu(_bn(_conv(x, blk["conv1"]), blk["bn1"]))
+            y = jax.nn.relu(_bn(_conv(y, blk["conv2"], stride=stride), blk["bn2"]))
+            y = _bn(_conv(y, blk["conv3"]), blk["bn3"])
+            if "proj" in blk:
+                resid = _bn(_conv(x, blk["proj"], stride=stride), blk["proj_bn"])
+            x = jax.nn.relu(y + resid)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def resnet_loss(params, images, labels, *, cfg: ResNetConfig):
+    logits = resnet_forward(params, images, cfg=cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

@@ -1,0 +1,113 @@
+"""Sharded training steps.
+
+Builds jitted train steps over a MeshPlan: parameters sharded per model rules
+(tp), batches sharded over dp, sequence over sp (ring attention).  XLA/GSPMD
+inserts all gradient psums and tensor-parallel collectives from the sharding
+constraints — no hand-written collectives outside the ring-attention kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lakesoul_tpu.models.bert import (
+    BertConfig,
+    bert_mlm_loss,
+    init_bert_params,
+    param_sharding_rules,
+)
+from lakesoul_tpu.parallel.mesh import MeshPlan
+from lakesoul_tpu.parallel.ring_attention import make_ring_attention
+
+
+def _specs_to_shardings(mesh, rules):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        rules,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def make_bert_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, seed: int = 0):
+    """Initialize (params, opt_state) laid out on the mesh."""
+    rules = param_sharding_rules(plan)
+    shardings = _specs_to_shardings(plan.mesh, rules)
+    init_fn = jax.jit(functools.partial(init_bert_params, cfg), out_shardings=shardings)
+    params = init_fn(jax.random.key(seed))
+    tx = optax.adamw(lr)
+    opt_state = tx.init(params)  # mirrors param sharding via GSPMD propagation
+    return params, opt_state, tx, shardings
+
+
+def make_bert_train_step(cfg: BertConfig, plan: MeshPlan, tx, param_shardings):
+    """Jitted MLM train step: (params, opt_state, input_ids, labels, mask) →
+    (params, opt_state, loss).  Batch arrives sharded P('dp', 'sp')."""
+    use_ring = plan.sp > 1
+    attention_fn = make_ring_attention(plan.mesh) if use_ring else None
+    batch_sharding = NamedSharding(plan.mesh, P("dp", "sp"))
+    loss_fn = functools.partial(bert_mlm_loss, cfg=cfg, attention_fn=attention_fn)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(param_shardings, None, batch_sharding, batch_sharding, batch_sharding),
+        out_shardings=(param_shardings, None, NamedSharding(plan.mesh, P())),
+        donate_argnums=(0, 1),
+    )
+    def train_step(params, opt_state, input_ids, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, mask)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def make_mlp_train_step(tx, mesh=None):
+    """Data-parallel MLP step for tabular pipelines (Titanic config)."""
+    from lakesoul_tpu.models.mlp import mlp_loss
+
+    batch_sharding = (
+        NamedSharding(mesh, P("dp")) if mesh is not None and "dp" in mesh.axis_names else None
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(mlp_loss)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, batch_sharding
+
+
+def make_resnet_train_step(cfg, tx, plan: MeshPlan | None = None):
+    """Data-parallel ResNet step (ImageNet config): batch over dp, params
+    replicated."""
+    from lakesoul_tpu.models.resnet import resnet_loss
+
+    kwargs = {}
+    if plan is not None:
+        kwargs = dict(
+            in_shardings=(
+                NamedSharding(plan.mesh, P()),
+                None,
+                NamedSharding(plan.mesh, P("dp")),
+                NamedSharding(plan.mesh, P("dp")),
+            ),
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1), **kwargs)
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p, x, y: resnet_loss(p, x, y, cfg=cfg)
+        )(params, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,85 @@
+"""Device-mesh construction and sharding plans.
+
+The reference's parallelism axes are storage-level (hash buckets, scan-unit
+round robin — SURVEY.md §2.8); the TPU build adds the model-side axes needed
+by its north-star consumers (ResNet-50 / BERT training, BASELINE.json):
+
+- ``dp``  — data parallel over batch
+- ``tp``  — tensor parallel over heads / ffn
+- ``sp``  — sequence parallel (ring attention) for long context
+
+Meshes are pure ``jax.sharding.Mesh`` objects; shardings are expressed with
+``NamedSharding`` + ``PartitionSpec`` so XLA inserts all collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A named mesh plus the framework's canonical axis names."""
+
+    mesh: Mesh
+    dp: int
+    tp: int
+    sp: int
+
+    @property
+    def axis_names(self):
+        return self.mesh.axis_names
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        return self.sharding("dp")
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+
+def _factor(n: int) -> tuple[int, int, int]:
+    """Split n devices into (dp, tp, sp), preferring dp ≥ tp ≥ sp, powers
+    of the prime factorization of n."""
+    dp, tp, sp = n, 1, 1
+    # peel a factor of 2 for tp, then for sp, when available
+    if dp % 2 == 0 and dp > 1:
+        dp //= 2
+        tp = 2
+    if dp % 2 == 0 and dp > 1:
+        dp //= 2
+        sp = 2
+    return dp, tp, sp
+
+
+def make_mesh(
+    devices=None,
+    *,
+    dp: int | None = None,
+    tp: int | None = None,
+    sp: int | None = None,
+) -> MeshPlan:
+    """Build a (dp, tp, sp) mesh over the given (default: all) devices.
+    Unspecified axis sizes are inferred from the device count."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None and tp is None and sp is None:
+        dp, tp, sp = _factor(n)
+    else:
+        dp = dp or 1
+        tp = tp or 1
+        sp = sp or max(1, n // (dp * tp))
+    if dp * tp * sp != n:
+        raise ValueError(f"mesh {dp}x{tp}x{sp} != {n} devices")
+    arr = np.array(devices).reshape(dp, tp, sp)
+    mesh = Mesh(arr, ("dp", "tp", "sp"))
+    return MeshPlan(mesh=mesh, dp=dp, tp=tp, sp=sp)

@@ -212,3 +212,66 @@ class TestAdapters:
         t = seed_pk_table(catalog)
         ds = t.scan().to_huggingface()
         assert len(list(ds)) == 4
+
+
+class TestReviewRegressions:
+    def test_filter_only_column_with_projection(self, catalog):
+        # filter references a non-PK, non-selected column: must still work
+        t = seed_pk_table(catalog, name="fp")
+        got = t.scan().select(["id"]).filter(col("v") >= 3.0).to_arrow().sort_by("id")
+        assert got.column_names == ["id"]
+        assert got.column("id").to_pylist() == [3, 4]
+
+    def test_partition_filter_with_projection_dropping_partition_col(self, catalog):
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("date", pa.string())])
+        t = catalog.create_table("pp", schema, primary_keys=["id"], range_partitions=["date"])
+        t.write_arrow(pa.table({"id": [1, 2], "v": [1.0, 2.0], "date": ["d1", "d2"]}))
+        got = t.scan().select(["id"]).filter(col("date") == "d2").to_arrow()
+        assert got.column_names == ["id"]
+        assert got.column("id").to_pylist() == [2]
+
+    def test_hf_dataset_two_epochs(self, catalog):
+        pytest.importorskip("datasets")
+        t = seed_pk_table(catalog, name="hf2")
+        ds = t.scan().to_huggingface()
+        assert len(list(ds)) == 4
+        assert len(list(ds)) == 4  # second epoch must not fail
+
+    def test_incremental_respects_partition_filter(self, catalog):
+        import time
+
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("date", pa.string())])
+        t = catalog.create_table("incp", schema, primary_keys=["id"], range_partitions=["date"])
+        t.write_arrow(pa.table({"id": [1], "v": [1.0], "date": ["d1"]}))
+        ts0 = max(
+            p.timestamp
+            for p in catalog.client.store.get_all_latest_partition_info(t.info.table_id)
+        )
+        time.sleep(0.002)
+        t.write_arrow(pa.table({"id": [2, 3], "v": [2.0, 3.0], "date": ["d1", "d2"]}))
+        inc = t.scan().incremental(ts0).partitions({"date": "d2"}).to_arrow()
+        assert inc.column("id").to_pylist() == [3]
+
+    def test_abandoned_iterator_does_not_leak_producer(self, catalog):
+        import threading
+        import time
+
+        t = catalog.create_table("leak", SCHEMA)
+        n = 4096
+        t.write_arrow(
+            pa.table({"id": np.arange(n), "v": np.ones(n), "name": ["x"] * n})
+        )
+        before = threading.active_count()
+        it = iter(t.scan().batch_size(64).to_jax_iter(device_put=False, prefetch=1))
+        next(it)
+        del it  # abandon mid-stream with a full queue
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before
+
+    def test_second_compact_is_noop(self, catalog):
+        t = seed_pk_table(catalog, name="c2")
+        t.upsert(pa.table({"id": [1], "v": [10.0], "name": ["A"]}))
+        assert t.compact() == 1
+        assert t.compact() == 0

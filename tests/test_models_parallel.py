@@ -1,0 +1,158 @@
+"""Model + parallelism tests on the virtual 8-device CPU mesh: ring attention
+correctness vs full attention, sharded BERT train step, ResNet/MLP steps,
+and the driver entry points."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lakesoul_tpu.models.bert import BertConfig, bert_forward, bert_mlm_loss, init_bert_params
+from lakesoul_tpu.models.train import (
+    make_bert_train_state,
+    make_bert_train_step,
+    make_mlp_train_step,
+    make_resnet_train_step,
+)
+from lakesoul_tpu.parallel.mesh import make_mesh
+from lakesoul_tpu.parallel.ring_attention import make_ring_attention, ring_attention
+
+
+class TestMesh:
+    def test_factorization(self):
+        plan = make_mesh(jax.devices())
+        assert plan.dp * plan.tp * plan.sp == 8
+        assert plan.mesh.axis_names == ("dp", "tp", "sp")
+
+    def test_explicit_axes(self):
+        plan = make_mesh(jax.devices(), dp=2, tp=2, sp=2)
+        assert (plan.dp, plan.tp, plan.sp) == (2, 2, 2)
+        with pytest.raises(ValueError):
+            make_mesh(jax.devices(), dp=3, tp=1, sp=1)
+
+
+class TestRingAttention:
+    def test_matches_full_attention(self):
+        plan = make_mesh(jax.devices(), dp=1, tp=1, sp=8)
+        B, H, T, D = 2, 4, 64, 16
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)), dtype=jnp.float32)
+        mask = np.ones((B, T), dtype=bool)
+        mask[:, -7:] = False  # padding on the tail
+        mask = jnp.asarray(mask)
+
+        # reference: plain softmax attention with masking
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        expected = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+        ring = make_ring_attention(plan.mesh)
+        got = jax.jit(ring)(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_ring_respects_mask_fully_padded_shard(self):
+        # one whole sequence shard masked out must not poison the softmax
+        plan = make_mesh(jax.devices(), dp=1, tp=1, sp=8)
+        B, H, T, D = 1, 2, 32, 8
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)), dtype=jnp.float32)
+        mask = np.ones((B, T), dtype=bool)
+        mask[:, T // 2 :] = False  # entire later shards padded
+        ring = make_ring_attention(plan.mesh)
+        got = np.asarray(jax.jit(ring)(q, k, v, jnp.asarray(mask)))
+        assert np.isfinite(got).all()
+
+
+class TestBert:
+    def test_forward_shapes_and_loss(self):
+        cfg = BertConfig.tiny()
+        params = init_bert_params(cfg, jax.random.key(0))
+        ids = jnp.zeros((2, 16), dtype=jnp.int32)
+        logits = jax.jit(lambda p, i: bert_forward(p, i, cfg=cfg))(params, ids)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        labels = jnp.full((2, 16), -100, dtype=jnp.int32)
+        labels = labels.at[0, 3].set(7)
+        loss = bert_mlm_loss(params, ids, labels, cfg=cfg)
+        assert np.isfinite(float(loss))
+
+    def test_sharded_train_step_runs_and_improves(self):
+        plan = make_mesh(jax.devices(), dp=2, tp=2, sp=2)
+        cfg = BertConfig(vocab_size=128, hidden=64, layers=2, heads=4, ff=128, max_len=32)
+        params, opt_state, tx, shardings = make_bert_train_state(cfg, plan, lr=5e-3)
+        step = make_bert_train_step(cfg, plan, tx, shardings)
+        rng = np.random.default_rng(0)
+        B, T = 4, 32
+        sharding = NamedSharding(plan.mesh, P("dp", "sp"))
+        ids = jax.device_put(rng.integers(0, 128, (B, T)).astype(np.int32), sharding)
+        labels_np = np.full((B, T), -100, np.int32)
+        labels_np[:, ::4] = rng.integers(0, 128, labels_np[:, ::4].shape)
+        labels = jax.device_put(labels_np, sharding)
+        mask = jax.device_put(np.ones((B, T), bool), sharding)
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, ids, labels, mask)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]  # optimizing
+
+    def test_tp_params_actually_sharded(self):
+        plan = make_mesh(jax.devices(), dp=2, tp=2, sp=2)
+        cfg = BertConfig(vocab_size=64, hidden=64, layers=2, heads=4, ff=128, max_len=16)
+        params, *_ = make_bert_train_state(cfg, plan)
+        w1_sharding = params["layers"]["w1"].sharding
+        assert w1_sharding.spec == P(None, None, "tp")
+
+
+class TestOtherModels:
+    def test_mlp_step(self):
+        from lakesoul_tpu.models.mlp import init_mlp_params
+
+        params = init_mlp_params(jax.random.key(0), 4)
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        step, _ = make_mlp_train_step(tx)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(32, 4)), dtype=jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 2, 32), dtype=jnp.int32)
+        params, opt_state, loss = step(params, opt_state, x, y)
+        assert np.isfinite(float(loss))
+
+    def test_resnet_tiny_step(self):
+        from lakesoul_tpu.models.resnet import ResNetConfig, init_resnet_params
+
+        cfg = ResNetConfig(num_classes=10, width=8, dtype="float32")
+        params = init_resnet_params(cfg, jax.random.key(0))
+        tx = optax.sgd(0.1)
+        opt_state = tx.init(params)
+        plan = make_mesh(jax.devices())
+        step = make_resnet_train_step(cfg, tx, plan)
+        rng = np.random.default_rng(0)
+        images = jax.device_put(
+            rng.normal(size=(8, 32, 32, 3)).astype(np.float32),
+            NamedSharding(plan.mesh, P("dp")),
+        )
+        labels = jax.device_put(
+            rng.integers(0, 10, 8).astype(np.int32), NamedSharding(plan.mesh, P("dp"))
+        )
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        assert np.isfinite(float(loss))
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip_8(self):
+        import __graft_entry__ as ge
+
+        ge.dryrun_multichip(8)
+
+    def test_entry_compiles_tiny(self):
+        # full BERT-base compile on CPU is slow; check the traced shapes only
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        shape = jax.eval_shape(fn, *args)
+        assert shape.shape == (8, 128, 30522)
