@@ -15,7 +15,7 @@ from typing import Any, Iterable, Iterator
 
 import pyarrow as pa
 
-from lakesoul_tpu.errors import ConfigError, MetadataError
+from lakesoul_tpu.errors import CommitConflictError, ConfigError, MetadataError
 from lakesoul_tpu.io.config import IOConfig
 from lakesoul_tpu.io.filters import Filter, extract_pk_equalities
 from lakesoul_tpu.io.reader import iter_scan_unit_batches, read_scan_unit
@@ -196,8 +196,15 @@ class LakeSoulTable:
                 op,
                 commit_id_by_partition=commit_id_by_partition,
             )
+        except CommitConflictError:
+            # conflict = the partition-version insert never landed, so the
+            # staged files are provably invisible → safe to delete
+            writer.abort()
+            raise
         except Exception:
-            writer.abort()  # don't orphan staged files on commit failure
+            # any other failure may have happened AFTER the snapshot became
+            # visible (e.g. in mark_committed) — deleting files a snapshot
+            # references would corrupt the table; leave them for the cleaner
             raise
         return [f for ops in files_by_partition.values() for f in ops]
 
@@ -271,13 +278,38 @@ class LakeSoulTable:
                     CommitOp.COMPACTION,
                     read_partition_info=[head],
                 )
-            except Exception:
-                writer.abort()
+            except CommitConflictError:
+                writer.abort()  # compaction lost the race; staged files invisible
                 raise
             for f in old_files:
                 client.store.insert_discard_file(f, self._info.table_path, head.partition_desc)
             count += 1
         return count
+
+    # ---------------------------------------------------------- vector index
+    def build_vector_index(self, column: str, **config_kwargs) -> int:
+        """Train+persist per-(partition, bucket) ANN shards for a vector
+        column (reference: LakeSoulTable.build_vector_index, catalog.py:496).
+        Returns the number of vectors indexed."""
+        from lakesoul_tpu.vector.builder import build_table_vector_index
+
+        return build_table_vector_index(self, column, **config_kwargs)
+
+    def vector_search(
+        self,
+        column: str,
+        query,
+        *,
+        top_k: int = 10,
+        nprobe: int = 8,
+        partitions: dict[str, str] | None = None,
+    ):
+        """ANN search → (pk ids, distances), nearest first."""
+        from lakesoul_tpu.vector.builder import search_table_vector_index
+
+        return search_table_vector_index(
+            self, column, query, top_k=top_k, nprobe=nprobe, partitions=partitions
+        )
 
     # ------------------------------------------------------------------ scan
     def scan(self) -> "LakeSoulScan":
@@ -304,6 +336,7 @@ class LakeSoulScan:
         self._snapshot_ts: int | None = None
         self._incremental: tuple[int, int | None] | None = None
         self._keep_cdc_deletes = False
+        self._vector_search: tuple | None = None
 
     def _replace(self, **kw) -> "LakeSoulScan":
         s = copy.copy(self)
@@ -351,8 +384,38 @@ class LakeSoulScan:
         """Keep CDC delete rows (needed by incremental CDC consumers)."""
         return self._replace(_keep_cdc_deletes=True)
 
+    def vector_search(self, column: str, query, *, top_k: int = 10, nprobe: int = 8) -> "LakeSoulScan":
+        """ANN-filtered scan: search the table's index shards and inject a
+        ``pk IN (matched ids)`` filter, so the scan returns the matching rows
+        through the normal MOR path (reference:
+        inject_vector_search_filter, reader.rs:250-344).
+
+        Lazy like every other builder method: the search executes at read
+        time, so partition filters chained before OR after this call narrow
+        which shards are searched."""
+        return self._replace(_vector_search=(column, query, int(top_k), int(nprobe)))
+
+    def _resolve_vector_search(self) -> "LakeSoulScan":
+        if self._vector_search is None:
+            return self
+        if self._snapshot_ts is not None or self._incremental is not None:
+            raise ConfigError(
+                "vector_search cannot be combined with snapshot/incremental scans:"
+                " index shards always reflect the latest table state"
+            )
+        column, query, top_k, nprobe = self._vector_search
+        ids, _ = self._table.vector_search(
+            column, query, top_k=top_k, nprobe=nprobe,
+            partitions=self._partitions or None,
+        )
+        pk = self._table.info.primary_keys[0]
+        resolved = self._replace(_vector_search=None)
+        return resolved.filter(Filter(op="in", col=pk, value=[int(i) for i in ids]))
+
     # ------------------------------------------------------------------ plan
     def scan_plan(self) -> list[ScanPlanPartition]:
+        if self._vector_search is not None:
+            return self._resolve_vector_search().scan_plan()
         client = self._table.catalog.client
         info = self._table.info
         if self._incremental is not None:
@@ -415,6 +478,8 @@ class LakeSoulScan:
         )
 
     def to_arrow(self) -> pa.Table:
+        if self._vector_search is not None:
+            return self._resolve_vector_search().to_arrow()
         tables = []
         for unit in self.scan_plan():
             t = read_scan_unit(unit.data_files, unit.primary_keys, **self._unit_kwargs(unit))
@@ -428,6 +493,9 @@ class LakeSoulScan:
         return pa.concat_tables(tables, promote_options="default").combine_chunks()
 
     def to_batches(self) -> Iterator[pa.RecordBatch]:
+        if self._vector_search is not None:
+            yield from self._resolve_vector_search().to_batches()
+            return
         for unit in self.scan_plan():
             yield from iter_scan_unit_batches(
                 unit.data_files,

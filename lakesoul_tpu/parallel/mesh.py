@@ -47,14 +47,14 @@ class MeshPlan:
 
 
 def _factor(n: int) -> tuple[int, int, int]:
-    """Split n devices into (dp, tp, sp), preferring dp ≥ tp ≥ sp, powers
-    of the prime factorization of n."""
+    """Split n devices into (dp, tp, sp) with dp ≥ 2 preserved: data
+    parallelism is the default axis for a data-loading framework, so tp/sp
+    only peel a factor of 2 each while at least dp=2 remains."""
     dp, tp, sp = n, 1, 1
-    # peel a factor of 2 for tp, then for sp, when available
-    if dp % 2 == 0 and dp > 1:
+    if dp % 2 == 0 and dp >= 4:
         dp //= 2
         tp = 2
-    if dp % 2 == 0 and dp > 1:
+    if dp % 2 == 0 and dp >= 4:
         dp //= 2
         sp = 2
     return dp, tp, sp

@@ -1,0 +1,4 @@
+from lakesoul_tpu.vector.config import VectorIndexConfig
+from lakesoul_tpu.vector.index import IvfRabitqIndex, SearchParams
+
+__all__ = ["VectorIndexConfig", "IvfRabitqIndex", "SearchParams"]

@@ -1,0 +1,308 @@
+"""On-chip ANN scan kernels (Pallas TPU + jnp fallback).
+
+The reference's compute-kernel layer is AVX-512 bit packing + FastScan LUTs
+(rust/lakesoul-vector/src/rabitq/simd.rs, fastscan.rs).  On TPU the same
+work is reshaped for the MXU/VPU:
+
+- ``packed_scan``: uint8-packed sign codes stay packed in HBM; each grid step
+  DMAs a (TILE, D/8) block into VMEM, unpacks with vectorized shift-and-mask
+  (VPU), and computes the code·query dot as a (TILE, D) x (D, 1) MXU matvec,
+  fused with the RaBitQ affine correction into estimated distances.
+- ``bruteforce_topk``: tiled exact-L2 scan (MXU matmul) + top-k.
+
+Both have pure-jnp fallbacks (used on CPU and for differential testing);
+``pallas=`` auto-detects the platform.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------------------
+# packed RaBitQ scan
+# --------------------------------------------------------------------------
+
+
+def _packed_scan_kernel(q_ref, codes_ref, norms_ref, factors_ref, out_ref, *, d: int):
+    """One tile: codes [T, d/8] uint8 → estimated squared distances [T].
+
+    Mosaic-friendly unpack: no 3D reshapes — 8 shift-planes, each a 2D
+    (T, d8) x (d8, 1) MXU matvec against the byte-strided query layout
+    q_ref [8, d8] where q_ref[j, p] = q[8p + j] (bit j of byte p, MSB-first)."""
+    packed = codes_ref[:].astype(jnp.int32)  # [T, d8]
+    planes = jnp.concatenate(
+        [((packed >> (7 - j)) & 1).astype(jnp.float32) for j in range(8)], axis=1
+    )  # [T, 8*d8]: bit-plane j of byte p at column j*d8 + p
+    q_flat = q_ref[:]  # [1, 8*d8] pre-laid-out on host in plane-concat order
+    bq = jnp.dot(planes, q_flat.T, preferred_element_type=jnp.float32)  # [T, 1] MXU
+    qsum = jnp.sum(q_flat)
+    qsq = jnp.sum(q_flat * q_flat)
+    dot_obar_q = (2.0 * bq[:, 0] - qsum) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    norms = norms_ref[0, :]
+    factors = factors_ref[0, :]
+    est_rq = norms * dot_obar_q / factors
+    out_ref[0, :] = norms * norms + qsq - 2.0 * est_rq
+
+
+@functools.partial(jax.jit, static_argnames=("d", "tile"))
+def packed_scan_pallas(packed_codes, norms, factors, q_rot, *, d: int, tile: int = 512):
+    """Pallas packed-code scan over one cluster: returns estimated sq-dists [N]."""
+    n, d8 = packed_codes.shape
+    n_pad = ((n + tile - 1) // tile) * tile
+    if n_pad != n:
+        packed_codes = jnp.pad(packed_codes, ((0, n_pad - n), (0, 0)))
+        norms = jnp.pad(norms, (0, n_pad - n))
+        factors = jnp.pad(factors, (0, n_pad - n), constant_values=1.0)
+    # plane-concat query layout: q_r[0, j*d8 + p] = q[8p + j] (bit j, byte p),
+    # flattened on the host so the kernel needs no shape casts
+    q_pad = jnp.pad(q_rot.astype(jnp.float32), (0, d8 * 8 - q_rot.shape[0]))
+    q_r = q_pad.reshape(d8, 8).T.reshape(1, d8 * 8)
+    grid = (n_pad // tile,)
+    out = pl.pallas_call(
+        functools.partial(_packed_scan_kernel, d=d),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, d8 * 8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(q_r, packed_codes, norms.reshape(1, -1), factors.reshape(1, -1))
+    return out[0, :n]
+
+
+def _pow2_bucket(n: int, floor: int = 512) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+def packed_scan(packed_codes, norms, factors, q_rot, *, d: int, pallas: bool | None = None):
+    """Estimated sq-distances for one cluster's packed codes (auto backend).
+
+    Cluster sizes are padded to power-of-2 buckets so repeated searches over
+    many differently-sized clusters share compiled kernels instead of
+    triggering a fresh XLA/Mosaic compile per shape."""
+    from lakesoul_tpu.vector.rabitq import estimate_distances
+
+    n = len(packed_codes)
+    if n == 0:
+        return jnp.zeros(0, jnp.float32)
+    n_pad = _pow2_bucket(n)
+    if n_pad != n:
+        packed_codes = np.pad(np.asarray(packed_codes), ((0, n_pad - n), (0, 0)))
+        norms = np.pad(np.asarray(norms), (0, n_pad - n))
+        factors = np.pad(np.asarray(factors), (0, n_pad - n), constant_values=1.0)
+
+    use_pallas = _on_tpu() if pallas is None else pallas
+    if use_pallas:
+        out = packed_scan_pallas(
+            jnp.asarray(packed_codes), jnp.asarray(norms), jnp.asarray(factors),
+            jnp.asarray(q_rot), d=d,
+        )
+    else:
+        out = estimate_distances(
+            jnp.asarray(packed_codes), jnp.asarray(norms), jnp.asarray(factors),
+            jnp.asarray(q_rot), d=d,
+        )
+    # slice on the host: an eager on-device slice would compile per shape
+    return np.asarray(out)[:n]
+
+
+def _packed_dot_kernel(q_ref, codes_ref, out_ref):
+    """bits·Q for one tile (same Mosaic-friendly plane-concat trick as the
+    full scan kernel)."""
+    packed = codes_ref[:].astype(jnp.int32)
+    planes = jnp.concatenate(
+        [((packed >> (7 - j)) & 1).astype(jnp.float32) for j in range(8)], axis=1
+    )
+    bq = jnp.dot(planes, q_ref[:].T, preferred_element_type=jnp.float32)
+    out_ref[0, :] = bq[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def packed_dot_pallas(packed_codes, q_rot, *, tile: int = 512):
+    """bits·Q over [N, d8] packed codes → [N] f32 (Pallas TPU)."""
+    n, d8 = packed_codes.shape
+    n_pad = ((n + tile - 1) // tile) * tile
+    if n_pad != n:
+        packed_codes = jnp.pad(packed_codes, ((0, n_pad - n), (0, 0)))
+    q_pad = jnp.pad(q_rot.astype(jnp.float32), (0, d8 * 8 - q_rot.shape[0]))
+    q_r = q_pad.reshape(d8, 8).T.reshape(1, d8 * 8)
+    out = pl.pallas_call(
+        _packed_dot_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((1, d8 * 8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(q_r, packed_codes)
+    return out[0, :n]
+
+
+@jax.jit
+def _packed_dot_jnp(packed_codes, q_rot):
+    from lakesoul_tpu.vector.rabitq import unpack_bits_jnp
+
+    bits = unpack_bits_jnp(packed_codes, q_rot.shape[0])
+    return bits @ q_rot
+
+
+@functools.partial(jax.jit, static_argnames=("d", "s", "k", "use_pallas", "do_rerank"))
+def _fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, query,
+                  *, d, s, k, use_pallas, do_rerank):
+    """One device call per query over the concatenated probe set.
+
+    Estimator in the *global* query frame (rows may come from different
+    clusters): with Q = P(query), xc = P(c) - Q per row's cluster,
+        dist² ≈ ||r||² + ||xc||² + 2·||r||·<o_bar, xc>/factor
+        <o_bar, xc> = (2·(code_dot_c - bits·Q) - csum) / √D
+    so the only O(N·D) work is ONE bits·Q MXU scan; csq=||xc||², csum=Σxc
+    are per-row scalars precomputed on the host.  Then top-S shortlist →
+    on-device gather + exact re-rank → top-k; single [k] readback."""
+    bq = (
+        packed_dot_pallas(codes, q_glob)
+        if use_pallas
+        else _packed_dot_jnp(codes, q_glob)
+    )
+    dot_obar_xc = (2.0 * (code_dot_c - bq) - csum) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    est = norms * norms + csq + 2.0 * norms * dot_obar_xc / factors
+    if not do_rerank:
+        neg, idx = jax.lax.top_k(-est, k)
+        return -neg, idx
+    neg_s, idx_s = jax.lax.top_k(-est, s)
+    sub = raw[idx_s]  # on-device gather of shortlisted raw vectors
+    q = query.astype(jnp.float32)
+    exact = jnp.sum(sub * sub, axis=1) - 2.0 * (sub @ q) + jnp.sum(q * q)
+    neg, order = jax.lax.top_k(-exact, k)
+    return -neg, idx_s[order]
+
+
+def fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, query,
+                 *, d, top_k, shortlist, pallas: bool | None = None):
+    """Host wrapper: pow2-pad candidate arrays, run the fused kernel, return
+    (dists, global indices) as numpy — indices >= the true candidate count
+    are pad rows the caller must drop."""
+    n = len(codes)
+    n_pad = _pow2_bucket(n)
+    if n_pad != n:
+        codes = np.pad(np.asarray(codes), ((0, n_pad - n), (0, 0)))
+        # pad rows get a huge norm → huge estimated distance → never selected
+        norms = np.pad(np.asarray(norms), (0, n_pad - n), constant_values=1e9)
+        factors = np.pad(np.asarray(factors), (0, n_pad - n), constant_values=1.0)
+        code_dot_c = np.pad(np.asarray(code_dot_c), (0, n_pad - n))
+        csq = np.pad(np.asarray(csq), (0, n_pad - n))
+        csum = np.pad(np.asarray(csum), (0, n_pad - n))
+        if raw is not None:
+            raw = np.pad(
+                np.asarray(raw), ((0, n_pad - n), (0, 0)), constant_values=1e9
+            )
+    do_rerank = raw is not None
+    s = min(shortlist, n_pad)
+    k = min(top_k, n_pad)
+    use_pallas = _on_tpu() if pallas is None else pallas
+    dists, idx = _fused_search(
+        jnp.asarray(codes),
+        jnp.asarray(np.asarray(norms, np.float32)),
+        jnp.asarray(np.asarray(factors, np.float32)),
+        jnp.asarray(np.asarray(code_dot_c, np.float32)),
+        jnp.asarray(np.asarray(csq, np.float32)),
+        jnp.asarray(np.asarray(csum, np.float32)),
+        jnp.asarray(q_glob, dtype=jnp.float32),
+        jnp.asarray(raw) if do_rerank else jnp.zeros((1, 1), jnp.float32),
+        jnp.asarray(query, dtype=jnp.float32),
+        d=d, s=s, k=k, use_pallas=use_pallas, do_rerank=do_rerank,
+    )
+    return np.asarray(dists), np.asarray(idx)
+
+
+# --------------------------------------------------------------------------
+# brute-force exact scan + top-k
+# --------------------------------------------------------------------------
+
+
+def _bruteforce_kernel(q_ref, x_ref, out_ref):
+    x = x_ref[:]  # [T, D]
+    q = q_ref[:]  # [1, D]
+    dots = jnp.dot(x, q.T, preferred_element_type=jnp.float32)[:, 0]
+    x_sq = jnp.sum(x.astype(jnp.float32) * x.astype(jnp.float32), axis=1)
+    q_sq = jnp.sum(q * q)
+    out_ref[0, :] = x_sq - 2.0 * dots + q_sq
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def bruteforce_distances_pallas(vectors, query, *, tile: int = 512):
+    n, d = vectors.shape
+    n_pad = ((n + tile - 1) // tile) * tile
+    if n_pad != n:
+        vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
+    q2 = query.reshape(1, -1).astype(jnp.float32)
+    out = pl.pallas_call(
+        _bruteforce_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(q2, vectors)
+    return out[0, :n]
+
+
+@jax.jit
+def _bruteforce_jnp(vectors, query):
+    v = vectors.astype(jnp.float32)
+    q = query.astype(jnp.float32)
+    return jnp.sum(v * v, axis=1) - 2.0 * (v @ q) + jnp.sum(q * q)
+
+
+def bruteforce_topk(vectors, query, k: int, *, pallas: bool | None = None):
+    """Exact L2 top-k over [N, D] vectors: returns (dists [k], indices [k]).
+    N is padded to a power-of-2 bucket (pad rows at +inf distance) to keep
+    the compiled-shape count logarithmic."""
+    use_pallas = _on_tpu() if pallas is None else pallas
+    n = len(vectors)
+    k = min(k, n)
+    n_pad = _pow2_bucket(n, floor=max(512, k))
+    v = np.asarray(vectors, dtype=np.float32)
+    if n_pad != n:
+        v = np.pad(v, ((0, n_pad - n), (0, 0)), constant_values=np.float32(1e18))
+    v = jnp.asarray(v)
+    q = jnp.asarray(query)
+    if use_pallas:
+        return _topk_pallas(v, q, k=k)
+    return _topk_jnp(v, q, k=k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_pallas(v, q, *, k: int):
+    dists = bruteforce_distances_pallas(v, q)
+    neg, idx = jax.lax.top_k(-dists, k)
+    return -neg, idx
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_jnp(v, q, *, k: int):
+    dists = _bruteforce_jnp(v, q)
+    neg, idx = jax.lax.top_k(-dists, k)
+    return -neg, idx

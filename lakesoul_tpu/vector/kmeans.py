@@ -1,0 +1,53 @@
+"""k-means clustering in JAX (Lloyd's iterations as one jitted scan).
+
+Replaces the reference's CPU kmeans (rust/lakesoul-vector/src/rabitq/kmeans.rs)
+with an MXU formulation: the assignment step is a single (N, D) x (D, K)
+matmul; the update step is a segment-sum via one-hot matmul — both map
+straight onto the systolic array.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def _kmeans_jit(data: jax.Array, init_idx: jax.Array, *, k: int, iters: int):
+    x = data.astype(jnp.float32)
+    n, d = x.shape
+    centroids = x[init_idx]  # [K, D]
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # [N, 1]
+
+    def step(carry, _):
+        centroids = carry
+        c_sq = jnp.sum(centroids * centroids, axis=1)  # [K]
+        # ||x - c||^2 = ||x||^2 - 2 x.c + ||c||^2 ; argmin over K
+        dots = x @ centroids.T  # [N, K] on the MXU
+        assign = jnp.argmin(x_sq - 2.0 * dots + c_sq[None, :], axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32)  # [N, K]
+        sums = onehot.T @ x  # [K, D]
+        counts = jnp.sum(onehot, axis=0)[:, None]  # [K, 1]
+        new_centroids = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), centroids)
+        return new_centroids, None
+
+    centroids, _ = jax.lax.scan(step, centroids, None, length=iters)
+    c_sq = jnp.sum(centroids * centroids, axis=1)
+    assign = jnp.argmin(x_sq - 2.0 * (x @ centroids.T) + c_sq[None, :], axis=1)
+    return centroids, assign
+
+
+def kmeans(data: np.ndarray, k: int, *, iters: int = 10, seed: int = 42):
+    """Returns (centroids [K, D] f32, assignments [N] i32)."""
+    n = len(data)
+    rng = np.random.default_rng(seed)
+    k_eff = min(k, n)
+    init_idx = jnp.asarray(rng.choice(n, size=k_eff, replace=False))
+    if k_eff < k:
+        # degenerate tiny input: pad by repeating points
+        init_idx = jnp.concatenate([init_idx, init_idx[np.zeros(k - k_eff, dtype=int)]])
+    centroids, assign = _kmeans_jit(jnp.asarray(data), init_idx, k=k, iters=iters)
+    return np.asarray(centroids), np.asarray(assign).astype(np.int32)

@@ -1,0 +1,140 @@
+"""Versioned vector-index persistence on the object store.
+
+Layout parity with ManifestStore (rust/lakesoul-vector/src/rabitq/
+manifest.rs:38): a ``LATEST`` pointer → ``manifests/manifest-<gen>-<ver>.json``
+→ ``cluster_<c>[.delta_<i>].seg`` segment files, every blob CRC32-checked.
+Segments are npz blobs (codes/norms/factors/ids[/raw]) — host-side IO only,
+the chip never touches manifests."""
+
+from __future__ import annotations
+
+import io
+import json
+import zlib
+
+import numpy as np
+
+from lakesoul_tpu.errors import VectorIndexError
+from lakesoul_tpu.io.object_store import ensure_dir, filesystem_for
+from lakesoul_tpu.vector.config import VectorIndexConfig
+from lakesoul_tpu.vector.index import IvfRabitqIndex, _Cluster
+
+LATEST = "LATEST"
+
+
+def _crc_wrap(payload: bytes) -> bytes:
+    return zlib.crc32(payload).to_bytes(4, "big") + payload
+
+
+def _crc_unwrap(blob: bytes, what: str) -> bytes:
+    if len(blob) < 4:
+        raise VectorIndexError(f"corrupt {what}: too short")
+    crc, payload = int.from_bytes(blob[:4], "big"), blob[4:]
+    if zlib.crc32(payload) != crc:
+        raise VectorIndexError(f"corrupt {what}: CRC mismatch")
+    return payload
+
+
+class ManifestStore:
+    def __init__(self, root: str, storage_options: dict | None = None):
+        self.root = root.rstrip("/")
+        self.storage_options = storage_options or {}
+        self.fs, self.root_path = filesystem_for(self.root, self.storage_options)
+
+    # ------------------------------------------------------------------ write
+    def write_index(self, index: IvfRabitqIndex, *, generation: int | None = None) -> int:
+        ensure_dir(f"{self.root}/manifests", self.storage_options)
+        ensure_dir(f"{self.root}/segments", self.storage_options)
+        if generation is None:
+            generation = self.latest_generation() + 1
+
+        seg_names: dict[str, list[str]] = {"base": [], "delta": []}
+        for c, cluster in enumerate(index.clusters):
+            name = f"segments/cluster_{c}.gen{generation}.seg"
+            self._write_segment(name, cluster)
+            seg_names["base"].append(name)
+        delta_entries = []
+        for c, deltas in enumerate(index.deltas):
+            for i, seg in enumerate(deltas):
+                name = f"segments/cluster_{c}.gen{generation}.delta_{i}.seg"
+                self._write_segment(name, seg)
+                delta_entries.append({"cluster": c, "path": name})
+
+        manifest = {
+            "generation": generation,
+            "config": index.config.encode(),
+            "keep_raw": index.keep_raw,
+            "num_vectors": index.num_vectors,
+            "centroids": index.centroids.tolist() if index.centroids is not None else None,
+            "base_segments": seg_names["base"],
+            "delta_segments": delta_entries,
+        }
+        mpath = f"manifests/manifest-{generation}.json"
+        self._write_blob(mpath, _crc_wrap(json.dumps(manifest).encode()))
+        self._write_blob(LATEST, _crc_wrap(mpath.encode()))
+        return generation
+
+    def _write_segment(self, name: str, cluster: _Cluster) -> None:
+        buf = io.BytesIO()
+        arrays = {
+            "codes": cluster.codes,
+            "norms": cluster.norms,
+            "factors": cluster.factors,
+            "ids": cluster.ids,
+        }
+        if cluster.code_dot_c is not None:
+            arrays["code_dot_c"] = cluster.code_dot_c
+        if cluster.raw is not None:
+            arrays["raw"] = cluster.raw
+        np.savez(buf, **arrays)
+        self._write_blob(name, _crc_wrap(buf.getvalue()))
+
+    def _write_blob(self, rel: str, data: bytes) -> None:
+        with self.fs.open(f"{self.root_path}/{rel}", "wb") as f:
+            f.write(data)
+
+    def _read_blob(self, rel: str) -> bytes:
+        with self.fs.open(f"{self.root_path}/{rel}", "rb") as f:
+            return f.read()
+
+    # ------------------------------------------------------------------- read
+    def latest_generation(self) -> int:
+        try:
+            mpath = _crc_unwrap(self._read_blob(LATEST), "LATEST").decode()
+        except FileNotFoundError:
+            return 0
+        return int(mpath.rsplit("-", 1)[-1].split(".")[0])
+
+    def exists(self) -> bool:
+        return self.fs.exists(f"{self.root_path}/{LATEST}")
+
+    def read_latest(self) -> IvfRabitqIndex:
+        mpath = _crc_unwrap(self._read_blob(LATEST), "LATEST").decode()
+        manifest = json.loads(_crc_unwrap(self._read_blob(mpath), mpath))
+        config = VectorIndexConfig.parse(manifest["config"])
+        index = IvfRabitqIndex(config)
+        index.keep_raw = manifest["keep_raw"]
+        index.centroids = (
+            np.asarray(manifest["centroids"], dtype=np.float32)
+            if manifest["centroids"] is not None
+            else None
+        )
+        index.clusters = [
+            self._read_segment(p) for p in manifest["base_segments"]
+        ]
+        index.deltas = [[] for _ in index.clusters]
+        for entry in manifest["delta_segments"]:
+            index.deltas[entry["cluster"]].append(self._read_segment(entry["path"]))
+        return index
+
+    def _read_segment(self, rel: str) -> _Cluster:
+        payload = _crc_unwrap(self._read_blob(rel), rel)
+        z = np.load(io.BytesIO(payload))
+        return _Cluster(
+            codes=z["codes"],
+            norms=z["norms"],
+            factors=z["factors"],
+            ids=z["ids"],
+            code_dot_c=z["code_dot_c"] if "code_dot_c" in z.files else None,
+            raw=z["raw"] if "raw" in z.files else None,
+        )

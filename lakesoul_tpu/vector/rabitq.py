@@ -1,0 +1,166 @@
+"""RaBitQ quantization: rotation + 1-bit sign codes + unbiased distance
+estimation factors.
+
+Math (capability parity with rust/lakesoul-vector/src/rabitq/quantizer.rs,
+redesigned for TPU layouts — the reference's AVX-512 bit tricks don't
+transfer, see SURVEY.md §7):
+
+For a vector v in cluster c:  r = P(v - c)  (P = random rotation)
+  norm      = ||r||
+  b         = sign(r) ∈ {-1,+1}^D,  stored packed (D/8 uint8, MSB-first)
+  o_bar     = b / √D  (the quantized unit vector)
+  factor    = <o_bar, r/||r||>  (quantization quality of this vector)
+
+At query time with rotated residual q = P(query - c):
+  <r, q> ≈ norm * <o_bar, q> / factor
+  ||v - query||² = norm² + ||q||² - 2<r, q>
+
+<o_bar, q> reduces to a ±1 dot, computed on the MXU from unpacked codes:
+  b·q = 2·(bits·q) - sum(q)   with bits ∈ {0,1}.
+
+Rotations: "fht" = fast Hadamard transform with random sign flips (FhtKac,
+reference rotation.rs) — O(D log D), jittable; "matrix" = dense random
+orthonormal matrix (one (D, D) MXU matmul); "identity" for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lakesoul_tpu.errors import VectorIndexError
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class Rotator:
+    """Orthonormal rotation P applied to (possibly zero-padded) vectors.
+
+    Computed in numpy on the host: rotations are O(D log D) per vector and run
+    once per build/query, while eager per-shape XLA dispatch would trigger a
+    fresh TPU compile for every distinct cluster size — the scans (the actual
+    FLOPs) stay on-chip."""
+
+    def __init__(self, dim: int, kind: str = "fht", seed: int = 42, rounds: int = 3):
+        self.dim = dim
+        self.kind = kind
+        self.padded_dim = next_pow2(dim) if kind == "fht" else dim
+        rng = np.random.default_rng(seed)
+        if kind == "fht":
+            # FhtKac: alternating random-sign flips and Hadamard transforms
+            self.signs = rng.choice([-1.0, 1.0], size=(rounds, self.padded_dim)).astype(
+                np.float32
+            )
+        elif kind == "matrix":
+            a = rng.normal(size=(dim, dim)).astype(np.float32)
+            q, _ = np.linalg.qr(a)
+            self.matrix = q.astype(np.float32)
+        elif kind == "identity":
+            pass
+        else:
+            raise VectorIndexError(f"unknown rotator {kind}")
+
+    def __call__(self, x) -> np.ndarray:
+        """x [..., dim] → rotated [..., padded_dim] (numpy)."""
+        x = np.asarray(x, dtype=np.float32)
+        if self.kind == "identity":
+            return x
+        if self.kind == "matrix":
+            return x @ self.matrix
+        pad = self.padded_dim - self.dim
+        if pad:
+            x = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+        scale = np.float32(1.0 / np.sqrt(self.padded_dim))
+        for r in range(self.signs.shape[0]):
+            x = x * self.signs[r]
+            x = _fht(x) * scale
+        return x
+
+
+def _fht(x: np.ndarray) -> np.ndarray:
+    """Fast Hadamard transform along the last axis (power-of-two length)."""
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    h = 1
+    x = x.copy()
+    while h < d:
+        x = x.reshape(lead + (d // (2 * h), 2, h))
+        a = x[..., 0, :].copy()
+        b = x[..., 1, :].copy()
+        x[..., 0, :] = a + b
+        x[..., 1, :] = a - b
+        x = x.reshape(lead + (d,))
+        h *= 2
+    return x
+
+
+def pack_bits(bits: np.ndarray) -> np.ndarray:
+    """[N, D] {0,1} → [N, D/8] uint8 (D padded to a byte multiple)."""
+    return np.packbits(bits.astype(np.uint8), axis=-1)
+
+
+def unpack_bits_jnp(packed: jax.Array, d: int) -> jax.Array:
+    """[N, D/8] uint8 → [N, D] {0,1} float32, vectorized shift-and-mask
+    (the TPU-native replacement of the AVX-512 unpack, simd.rs:229-290)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)  # MSB-first like np.packbits
+    bits = (packed[..., :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    return bits.reshape(packed.shape[0], -1)[:, :d].astype(jnp.float32)
+
+
+class RabitqQuantizer:
+    """Quantize cluster residuals → packed codes + per-vector factors."""
+
+    def __init__(self, dim: int, *, rotator: str = "fht", seed: int = 42):
+        self.dim = dim
+        self.rotator = Rotator(dim, rotator, seed)
+        self.padded_dim = self.rotator.padded_dim
+
+    def quantize(self, vectors: np.ndarray, centroid: np.ndarray):
+        """vectors [N, dim], centroid [dim] →
+        (codes [N, padded/8] uint8, norms [N] f32, factors [N] f32,
+         code_dot_c [N] f32).
+
+        ``code_dot_c`` = bits · P(centroid), precomputed so multi-cluster
+        searches can use ONE globally-rotated query:  bits·P(query - c) =
+        bits·P(query) - code_dot_c  (rotation is linear)."""
+        r = self.rotator(vectors - centroid[None, :])
+        norms = np.linalg.norm(r, axis=1)
+        safe = np.maximum(norms, 1e-20)
+        unit = r / safe[:, None]
+        bits = (r > 0).astype(np.uint8)
+        o_bar = (bits * 2.0 - 1.0) / np.sqrt(self.padded_dim)
+        factors = np.sum(o_bar * unit, axis=1).astype(np.float32)
+        # guard: zero/degenerate vectors get factor 1 (estimator returns norm²)
+        factors = np.where(np.abs(factors) < 1e-6, 1.0, factors)
+        c_rot = self.rotator(centroid.astype(np.float32))
+        code_dot_c = (bits.astype(np.float32) @ c_rot).astype(np.float32)
+        return pack_bits(bits), norms.astype(np.float32), factors, code_dot_c
+
+    def rotate(self, x: np.ndarray) -> np.ndarray:
+        return self.rotator(np.asarray(x, dtype=np.float32))
+
+    def rotate_query(self, query: np.ndarray, centroid: np.ndarray) -> np.ndarray:
+        return self.rotator(np.asarray(query - centroid, dtype=np.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("d",))
+def estimate_distances(packed_codes, norms, factors, q_rot, *, d: int):
+    """Estimated squared L2 distances of one cluster's codes to the query.
+
+    packed_codes [N, d/8] uint8, norms/factors [N], q_rot [d] (rotated query
+    residual).  All compute is one (N, d) x (d,) MXU matvec after on-chip
+    unpack."""
+    bits = unpack_bits_jnp(packed_codes, d)  # [N, d]
+    bq = bits @ q_rot  # MXU
+    dot_obar_q = (2.0 * bq - jnp.sum(q_rot)) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    est_rq = norms * dot_obar_q / factors
+    q_sq = jnp.sum(q_rot * q_rot)
+    return norms * norms + q_sq - 2.0 * est_rq

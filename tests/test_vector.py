@@ -1,0 +1,244 @@
+"""Vector index tests: rotation orthogonality, RaBitQ estimation quality,
+kernel differential (pallas-interpret vs jnp), IVF recall, manifests, delta
+inserts, and table-level e2e ANN search."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu.errors import VectorIndexError
+from lakesoul_tpu.vector import IvfRabitqIndex, SearchParams, VectorIndexConfig
+from lakesoul_tpu.vector.kernels import bruteforce_topk, packed_scan
+from lakesoul_tpu.vector.kmeans import kmeans
+from lakesoul_tpu.vector.manifest import ManifestStore
+from lakesoul_tpu.vector.rabitq import RabitqQuantizer, Rotator, pack_bits, unpack_bits_jnp
+
+
+def brute_force_knn(vectors, query, k):
+    d = np.sum((vectors - query[None, :]) ** 2, axis=1)
+    return np.argsort(d)[:k]
+
+
+class TestConfig:
+    def test_parse_round_trip(self):
+        c = VectorIndexConfig.parse("emb:128:32:1:l2:fht:7:true")
+        assert c.column == "emb" and c.dim == 128 and c.nlist == 32
+        assert c.seed == 7 and c.faster is True
+        assert VectorIndexConfig.parse(c.encode()) == c
+
+    def test_parse_multiple_and_errors(self):
+        cs = VectorIndexConfig.parse_multiple("a:8;b:16:4")
+        assert [c.column for c in cs] == ["a", "b"]
+        with pytest.raises(VectorIndexError):
+            VectorIndexConfig.parse("bad")
+        with pytest.raises(VectorIndexError):
+            VectorIndexConfig(column="x", dim=8, total_bits=99)
+
+
+class TestRotation:
+    @pytest.mark.parametrize("kind", ["fht", "matrix"])
+    def test_preserves_norms_and_dots(self, kind):
+        rot = Rotator(48, kind, seed=3)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(10, 48)).astype(np.float32)
+        rx = np.asarray(rot(x))
+        np.testing.assert_allclose(
+            np.linalg.norm(rx, axis=1), np.linalg.norm(x, axis=1), rtol=1e-4
+        )
+        # pairwise inner products preserved (orthonormality)
+        np.testing.assert_allclose(rx @ rx.T, x @ x.T, atol=1e-3)
+
+    def test_bit_pack_round_trip(self):
+        rng = np.random.default_rng(0)
+        bits = (rng.random((5, 64)) > 0.5).astype(np.uint8)
+        packed = pack_bits(bits)
+        un = np.asarray(unpack_bits_jnp(packed, 64))
+        np.testing.assert_array_equal(un, bits.astype(np.float32))
+
+
+class TestEstimator:
+    def test_estimates_correlate_with_true_distances(self):
+        rng = np.random.default_rng(0)
+        dim = 64
+        quant = RabitqQuantizer(dim, rotator="fht", seed=1)
+        vectors = rng.normal(size=(500, dim)).astype(np.float32)
+        centroid = vectors.mean(0)
+        codes, norms, factors, _cdc = quant.quantize(vectors, centroid)
+        query = rng.normal(size=dim).astype(np.float32)
+        q_rot = np.asarray(quant.rotate_query(query, centroid))
+        est = np.asarray(
+            packed_scan(codes, norms, factors, q_rot, d=quant.padded_dim, pallas=False)
+        )
+        true = np.sum((vectors - query[None, :]) ** 2, axis=1)
+        corr = np.corrcoef(est, true)[0, 1]
+        assert corr > 0.85, f"estimator correlation too low: {corr}"
+        # estimates unbiased-ish: mean relative error small
+        rel = np.abs(est - true) / np.maximum(true, 1e-6)
+        assert np.median(rel) < 0.35
+
+    def test_pallas_interpret_matches_jnp(self):
+        import jax
+        from jax.experimental.pallas import tpu as pltpu
+
+        rng = np.random.default_rng(1)
+        dim = 64
+        quant = RabitqQuantizer(dim, rotator="identity", seed=1)
+        vectors = rng.normal(size=(100, dim)).astype(np.float32)
+        centroid = np.zeros(dim, np.float32)
+        codes, norms, factors, _cdc = quant.quantize(vectors, centroid)
+        q_rot = rng.normal(size=dim).astype(np.float32)
+        ref = np.asarray(
+            packed_scan(codes, norms, factors, q_rot, d=dim, pallas=False)
+        )
+        with pltpu.force_tpu_interpret_mode():
+            got = np.asarray(
+                packed_scan(codes, norms, factors, q_rot, d=dim, pallas=True)
+            )
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-3)
+
+
+class TestKmeans:
+    def test_separates_gaussian_blobs(self):
+        rng = np.random.default_rng(0)
+        blobs = np.concatenate(
+            [rng.normal(loc=c * 10, size=(100, 8)) for c in range(4)]
+        ).astype(np.float32)
+        centroids, assign = kmeans(blobs, 4, iters=10)
+        # each blob maps to exactly one cluster
+        for b in range(4):
+            labels = assign[b * 100 : (b + 1) * 100]
+            assert len(np.unique(labels)) == 1
+
+
+class TestIvfIndex:
+    def _make(self, n=2000, dim=32, nlist=16, seed=0, keep_raw=True):
+        rng = np.random.default_rng(seed)
+        vectors = rng.normal(size=(n, dim)).astype(np.float32)
+        ids = np.arange(n, dtype=np.uint64)
+        cfg = VectorIndexConfig(column="emb", dim=dim, nlist=nlist)
+        return IvfRabitqIndex.train(vectors, ids, cfg, keep_raw=keep_raw), vectors, ids
+
+    def test_recall_at_10(self):
+        index, vectors, ids = self._make()
+        rng = np.random.default_rng(42)
+        recalls = []
+        for _ in range(20):
+            q = rng.normal(size=vectors.shape[1]).astype(np.float32)
+            true = set(brute_force_knn(vectors, q, 10))
+            got, _ = index.search(q, SearchParams(top_k=10, nprobe=8))
+            recalls.append(len(true & set(int(i) for i in got)) / 10)
+        assert np.mean(recalls) >= 0.5, f"recall@10 = {np.mean(recalls)}"
+
+    def test_recall_no_rerank_still_useful(self):
+        # 1-bit codes alone on iid Gaussian data (worst case: zero cluster
+        # structure) — far above chance (10/2000) but well below the reranked
+        # path; the reference reaches higher via multi-bit ex-codes, which is
+        # future work (total_bits > 1)
+        index, vectors, _ = self._make(keep_raw=False)
+        rng = np.random.default_rng(1)
+        recalls = []
+        for _ in range(20):
+            q = rng.normal(size=vectors.shape[1]).astype(np.float32)
+            true = set(brute_force_knn(vectors, q, 10))
+            got, _ = index.search(q, SearchParams(top_k=10, nprobe=8))
+            recalls.append(len(true & set(int(i) for i in got)) / 10)
+        assert np.mean(recalls) >= 0.25
+
+    def test_search_filtered(self):
+        index, vectors, ids = self._make()
+        q = vectors[7]
+        allowed = np.asarray([7, 8, 9], dtype=np.uint64)
+        got, dists = index.search_filtered(q, allowed, SearchParams(top_k=3, nprobe=16))
+        assert set(int(i) for i in got) <= {7, 8, 9}
+        assert int(got[0]) == 7  # the vector itself is nearest
+
+    def test_insert_batch_and_merge_deltas(self):
+        index, vectors, _ = self._make(n=500)
+        rng = np.random.default_rng(5)
+        new = rng.normal(size=(100, vectors.shape[1])).astype(np.float32)
+        new_ids = np.arange(10_000, 10_100, dtype=np.uint64)
+        index.insert_batch(new, new_ids)
+        assert index.num_vectors == 600
+        got, _ = index.search(new[3], SearchParams(top_k=1, nprobe=16))
+        assert int(got[0]) == 10_003  # delta segment searched
+        index.merge_deltas()
+        assert index.num_vectors == 600
+        got2, _ = index.search(new[3], SearchParams(top_k=1, nprobe=16))
+        assert int(got2[0]) == 10_003
+
+    def test_batch_search(self):
+        index, vectors, _ = self._make(n=300)
+        ids_list, dists_list = index.batch_search(vectors[:5], SearchParams(top_k=1, nprobe=16))
+        hits = sum(int(ids_list[i][0]) == i for i in range(5))
+        assert hits >= 4
+
+
+class TestManifest:
+    def test_write_read_round_trip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        vectors = rng.normal(size=(200, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="emb", dim=16, nlist=4)
+        index = IvfRabitqIndex.train(vectors, np.arange(200, dtype=np.uint64), cfg)
+        index.insert_batch(vectors[:10] + 0.01, np.arange(900, 910, dtype=np.uint64))
+        store = ManifestStore(str(tmp_path / "idx"))
+        gen = store.write_index(index)
+        assert gen == 1
+        loaded = store.read_latest()
+        assert loaded.num_vectors == index.num_vectors
+        q = vectors[3]
+        got1, _ = index.search(q, SearchParams(top_k=5, nprobe=4))
+        got2, _ = loaded.search(q, SearchParams(top_k=5, nprobe=4))
+        np.testing.assert_array_equal(got1, got2)
+        # second write bumps the generation
+        assert store.write_index(loaded) == 2
+
+    def test_crc_detects_corruption(self, tmp_path):
+        rng = np.random.default_rng(0)
+        cfg = VectorIndexConfig(column="emb", dim=8, nlist=2)
+        index = IvfRabitqIndex.train(
+            rng.normal(size=(50, 8)).astype(np.float32),
+            np.arange(50, dtype=np.uint64),
+            cfg,
+        )
+        store = ManifestStore(str(tmp_path / "idx"))
+        store.write_index(index)
+        latest = tmp_path / "idx" / "LATEST"
+        blob = bytearray(latest.read_bytes())
+        blob[-1] ^= 0xFF
+        latest.write_bytes(bytes(blob))
+        with pytest.raises(VectorIndexError, match="CRC"):
+            store.read_latest()
+
+
+class TestTableIntegration:
+    def test_e2e_build_and_search(self, tmp_warehouse):
+        from lakesoul_tpu import LakeSoulCatalog
+
+        dim = 16
+        schema = pa.schema(
+            [("id", pa.int64()), ("emb", pa.list_(pa.float32(), dim)), ("tag", pa.string())]
+        )
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        t = cat.create_table("vecs", schema, primary_keys=["id"], hash_bucket_num=2)
+        rng = np.random.default_rng(0)
+        n = 600
+        vecs = rng.normal(size=(n, dim)).astype(np.float32)
+        t.write_arrow(
+            pa.table(
+                {
+                    "id": np.arange(n),
+                    "emb": pa.FixedSizeListArray.from_arrays(vecs.reshape(-1), dim),
+                    "tag": ["x"] * n,
+                },
+                schema=schema,
+            )
+        )
+        total = t.build_vector_index("emb", nlist=8)
+        assert total == n
+        q = vecs[123]
+        ids, dists = t.vector_search("emb", q, top_k=5, nprobe=8)
+        assert int(ids[0]) == 123
+        # ANN-filtered scan returns the actual rows through the MOR path
+        rows = t.scan().vector_search("emb", q, top_k=5, nprobe=8).to_arrow()
+        assert 123 in rows.column("id").to_pylist()
+        assert rows.num_rows <= 5
