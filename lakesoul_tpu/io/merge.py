@@ -137,6 +137,13 @@ def merge_sorted_tables(
     if not primary_keys:
         return big
 
+    # fast path: single non-null int64 PK over already-sorted runs (the
+    # writer sorts every PK cell) → native loser-tree merge, no argsort
+    if len(primary_keys) == 1 and not merge_operators:
+        fast = _native_merge_fast_path(big, uniformed, primary_keys[0])
+        if fast is not None:
+            return fast
+
     # sort by PK columns with an explicit row-order tiebreaker: pyarrow's sort
     # is not documented stable, and ties must keep concat order (= file
     # version order) for "last wins" semantics
@@ -223,6 +230,34 @@ def merge_sorted_tables(
             arrays.append(out_columns.get(fld.name, base.column(fld.name)))
         base = pa.table(arrays, schema=base.schema)
     return base
+
+
+def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
+    """C++ loser-tree merge (native/src/lakesoul_native.cc ls_merge_i64) when
+    the key column is int64, null-free, and each input run is sorted.
+    Returns None when preconditions don't hold (caller falls back)."""
+    from lakesoul_tpu import native
+
+    if not native.available():
+        return None
+    col = big.column(pk)
+    # strictly signed int64: uint64 would reinterpret, and INT64_MAX is the
+    # C++ merge's run-exhausted sentinel
+    if not (pa.types.is_signed_integer(col.type) and col.type.bit_width == 64):
+        return None
+    if col.null_count:
+        return None
+    keys = np.asarray(col).astype(np.int64, copy=False)
+    if len(keys) and keys.max() == np.iinfo(np.int64).max:
+        return None
+    lengths = np.array([len(t) for t in uniformed], dtype=np.int64)
+    run_offsets = np.concatenate([[0], np.cumsum(lengths)])
+    for a, b in zip(run_offsets[:-1], run_offsets[1:]):
+        if b - a > 1 and not np.all(keys[a + 1 : b] >= keys[a : b - 1]):
+            return None  # run not sorted; vectorized path handles it
+    order, tail, _groups = native.merge_sorted_runs_i64(keys, run_offsets)
+    last_idx = order[tail]
+    return big.take(pa.array(last_idx))
 
 
 def apply_cdc_filter(table: pa.Table, cdc_column: str) -> pa.Table:

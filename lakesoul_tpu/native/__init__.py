@@ -1,0 +1,163 @@
+"""Native C++ core loader.
+
+Builds (once, cached) and loads ``liblakesoul_native.so`` via ctypes; every
+consumer has a pure-numpy fallback, so the package works without a compiler
+(set ``LAKESOUL_TPU_DISABLE_NATIVE=1`` to force fallbacks)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "lakesoul_native.cc")
+_LIB_PATH = os.path.join(_HERE, "liblakesoul_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             _SRC, "-o", _LIB_PATH],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def _bind(lib) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ls_hash_i32.argtypes = [i32p, u8p, u32p, ctypes.c_int64, u32p, ctypes.c_uint32]
+    lib.ls_hash_i64.argtypes = [i64p, u8p, u32p, ctypes.c_int64, u32p, ctypes.c_uint32]
+    lib.ls_hash_bytes32.argtypes = [u8p, i32p, u8p, u32p, ctypes.c_int64, u32p, ctypes.c_uint32]
+    lib.ls_hash_bytes64.argtypes = [u8p, i64p, u8p, u32p, ctypes.c_int64, u32p, ctypes.c_uint32]
+    lib.ls_bucket_ids.argtypes = [u32p, i64p, ctypes.c_int64, ctypes.c_uint32]
+    lib.ls_merge_i64.argtypes = [i64p, i64p, ctypes.c_int32, i64p, u8p]
+    lib.ls_merge_i64.restype = ctypes.c_int64
+    lib.ls_pack_bits.argtypes = [u8p, u8p, ctypes.c_int64, ctypes.c_int64]
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried or os.environ.get("LAKESOUL_TPU_DISABLE_NATIVE") == "1":
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        have_src = os.path.exists(_SRC)
+        stale = (
+            not os.path.exists(_LIB_PATH)
+            or (have_src and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+        )
+        if stale:
+            if not have_src or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _bind(lib)
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def hash_i64(vals: np.ndarray, seeds: np.ndarray | None, valid: np.ndarray | None,
+             out: np.ndarray, seed: int) -> None:
+    lib = get_lib()
+    lib.ls_hash_i64(
+        _ptr(np.ascontiguousarray(vals, np.int64), ctypes.c_int64),
+        _ptr(valid, ctypes.c_uint8) if valid is not None else None,
+        _ptr(out, ctypes.c_uint32),
+        len(vals),
+        _ptr(seeds, ctypes.c_uint32) if seeds is not None else None,
+        seed,
+    )
+
+
+def hash_i32(vals: np.ndarray, seeds: np.ndarray | None, valid: np.ndarray | None,
+             out: np.ndarray, seed: int) -> None:
+    lib = get_lib()
+    lib.ls_hash_i32(
+        _ptr(np.ascontiguousarray(vals, np.int32), ctypes.c_int32),
+        _ptr(valid, ctypes.c_uint8) if valid is not None else None,
+        _ptr(out, ctypes.c_uint32),
+        len(vals),
+        _ptr(seeds, ctypes.c_uint32) if seeds is not None else None,
+        seed,
+    )
+
+
+def hash_string_array(data: np.ndarray, offsets: np.ndarray, seeds: np.ndarray | None,
+                      valid: np.ndarray | None, out: np.ndarray, seed: int) -> None:
+    """Arrow string layout: data uint8 buffer + offsets (i32 or i64)."""
+    lib = get_lib()
+    n = len(offsets) - 1
+    if offsets.dtype == np.int32:
+        lib.ls_hash_bytes32(
+            _ptr(data, ctypes.c_uint8),
+            _ptr(offsets, ctypes.c_int32),
+            _ptr(valid, ctypes.c_uint8) if valid is not None else None,
+            _ptr(out, ctypes.c_uint32), n,
+            _ptr(seeds, ctypes.c_uint32) if seeds is not None else None, seed,
+        )
+    else:
+        lib.ls_hash_bytes64(
+            _ptr(data, ctypes.c_uint8),
+            _ptr(np.ascontiguousarray(offsets, np.int64), ctypes.c_int64),
+            _ptr(valid, ctypes.c_uint8) if valid is not None else None,
+            _ptr(out, ctypes.c_uint32), n,
+            _ptr(seeds, ctypes.c_uint32) if seeds is not None else None, seed,
+        )
+
+
+def merge_sorted_runs_i64(keys: np.ndarray, run_offsets: np.ndarray):
+    """Loser-tree merge of k sorted int64 runs → (order, group_tail, n_groups)."""
+    lib = get_lib()
+    n = int(run_offsets[-1])
+    order = np.empty(n, dtype=np.int64)
+    tail = np.empty(n, dtype=np.uint8)
+    groups = lib.ls_merge_i64(
+        _ptr(np.ascontiguousarray(keys, np.int64), ctypes.c_int64),
+        _ptr(np.ascontiguousarray(run_offsets, np.int64), ctypes.c_int64),
+        len(run_offsets) - 1,
+        _ptr(order, ctypes.c_int64),
+        _ptr(tail, ctypes.c_uint8),
+    )
+    return order, tail.astype(bool), int(groups)
+
+
+def pack_bits(bits: np.ndarray) -> np.ndarray:
+    lib = get_lib()
+    n, d = bits.shape
+    out = np.empty((n, (d + 7) // 8), dtype=np.uint8)
+    lib.ls_pack_bits(
+        _ptr(np.ascontiguousarray(bits, np.uint8), ctypes.c_uint8),
+        _ptr(out, ctypes.c_uint8), n, d,
+    )
+    return out

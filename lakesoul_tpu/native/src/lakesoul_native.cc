@@ -1,0 +1,204 @@
+// lakesoul_tpu native core: host-side hot loops.
+//
+// The reference implements these in Rust (rust/lakesoul-io/src/utils/hash,
+// physical_plan/merge/sorted/v2/loser_tree_merger.rs, lakesoul-vector simd.rs);
+// here the same roles are C++ with a plain C ABI consumed via ctypes:
+//   - Spark-compatible Murmur3 (seed 42) batch hashing for fixed-width and
+//     Arrow-layout string columns (bucket assignment hot path)
+//   - loser-tree k-way merge over sorted int64 runs (merge-on-read hot path:
+//     emits the merged take-order and group-tail flags in one pass)
+//   - RaBitQ sign-bit packing
+//
+// Everything is pure functions over caller-owned buffers: no allocation, no
+// global state, trivially thread-safe.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- murmur3
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k(uint32_t k) {
+  k *= 0xcc9e2d51u;
+  k = rotl32(k, 15);
+  k *= 0x1b873593u;
+  return k;
+}
+
+static inline uint32_t mix_h(uint32_t h, uint32_t k) {
+  h ^= mix_k(k);
+  h = rotl32(h, 13);
+  return h * 5u + 0xe6546b64u;
+}
+
+static inline uint32_t fmix(uint32_t h, uint32_t len) {
+  h ^= len;
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// Spark variant: whole 4-byte LE words, then each tail byte as its own block.
+static inline uint32_t murmur3_bytes(const uint8_t* data, int64_t len,
+                                     uint32_t seed) {
+  uint32_t h = seed;
+  int64_t nblocks = len / 4;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);
+    h = mix_h(h, k);
+  }
+  for (int64_t i = nblocks * 4; i < len; i++) {
+    h = mix_h(h, (uint32_t)data[i]);
+  }
+  return fmix(h, (uint32_t)len);
+}
+
+// hash ≤32-bit ints (sign-extended to u32, one block).  valid==nullptr means
+// no nulls; null rows keep their incoming out[] value (reference semantics).
+void ls_hash_i32(const int32_t* vals, const uint8_t* valid, uint32_t* out,
+                 int64_t n, const uint32_t* seeds, uint32_t seed) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    uint32_t s = seeds ? seeds[i] : seed;
+    uint32_t h = mix_h(s, (uint32_t)vals[i]);
+    out[i] = fmix(h, 4);
+  }
+}
+
+void ls_hash_i64(const int64_t* vals, const uint8_t* valid, uint32_t* out,
+                 int64_t n, const uint32_t* seeds, uint32_t seed) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    uint32_t s = seeds ? seeds[i] : seed;
+    uint64_t v = (uint64_t)vals[i];
+    uint32_t h = mix_h(s, (uint32_t)(v & 0xffffffffu));
+    h = mix_h(h, (uint32_t)(v >> 32));
+    out[i] = fmix(h, 8);
+  }
+}
+
+// Arrow string/binary layout: int32 offsets [n+1] + contiguous data buffer.
+void ls_hash_bytes32(const uint8_t* data, const int32_t* offsets,
+                     const uint8_t* valid, uint32_t* out, int64_t n,
+                     const uint32_t* seeds, uint32_t seed) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    uint32_t s = seeds ? seeds[i] : seed;
+    out[i] = murmur3_bytes(data + offsets[i], offsets[i + 1] - offsets[i], s);
+  }
+}
+
+void ls_hash_bytes64(const uint8_t* data, const int64_t* offsets,
+                     const uint8_t* valid, uint32_t* out, int64_t n,
+                     const uint32_t* seeds, uint32_t seed) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    uint32_t s = seeds ? seeds[i] : seed;
+    out[i] = murmur3_bytes(data + offsets[i], offsets[i + 1] - offsets[i], s);
+  }
+}
+
+void ls_bucket_ids(const uint32_t* hashes, int64_t* out, int64_t n,
+                   uint32_t num_buckets) {
+  for (int64_t i = 0; i < n; i++) out[i] = (int64_t)(hashes[i] % num_buckets);
+}
+
+// ------------------------------------------------------------ loser tree
+// Merge k sorted int64 runs (concatenated in `keys`, run r spans
+// [run_offsets[r], run_offsets[r+1])) into ascending order; ties broken by
+// run index (later run = newer version last).  Outputs:
+//   order[n]       global row indices in merged order
+//   group_tail[n]  1 where position i is the LAST row of its key group
+// Returns the number of distinct keys.
+int64_t ls_merge_i64(const int64_t* keys, const int64_t* run_offsets,
+                     int32_t num_runs, int64_t* order, uint8_t* group_tail) {
+  const int64_t n = run_offsets[num_runs];
+  if (n == 0) return 0;
+  // loser tree over run heads: find k2 = next pow2 ≥ num_runs
+  int32_t k2 = 1;
+  while (k2 < num_runs) k2 <<= 1;
+  const int64_t SENTINEL = INT64_MAX;
+
+  std::vector<int64_t> pos(num_runs);
+  for (int32_t r = 0; r < num_runs; r++) pos[r] = run_offsets[r];
+
+  auto head_key = [&](int32_t r) -> int64_t {
+    if (r >= num_runs || pos[r] >= run_offsets[r + 1]) return SENTINEL;
+    return keys[pos[r]];
+  };
+
+  // tree[1..k2-1] store LOSER run ids; tree[0] stores the winner.
+  std::vector<int32_t> tree(2 * k2, -1);
+  // initialize: bottom-up tournament
+  std::vector<int32_t> winner(2 * k2, -1);
+  for (int32_t i = 0; i < k2; i++) winner[k2 + i] = i;
+  for (int32_t node = k2 - 1; node >= 1; node--) {
+    int32_t a = winner[2 * node], b = winner[2 * node + 1];
+    int64_t ka = head_key(a), kb = head_key(b);
+    // smaller key wins; tie → smaller run id first (stable: older first)
+    int32_t w, l;
+    if (ka < kb || (ka == kb && a < b)) { w = a; l = b; } else { w = b; l = a; }
+    winner[node] = w;
+    tree[node] = l;
+  }
+  int32_t w = winner[1];
+
+  int64_t out_i = 0;
+  int64_t prev_key = 0;
+  bool have_prev = false;
+  int64_t groups = 0;
+  while (head_key(w) != SENTINEL) {
+    int64_t key = head_key(w);
+    if (have_prev && key != prev_key) {
+      group_tail[out_i - 1] = 1;
+    }
+    if (!have_prev || key != prev_key) groups++;
+    prev_key = key;
+    have_prev = true;
+    order[out_i] = pos[w];
+    group_tail[out_i] = 0;
+    out_i++;
+    pos[w]++;
+    // replay from leaf to root
+    int32_t node = (k2 + w) >> 1;
+    while (node >= 1) {
+      int32_t l = tree[node];
+      int64_t kw = head_key(w), kl = head_key(l);
+      if (kl < kw || (kl == kw && l < w)) {
+        tree[node] = w;
+        w = l;
+      }
+      node >>= 1;
+    }
+  }
+  if (out_i > 0) group_tail[out_i - 1] = 1;
+  return groups;
+}
+
+// --------------------------------------------------------------- bit pack
+// bits [n, d] {0,1} bytes → packed [n, ceil(d/8)] MSB-first (np.packbits).
+void ls_pack_bits(const uint8_t* bits, uint8_t* out, int64_t n, int64_t d) {
+  const int64_t d8 = (d + 7) / 8;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* row = bits + i * d;
+    uint8_t* orow = out + i * d8;
+    for (int64_t b = 0; b < d8; b++) {
+      uint8_t v = 0;
+      const int64_t base = b * 8;
+      const int64_t lim = (d - base) < 8 ? (d - base) : 8;
+      for (int64_t j = 0; j < lim; j++) v |= (uint8_t)((row[base + j] & 1u) << (7 - j));
+      orow[b] = v;
+    }
+  }
+}
+
+}  // extern "C"

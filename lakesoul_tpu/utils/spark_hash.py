@@ -213,11 +213,27 @@ def hash_array(arr: pa.Array, seeds=None, *, null_values: np.ndarray | None = No
             or pa.types.is_large_string(ty)
             or pa.types.is_binary(ty)
             or pa.types.is_large_binary(ty)
-            or pa.types.is_fixed_size_binary(ty)
         ):
+            from lakesoul_tpu import native
+
+            if native.available() and len(a) > 0:
+                # zero-copy over the Arrow buffers (validity handled upstream)
+                bufs = a.buffers()
+                off_dtype = np.int64 if (
+                    pa.types.is_large_string(ty) or pa.types.is_large_binary(ty)
+                ) else np.int32
+                offsets = np.frombuffer(bufs[1], dtype=off_dtype)[
+                    a.offset : a.offset + len(a) + 1
+                ].copy()
+                data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] else np.zeros(0, np.uint8)
+                out = np.empty(len(a), dtype=np.uint32)
+                native.hash_string_array(data, offsets, s, None, out, HASH_SEED)
+                return out
             pylist = a.to_pylist()
             bufs = [v.encode("utf-8") if isinstance(v, str) else v for v in pylist]
             return hash_bytes_list(bufs, s)
+        if pa.types.is_fixed_size_binary(ty):
+            return hash_bytes_list(a.to_pylist(), s)
         if pa.types.is_date(ty) or pa.types.is_time(ty) or pa.types.is_timestamp(ty):
             # 32-bit storage (date32/time32) hashes as one 4-byte block, like
             # the reference's i32-native Date32/Time32 arrays; 64-bit storage

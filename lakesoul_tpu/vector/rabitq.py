@@ -103,7 +103,11 @@ def _fht(x: np.ndarray) -> np.ndarray:
 
 
 def pack_bits(bits: np.ndarray) -> np.ndarray:
-    """[N, D] {0,1} → [N, D/8] uint8 (D padded to a byte multiple)."""
+    """[N, D] {0,1} → [N, D/8] uint8 (D padded to a byte multiple, MSB-first)."""
+    from lakesoul_tpu import native
+
+    if native.available() and bits.ndim == 2 and len(bits):
+        return native.pack_bits(bits.astype(np.uint8))
     return np.packbits(bits.astype(np.uint8), axis=-1)
 
 

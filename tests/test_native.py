@@ -1,0 +1,138 @@
+"""Native C++ core differential tests: every kernel must agree exactly with
+its pure-python counterpart, and the library must be optional."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import native
+from lakesoul_tpu.utils import spark_hash as sh
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no compiler)"
+)
+
+
+class TestNativeHash:
+    def test_i64_matches_python(self):
+        rng = np.random.default_rng(0)
+        vals = rng.integers(-(2**62), 2**62, 1000, dtype=np.int64)
+        out = np.zeros(1000, dtype=np.uint32)
+        native.hash_i64(vals, None, None, out, sh.HASH_SEED)
+        expect = sh.hash_long_array(vals)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_i32_with_seeds(self):
+        rng = np.random.default_rng(1)
+        vals = rng.integers(-(2**31), 2**31, 500, dtype=np.int32)
+        seeds = rng.integers(0, 2**32, 500, dtype=np.uint32)
+        out = np.zeros(500, dtype=np.uint32)
+        native.hash_i32(vals, seeds, None, out, sh.HASH_SEED)
+        expect = sh.hash_int_array(vals, seeds)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_string_arrays_match_python_fallback(self, monkeypatch):
+        vals = ["", "a", "hello world", "ab", "x" * 100, "日本語テキスト"]
+        arr = pa.array(vals)
+        got = sh.hash_array(arr)
+        # force the python path and compare
+        monkeypatch.setenv("LAKESOUL_TPU_DISABLE_NATIVE", "1")
+        bufs = [v.encode("utf-8") for v in vals]
+        expect = sh.hash_bytes_list(bufs)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_sliced_string_array(self):
+        arr = pa.array(["aa", "bb", "cc", "dd"]).slice(1, 2)
+        got = sh.hash_array(arr)
+        expect = sh.hash_bytes_list([b"bb", b"cc"])
+        np.testing.assert_array_equal(got, expect)
+
+
+class TestNativeMerge:
+    def test_loser_tree_matches_sorted_merge(self):
+        rng = np.random.default_rng(0)
+        runs = []
+        for _ in range(5):
+            n = int(rng.integers(1, 200))
+            runs.append(np.sort(rng.choice(500, n, replace=False)).astype(np.int64))
+        keys = np.concatenate(runs)
+        offsets = np.concatenate([[0], np.cumsum([len(r) for r in runs])]).astype(np.int64)
+        order, tail, groups = native.merge_sorted_runs_i64(keys, offsets)
+        merged = keys[order]
+        assert np.all(np.diff(merged) >= 0)  # globally sorted
+        # ties keep run order: for each key group the last element comes from
+        # the highest run index containing it
+        last_keys = merged[tail]
+        assert groups == len(np.unique(keys))
+        assert np.array_equal(np.unique(keys), np.sort(last_keys))
+        # last-per-group row index must come from the newest run with that key
+        for key in np.unique(keys):
+            holders = [r for r in range(5) if key in runs[r]]
+            newest = holders[-1]
+            pos = int(np.nonzero((merged == key) & tail)[0][0])
+            src_row = order[pos]
+            assert offsets[newest] <= src_row < offsets[newest + 1]
+
+    def test_merge_fast_path_equals_vectorized(self):
+        from lakesoul_tpu.io.merge import merge_sorted_tables
+
+        t1 = pa.table({"id": [1, 2, 3, 7], "v": [1.0, 2.0, 3.0, 7.0]})
+        t2 = pa.table({"id": [2, 5], "v": [20.0, 50.0]})
+        t3 = pa.table({"id": [3, 7, 9], "v": [30.0, 70.0, 90.0]})
+        fast = merge_sorted_tables([t1, t2, t3], ["id"])
+        import os
+
+        os.environ["LAKESOUL_TPU_DISABLE_NATIVE"] = "1"
+        try:
+            # force re-evaluation without native (availability is cached, so
+            # call the slow path directly by breaking the precondition)
+            slow = merge_sorted_tables(
+                [t1, t2, t3], ["id"], merge_operators={"v": "UseLast"}
+            )
+        finally:
+            del os.environ["LAKESOUL_TPU_DISABLE_NATIVE"]
+        assert fast.column("id").to_pylist() == [1, 2, 3, 5, 7, 9]
+        assert fast.column("v").to_pylist() == slow.column("v").to_pylist()
+
+    def test_empty_and_single_run(self):
+        order, tail, groups = native.merge_sorted_runs_i64(
+            np.array([1, 2, 3], dtype=np.int64), np.array([0, 3], dtype=np.int64)
+        )
+        assert list(order) == [0, 1, 2] and groups == 3
+        assert list(tail) == [True, True, True]
+        order, tail, groups = native.merge_sorted_runs_i64(
+            np.zeros(0, dtype=np.int64), np.array([0, 0], dtype=np.int64)
+        )
+        assert len(order) == 0 and groups == 0
+
+
+class TestNativePackBits:
+    def test_matches_numpy_packbits(self):
+        rng = np.random.default_rng(0)
+        for d in (8, 13, 64, 100):
+            bits = (rng.random((20, d)) > 0.5).astype(np.uint8)
+            np.testing.assert_array_equal(
+                native.pack_bits(bits), np.packbits(bits, axis=-1)
+            )
+
+
+class TestNativeEdgeCases:
+    def test_int64_max_key_falls_back_correctly(self):
+        # INT64_MAX is the C++ sentinel: the fast path must refuse it
+        from lakesoul_tpu.io.merge import merge_sorted_tables
+
+        big = np.iinfo(np.int64).max
+        t1 = pa.table({"id": np.array([1, big], dtype=np.int64), "v": [1.0, 2.0]})
+        t2 = pa.table({"id": np.array([big], dtype=np.int64), "v": [99.0]})
+        m = merge_sorted_tables([t1, t2], ["id"])
+        assert m.column("id").to_pylist() == [1, big]
+        assert m.column("v").to_pylist() == [1.0, 99.0]
+
+    def test_uint64_pk_not_reinterpreted(self):
+        from lakesoul_tpu.io.merge import merge_sorted_tables
+
+        t1 = pa.table({"id": pa.array([2**63 + 1], type=pa.uint64()), "v": [1.0]})
+        t2 = pa.table({"id": pa.array([10], type=pa.uint64()), "v": [2.0]})
+        m = merge_sorted_tables([t1, t2], ["id"])
+        assert m.column("id").to_pylist() == [10, 2**63 + 1]  # unsigned order
