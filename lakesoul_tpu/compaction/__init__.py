@@ -1,0 +1,4 @@
+from lakesoul_tpu.compaction.service import CompactionService
+from lakesoul_tpu.compaction.cleaner import Cleaner
+
+__all__ = ["CompactionService", "Cleaner"]

@@ -1,0 +1,188 @@
+"""Automatic compaction service.
+
+Role parity with the reference's Spark compaction service
+(lakesoul-spark/…/compaction/NewCompactionTask.scala:22-150): it LISTENs for
+`lakesoul_compaction_notify` events that the PG trigger emits when a
+partition's version gap since the last CompactionCommit reaches the threshold
+(meta_init.sql:101-150), hashes the partition onto a worker pool, and runs
+the compaction through the normal write path.
+
+Here the metadata store fires the same event synchronously
+(SqliteMetadataStore._fire_compaction_triggers); the service consumes them on
+a bounded queue with N worker threads, deduplicates in-flight partitions, and
+also supports size-tiered scheduled sweeps (the reference's "new compaction"
+path with file-number/size limits)."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+
+from lakesoul_tpu.errors import CommitConflictError
+from lakesoul_tpu.meta.store import CompactionEvent
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class CompactionStats:
+    triggered: int = 0
+    compacted: int = 0
+    skipped: int = 0
+    conflicts: int = 0
+    errors: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def bump(self, name: str) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + 1)
+
+
+class CompactionService:
+    """Consume compaction events for one catalog and compact on worker threads.
+
+    Usage::
+
+        svc = CompactionService(catalog, workers=2)
+        svc.start()           # subscribes to the store's trigger events
+        ...                   # writes keep committing; gaps trigger events
+        svc.drain(); svc.stop()
+    """
+
+    def __init__(
+        self,
+        catalog,
+        *,
+        workers: int = 2,
+        min_file_num: int = 2,
+        queue_size: int = 256,
+    ):
+        self.catalog = catalog
+        self.workers = workers
+        self.min_file_num = min_file_num
+        self.stats = CompactionStats()
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._in_flight: set[tuple[str, str]] = set()
+        self._in_flight_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # --------------------------------------------------------------- control
+    def start(self) -> None:
+        self.catalog.client.store.add_compaction_listener(self._on_event)
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, name=f"compaction-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.catalog.client.store.remove_compaction_listener(self._on_event)
+        except ValueError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until the event queue is empty and workers are idle."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._in_flight_lock:
+                busy = bool(self._in_flight)
+            if self._queue.empty() and not busy:
+                return
+            time.sleep(0.02)
+
+    # ---------------------------------------------------------------- events
+    def _on_event(self, event: CompactionEvent) -> None:
+        self.stats.bump("triggered")
+        key = (event.table_id, event.partition_desc)
+        with self._in_flight_lock:
+            if key in self._in_flight:
+                return  # already queued/running for this partition
+            self._in_flight.add(key)
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            with self._in_flight_lock:
+                self._in_flight.discard(key)
+            logger.warning("compaction queue full; dropping event for %s", key)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                event = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            key = (event.table_id, event.partition_desc)
+            try:
+                self._compact_one(event)
+            except Exception:
+                self.stats.bump("errors")
+                logger.exception("compaction failed for %s", key)
+            finally:
+                with self._in_flight_lock:
+                    self._in_flight.discard(key)
+                self._queue.task_done()
+
+    def _compact_one(self, event: CompactionEvent) -> None:
+        from lakesoul_tpu.meta.client import partition_desc_to_dict
+
+        info = self.catalog.client.store.get_table_info_by_id(event.table_id)
+        if info is None:
+            self.stats.bump("skipped")
+            return
+        table = self.catalog.table(info.table_name, info.table_namespace)
+        parts = partition_desc_to_dict(event.partition_desc) or None
+        # writers may advance the partition mid-compact; each retry re-reads
+        # the fresh head, like the reference re-running on the next notify
+        for attempt in range(3):
+            if not self._needs_compaction(table, event.partition_desc):
+                self.stats.bump("skipped")
+                return
+            try:
+                n = table.compact(parts)
+                self.stats.bump("compacted" if n else "skipped")
+                return
+            except CommitConflictError:
+                self.stats.bump("conflicts")
+        logger.info("compaction kept losing races for %s; deferring", event.partition_desc)
+
+    def _needs_compaction(self, table, partition_desc: str) -> bool:
+        """Size-tiered gate: only compact when some bucket stacks at least
+        min_file_num files (reference: file num/size limits in the
+        new-compaction path)."""
+        units = table.scan().scan_plan()
+        for u in units:
+            if u.partition_desc == partition_desc and len(u.data_files) >= self.min_file_num:
+                return True
+        return False
+
+    # ------------------------------------------------------------- full sweep
+    def sweep(self) -> int:
+        """Compact every table/partition that crosses the file threshold —
+        the scheduled fallback when no trigger fired (e.g. after restarts)."""
+        total = 0
+        for ns in self.catalog.list_namespaces():
+            for name in self.catalog.list_tables(ns):
+                table = self.catalog.table(name, ns)
+                units = table.scan().scan_plan()
+                descs = {
+                    u.partition_desc
+                    for u in units
+                    if len(u.data_files) >= self.min_file_num
+                }
+                for desc in descs:
+                    from lakesoul_tpu.meta.client import partition_desc_to_dict
+
+                    try:
+                        total += table.compact(partition_desc_to_dict(desc) or None)
+                    except CommitConflictError:
+                        self.stats.bump("conflicts")
+        return total

@@ -24,8 +24,11 @@ _tried = False
 
 def _build() -> bool:
     try:
+        # no -march=native: the .so may travel with the package tree to a
+        # different CPU (container image, shared venv) where native ISA
+        # extensions would SIGILL; these kernels vectorize fine at -O3
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
              _SRC, "-o", _LIB_PATH],
             check=True,
             capture_output=True,
@@ -52,11 +55,14 @@ def _bind(lib) -> None:
 
 
 def get_lib():
-    """The loaded native library, or None when unavailable/disabled."""
+    """The loaded native library, or None when unavailable/disabled.  The
+    kill switch is honored even after the library has loaded."""
     global _lib, _tried
+    if os.environ.get("LAKESOUL_TPU_DISABLE_NATIVE") == "1":
+        return None
     if _lib is not None:
         return _lib
-    if _tried or os.environ.get("LAKESOUL_TPU_DISABLE_NATIVE") == "1":
+    if _tried:
         return _lib
     with _lock:
         if _tried:

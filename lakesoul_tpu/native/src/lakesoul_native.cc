@@ -195,7 +195,8 @@ void ls_pack_bits(const uint8_t* bits, uint8_t* out, int64_t n, int64_t d) {
       uint8_t v = 0;
       const int64_t base = b * 8;
       const int64_t lim = (d - base) < 8 ? (d - base) : 8;
-      for (int64_t j = 0; j < lim; j++) v |= (uint8_t)((row[base + j] & 1u) << (7 - j));
+      // any nonzero byte counts as a set bit (np.packbits semantics)
+      for (int64_t j = 0; j < lim; j++) v |= (uint8_t)((row[base + j] != 0 ? 1u : 0u) << (7 - j));
       orow[b] = v;
     }
   }

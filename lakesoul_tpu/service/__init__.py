@@ -1,0 +1,4 @@
+from lakesoul_tpu.service.jwt import JwtServer
+from lakesoul_tpu.service.rbac import RbacVerifier
+
+__all__ = ["JwtServer", "RbacVerifier"]

@@ -1,0 +1,142 @@
+"""Interactive console / CLI over a warehouse.
+
+Role parity with the reference's lakesoul-console (rust/lakesoul-console:
+exec_from_repl + file exec): inspect tables, scan with filters, write files,
+compact, clean — without an engine.  Usable as a REPL
+(``python -m lakesoul_tpu.service.console -w /path/wh``) or one-shot
+(``... -c "scan mytable limit 5"``)."""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+import pyarrow as pa
+
+
+class Console:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    def execute(self, line: str) -> str:
+        toks = shlex.split(line.strip())
+        if not toks:
+            return ""
+        cmd, args = toks[0].lower(), toks[1:]
+        handler = getattr(self, f"cmd_{cmd}", None)
+        if handler is None:
+            return f"unknown command: {cmd!r} (try 'help')"
+        try:
+            return handler(args)
+        except Exception as e:  # surfaced, not fatal — it's a REPL
+            return f"error: {type(e).__name__}: {e}"
+
+    # ---------------------------------------------------------------- cmds
+    def cmd_help(self, args) -> str:
+        return (
+            "commands:\n"
+            "  tables                       list tables\n"
+            "  show <table>                 schema + properties\n"
+            "  scan <table> [limit N]       print rows\n"
+            "  count <table>                row count\n"
+            "  write <table> <parquet>      append a parquet file's rows\n"
+            "  compact <table>              compact all partitions\n"
+            "  versions <table>             partition version chains\n"
+            "  drop <table>                 drop a table\n"
+            "  quit"
+        )
+
+    def cmd_tables(self, args) -> str:
+        out = []
+        for ns in self.catalog.list_namespaces():
+            for t in self.catalog.list_tables(ns):
+                out.append(f"{ns}.{t}")
+        return "\n".join(out) or "(no tables)"
+
+    def cmd_show(self, args) -> str:
+        t = self.catalog.table(args[0])
+        info = t.info
+        lines = [f"table: {info.table_namespace}.{info.table_name}",
+                 f"path: {info.table_path}",
+                 f"primary keys: {info.primary_keys}",
+                 f"range partitions: {info.range_partition_columns}",
+                 f"properties: {info.properties}",
+                 "schema:"]
+        for fld in t.schema:
+            lines.append(f"  {fld.name}: {fld.type}")
+        return "\n".join(lines)
+
+    def cmd_scan(self, args) -> str:
+        name = args[0]
+        limit = None
+        if len(args) >= 3 and args[1].lower() == "limit":
+            limit = int(args[2])
+        table = self.catalog.table(name).to_arrow()
+        if limit is not None:
+            table = table.slice(0, limit)
+        return table.to_pandas().to_string()
+
+    def cmd_count(self, args) -> str:
+        return str(self.catalog.table(args[0]).scan().count_rows())
+
+    def cmd_write(self, args) -> str:
+        import pyarrow.parquet as pq
+
+        t = self.catalog.table(args[0])
+        data = pq.read_table(args[1])
+        files = t.write_arrow(data)
+        return f"wrote {data.num_rows} rows in {len(files)} files"
+
+    def cmd_compact(self, args) -> str:
+        n = self.catalog.table(args[0]).compact()
+        return f"compacted {n} partitions"
+
+    def cmd_versions(self, args) -> str:
+        t = self.catalog.table(args[0])
+        store = self.catalog.client.store
+        lines = []
+        for head in store.get_all_latest_partition_info(t.info.table_id):
+            for v in store.get_partition_versions(t.info.table_id, head.partition_desc):
+                lines.append(
+                    f"{head.partition_desc} v{v.version} {v.commit_op.value}"
+                    f" commits={len(v.snapshot)} ts={v.timestamp}"
+                )
+        return "\n".join(lines) or "(empty)"
+
+    def cmd_drop(self, args) -> str:
+        self.catalog.drop_table(args[0])
+        return f"dropped {args[0]}"
+
+    # ---------------------------------------------------------------- repl
+    def repl(self) -> None:
+        print("lakesoul_tpu console — 'help' for commands")
+        while True:
+            try:
+                line = input("lakesoul> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            if line.strip().lower() in ("quit", "exit"):
+                break
+            out = self.execute(line)
+            if out:
+                print(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="lakesoul_tpu console")
+    parser.add_argument("-w", "--warehouse", required=True)
+    parser.add_argument("-c", "--command", help="run one command and exit")
+    args = parser.parse_args(argv)
+    from lakesoul_tpu import LakeSoulCatalog
+
+    console = Console(LakeSoulCatalog(args.warehouse))
+    if args.command:
+        print(console.execute(args.command))
+        return 0
+    console.repl()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""Arrow Flight gateway.
+
+Role parity with the reference's Flight SQL server
+(rust/lakesoul-flight/src/flight_sql_service.rs:194): JWT-authenticated
+clients stream table scans out (DoGet), ingest Arrow streams transactionally
+(DoPut with exactly-once checkpoint ids), list tables, and run management
+actions — over pyarrow.flight instead of tonic/gRPC-rust.
+
+Tickets and descriptors are JSON:
+  DoGet ticket: {"table": ..., "namespace": ..., "columns": [...],
+                 "filter": <Filter JSON>, "partitions": {...},
+                 "incremental_start_ms": ..., "batch_size": ...}
+  DoPut descriptor path: ["<namespace>.<table>"] with app_metadata
+                 {"checkpoint_id": ...} for idempotent streaming commits.
+
+Metrics parity with StreamWriteMetrics (flight_sql_service.rs:90): active and
+total streams, rows and bytes in/out, exposed via the ``metrics`` action."""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from lakesoul_tpu.errors import LakeSoulError, RBACError
+from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.service.jwt import JwtServer
+from lakesoul_tpu.service.rbac import RbacVerifier
+
+
+@dataclass
+class StreamMetrics:
+    active_get_streams: int = 0
+    active_put_streams: int = 0
+    total_get_streams: int = 0
+    total_put_streams: int = 0
+    rows_out: int = 0
+    rows_in: int = 0
+    bytes_in: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def add(self, **kw) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                k: getattr(self, k)
+                for k in (
+                    "active_get_streams", "active_put_streams", "total_get_streams",
+                    "total_put_streams", "rows_out", "rows_in", "bytes_in",
+                )
+            }
+
+
+class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
+    def __init__(self, jwt_server: JwtServer | None):
+        self.jwt_server = jwt_server
+
+    def start_call(self, info, headers):
+        if self.jwt_server is None:
+            return _AuthMiddleware("anonymous", "public")
+        auth = headers.get("authorization") or headers.get("Authorization")
+        if not auth:
+            raise flight.FlightUnauthenticatedError("missing authorization header")
+        token = auth[0]
+        if token.lower().startswith("bearer "):
+            token = token[7:]
+        try:
+            claims = self.jwt_server.decode_token(token)
+        except RBACError as e:
+            raise flight.FlightUnauthenticatedError(str(e))
+        return _AuthMiddleware(claims.sub, claims.group)
+
+
+class _AuthMiddleware(flight.ServerMiddleware):
+    def __init__(self, user: str, group: str):
+        self.user = user
+        self.group = group
+
+
+class LakeSoulFlightServer(flight.FlightServerBase):
+    def __init__(
+        self,
+        catalog,
+        location: str = "grpc://127.0.0.1:0",
+        *,
+        jwt_secret: str | None = None,
+    ):
+        self.catalog = catalog
+        self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
+        self.rbac = RbacVerifier(catalog.client)
+        self.metrics = StreamMetrics()
+        super().__init__(
+            location,
+            middleware={"auth": _AuthMiddlewareFactory(self.jwt_server)},
+        )
+
+    # ------------------------------------------------------------------ auth
+    def _identity(self, context) -> tuple[str, str]:
+        mw = context.get_middleware("auth")
+        if mw is None:
+            return "anonymous", "public"
+        return mw.user, mw.group
+
+    def _check(self, context, namespace: str, table: str) -> None:
+        user, group = self._identity(context)
+        try:
+            self.rbac.check(user, group, namespace, table)
+        except RBACError as e:
+            raise flight.FlightUnauthorizedError(str(e))
+
+    # ----------------------------------------------------------------- lists
+    def list_flights(self, context, criteria):
+        for ns in self.catalog.list_namespaces():
+            for name in self.catalog.list_tables(ns):
+                table = self.catalog.table(name, ns)
+                desc = flight.FlightDescriptor.for_path(f"{ns}.{name}")
+                yield flight.FlightInfo(
+                    table.schema, desc, [], -1, -1
+                )
+
+    def get_flight_info(self, context, descriptor):
+        ns, name = self._parse_descriptor(descriptor)
+        self._check(context, ns, name)
+        table = self.catalog.table(name, ns)
+        ticket = flight.Ticket(json.dumps({"table": name, "namespace": ns}).encode())
+        endpoint = flight.FlightEndpoint(ticket, [])
+        return flight.FlightInfo(table.schema, descriptor, [endpoint], -1, -1)
+
+    @staticmethod
+    def _parse_descriptor(descriptor) -> tuple[str, str]:
+        if descriptor.path:
+            full = descriptor.path[0]
+            if isinstance(full, bytes):
+                full = full.decode()
+        else:
+            full = descriptor.command.decode()
+        ns, _, name = full.rpartition(".")
+        return ns or "default", name
+
+    # ----------------------------------------------------------------- DoGet
+    def do_get(self, context, ticket):
+        req = json.loads(ticket.ticket.decode())
+        ns = req.get("namespace", "default")
+        name = req["table"]
+        self._check(context, ns, name)
+        table = self.catalog.table(name, ns)
+        scan = table.scan()
+        if req.get("columns"):
+            scan = scan.select(req["columns"])
+        if req.get("filter"):
+            scan = scan.filter(Filter._from_dict(req["filter"]))
+        if req.get("partitions"):
+            scan = scan.partitions(req["partitions"])
+        if req.get("incremental_start_ms") is not None:
+            scan = scan.incremental(req["incremental_start_ms"], req.get("incremental_end_ms"))
+        if req.get("batch_size"):
+            scan = scan.batch_size(req["batch_size"])
+
+        metrics = self.metrics
+        metrics.add(active_get_streams=1, total_get_streams=1)
+
+        def gen():
+            try:
+                for batch in scan.to_batches():
+                    metrics.add(rows_out=len(batch))
+                    yield batch
+            finally:
+                metrics.add(active_get_streams=-1)
+
+        # stream lazily with the table schema (projection-aware)
+        out_schema = table.schema
+        if req.get("columns"):
+            out_schema = pa.schema([out_schema.field(c) for c in req["columns"]])
+        return flight.GeneratorStream(out_schema, gen())
+
+    # ----------------------------------------------------------------- DoPut
+    def do_put(self, context, descriptor, reader, writer):
+        ns, name = self._parse_descriptor(descriptor)
+        self._check(context, ns, name)
+        table = self.catalog.table(name, ns)
+        self.metrics.add(active_put_streams=1, total_put_streams=1)
+        try:
+            from lakesoul_tpu.streaming import CheckpointedWriter
+
+            w = CheckpointedWriter(table)
+            rows = 0
+            nbytes = 0
+            checkpoint_id = None
+            for chunk in reader:
+                batch = chunk.data
+                if chunk.app_metadata:
+                    meta = json.loads(chunk.app_metadata.to_pybytes().decode())
+                    checkpoint_id = meta.get("checkpoint_id", checkpoint_id)
+                if batch is not None and len(batch):
+                    rows += len(batch)
+                    nbytes += batch.nbytes
+                    w.write(pa.table(batch))
+            if checkpoint_id is not None:
+                w.checkpoint(checkpoint_id)  # exactly-once epoch commit
+            else:
+                outputs = w._ensure_writer().flush()
+                if outputs:
+                    from lakesoul_tpu.meta import DataFileOp
+
+                    files = {}
+                    for out in outputs:
+                        files.setdefault(out.partition_desc, []).append(
+                            DataFileOp(path=out.path, file_op="add", size=out.size,
+                                       file_exist_cols=out.file_exist_cols)
+                        )
+                    self.catalog.client.commit_data_files(table.info, files, w.commit_op)
+            self.metrics.add(rows_in=rows, bytes_in=nbytes)
+        except LakeSoulError as e:
+            raise flight.FlightServerError(str(e))
+        finally:
+            self.metrics.add(active_put_streams=-1)
+
+    # --------------------------------------------------------------- actions
+    def do_action(self, context, action):
+        body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
+        if action.type == "create_table":
+            schema = pa.ipc.read_schema(pa.BufferReader(bytes.fromhex(body["schema_ipc_hex"])))
+            self.catalog.create_table(
+                body["table"],
+                schema,
+                primary_keys=body.get("primary_keys"),
+                range_partitions=body.get("range_partitions"),
+                hash_bucket_num=body.get("hash_bucket_num"),
+                cdc=body.get("cdc", False),
+                namespace=body.get("namespace", "default"),
+            )
+            return [flight.Result(b"ok")]
+        if action.type == "drop_table":
+            ns = body.get("namespace", "default")
+            self._check(context, ns, body["table"])
+            self.catalog.drop_table(body["table"], ns)
+            return [flight.Result(b"ok")]
+        if action.type == "compact":
+            ns = body.get("namespace", "default")
+            self._check(context, ns, body["table"])
+            n = self.catalog.table(body["table"], ns).compact(body.get("partitions"))
+            return [flight.Result(json.dumps({"compacted": n}).encode())]
+        if action.type == "metrics":
+            return [flight.Result(json.dumps(self.metrics.snapshot()).encode())]
+        raise flight.FlightServerError(f"unknown action {action.type}")
+
+    def list_actions(self, context):
+        return [
+            ("create_table", "create a table; body: {table, schema_ipc_hex, primary_keys?, ...}"),
+            ("drop_table", "drop a table; body: {table, namespace?}"),
+            ("compact", "compact a table; body: {table, namespace?, partitions?}"),
+            ("metrics", "server stream metrics snapshot"),
+        ]
+
+
+class LakeSoulFlightClient:
+    """Thin convenience client for the gateway."""
+
+    def __init__(self, location: str, *, token: str | None = None):
+        self._client = flight.FlightClient(location)
+        self._options = None
+        if token:
+            self._options = flight.FlightCallOptions(
+                headers=[(b"authorization", f"Bearer {token}".encode())]
+            )
+
+    def scan(self, table: str, **req) -> pa.Table:
+        ticket = flight.Ticket(json.dumps({"table": table, **req}).encode())
+        return self._client.do_get(ticket, options=self._options).read_all()
+
+    def write(self, table: str, data: pa.Table, *, namespace: str = "default",
+              checkpoint_id=None) -> None:
+        desc = flight.FlightDescriptor.for_path(f"{namespace}.{table}")
+        writer, _ = self._client.do_put(desc, data.schema, options=self._options)
+        meta = (
+            json.dumps({"checkpoint_id": checkpoint_id}).encode()
+            if checkpoint_id is not None
+            else None
+        )
+        for batch in data.to_batches():
+            if meta is not None:
+                writer.write_with_metadata(batch, meta)
+            else:
+                writer.write_batch(batch)
+        writer.close()
+
+    def action(self, name: str, body: dict | None = None) -> list:
+        action = flight.Action(name, json.dumps(body or {}).encode())
+        return [r.body.to_pybytes() for r in self._client.do_action(action, options=self._options)]
+
+    def list_tables(self) -> list[str]:
+        return [
+            f.descriptor.path[0].decode() if isinstance(f.descriptor.path[0], bytes)
+            else f.descriptor.path[0]
+            for f in self._client.list_flights(options=self._options)
+        ]

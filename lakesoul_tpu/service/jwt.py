@@ -1,0 +1,61 @@
+"""HS256 JWT issue/verify, stdlib-only.
+
+Parity with the reference's JwtServer (rust/lakesoul-metadata/src/jwt.rs:10-94):
+claims {sub, group, exp}, HMAC-SHA256 signatures, used by the Flight gateway
+handshake and the storage proxy."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass
+
+from lakesoul_tpu.errors import RBACError
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64url(s: str) -> bytes:
+    pad = "=" * (-len(s) % 4)
+    return base64.urlsafe_b64decode(s + pad)
+
+
+@dataclass(frozen=True)
+class Claims:
+    """reference: Claims (jwt.rs:10) — subject user, group/domain, expiry."""
+
+    sub: str
+    group: str = "public"
+    exp: int = 0
+
+
+class JwtServer:
+    def __init__(self, secret: str | bytes):
+        self._secret = secret.encode() if isinstance(secret, str) else secret
+
+    def create_token(self, claims: Claims, *, ttl_seconds: int = 3600) -> str:
+        header = {"alg": "HS256", "typ": "JWT"}
+        exp = claims.exp or int(time.time()) + ttl_seconds
+        payload = {"sub": claims.sub, "group": claims.group, "exp": exp}
+        signing_input = f"{_b64url(json.dumps(header).encode())}.{_b64url(json.dumps(payload).encode())}"
+        sig = hmac.new(self._secret, signing_input.encode(), hashlib.sha256).digest()
+        return f"{signing_input}.{_b64url(sig)}"
+
+    def decode_token(self, token: str) -> Claims:
+        try:
+            head_b64, payload_b64, sig_b64 = token.split(".")
+        except ValueError:
+            raise RBACError("malformed token")
+        signing_input = f"{head_b64}.{payload_b64}".encode()
+        expect = hmac.new(self._secret, signing_input, hashlib.sha256).digest()
+        if not hmac.compare_digest(expect, _unb64url(sig_b64)):
+            raise RBACError("invalid token signature")
+        payload = json.loads(_unb64url(payload_b64))
+        if payload.get("exp", 0) < time.time():
+            raise RBACError("token expired")
+        return Claims(sub=payload["sub"], group=payload.get("group", "public"), exp=payload["exp"])

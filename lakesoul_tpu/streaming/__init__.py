@@ -1,0 +1,3 @@
+from lakesoul_tpu.streaming.cdc import CdcIngestor, CheckpointedWriter
+
+__all__ = ["CdcIngestor", "CheckpointedWriter"]

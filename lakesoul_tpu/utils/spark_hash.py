@@ -101,13 +101,31 @@ def hash_int_array(values: np.ndarray, seeds=None) -> np.ndarray:
     v = np.asarray(values)
     if v.dtype == np.bool_:
         v = v.astype(np.int32)
+    from lakesoul_tpu import native
+
+    if native.available() and len(v):
+        # int32 cast sign-extends smaller ints; the kernel wraps to u32 —
+        # identical to the numpy sign-extend-then-wrap below
+        out = np.empty(len(v), dtype=np.uint32)
+        seeds_arr = None if seeds is None else np.ascontiguousarray(seeds, np.uint32)
+        native.hash_i32(v.astype(np.int32, copy=False), seeds_arr, None, out, HASH_SEED)
+        return out
     u = v.astype(np.int64).astype(np.uint32).reshape(-1, 1)  # sign-extend then wrap
     return _hash_u32_blocks(u, _seed_array(len(u), seeds), 4)
 
 
 def hash_long_array(values: np.ndarray, seeds=None) -> np.ndarray:
     """Hash 64-bit integers: 8 LE bytes = two u32 blocks (low then high)."""
-    u = np.asarray(values).astype(np.uint64)
+    raw = np.asarray(values)
+    from lakesoul_tpu import native
+
+    if native.available() and len(raw):
+        i64 = raw.view(np.int64) if raw.dtype == np.uint64 else np.ascontiguousarray(raw, np.int64)
+        out = np.empty(len(raw), dtype=np.uint32)
+        seeds_arr = None if seeds is None else np.ascontiguousarray(seeds, np.uint32)
+        native.hash_i64(i64, seeds_arr, None, out, HASH_SEED)
+        return out
+    u = raw.astype(np.uint64)
     lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     hi = (u >> np.uint64(32)).astype(np.uint32)
     return _hash_u32_blocks(np.stack([lo, hi], axis=1), _seed_array(len(u), seeds), 8)

@@ -1,0 +1,145 @@
+"""Flight gateway, JWT, RBAC, and console tests."""
+
+import json
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as flight
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.errors import RBACError
+from lakesoul_tpu.service.console import Console
+from lakesoul_tpu.service.flight import LakeSoulFlightClient, LakeSoulFlightServer
+from lakesoul_tpu.service.jwt import Claims, JwtServer
+from lakesoul_tpu.service.rbac import RbacVerifier
+
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+class TestJwt:
+    def test_round_trip(self):
+        srv = JwtServer("secret")
+        token = srv.create_token(Claims(sub="alice", group="team1"))
+        claims = srv.decode_token(token)
+        assert claims.sub == "alice" and claims.group == "team1"
+
+    def test_tampered_token_rejected(self):
+        srv = JwtServer("secret")
+        token = srv.create_token(Claims(sub="alice"))
+        head, payload, sig = token.split(".")
+        with pytest.raises(RBACError, match="signature"):
+            srv.decode_token(f"{head}.{payload}x.{sig}")
+        with pytest.raises(RBACError):
+            JwtServer("other-secret").decode_token(token)
+
+    def test_expired_token(self):
+        srv = JwtServer("secret")
+        token = srv.create_token(Claims(sub="a", exp=int(time.time()) - 10))
+        with pytest.raises(RBACError, match="expired"):
+            srv.decode_token(token)
+
+
+class TestRbac:
+    def test_domain_rules(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        cat.create_table("pub", SCHEMA)
+        info = cat.client.create_table(
+            "priv", f"{tmp_warehouse}/priv", SCHEMA, domain="team1"
+        )
+        rbac = RbacVerifier(cat.client)
+        assert rbac.verify_permission_by_table_name("u", "whatever", "default", "pub")
+        assert rbac.verify_permission_by_table_name("u", "team1", "default", "priv")
+        assert not rbac.verify_permission_by_table_name("u", "team2", "default", "priv")
+        with pytest.raises(RBACError):
+            rbac.check("u", "team2", "default", "priv")
+        # cache answers without hitting the store
+        cat.client.store.delete_table(info.table_id)
+        assert rbac.verify_permission_by_table_name("u", "team1", "default", "priv")
+
+
+@pytest.fixture()
+def gateway(tmp_warehouse):
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    t = catalog.create_table("events", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+    t.write_arrow(pa.table({"id": np.arange(100), "v": np.arange(100, dtype=np.float64)}))
+    server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0", jwt_secret="s3cr3t")
+    token = server.jwt_server.create_token(Claims(sub="alice", group="public"))
+    yield server, f"grpc://127.0.0.1:{server.port}", token, catalog
+    server.shutdown()
+
+
+class TestFlightGateway:
+    def test_do_get_scan(self, gateway):
+        server, loc, token, _ = gateway
+        client = LakeSoulFlightClient(loc, token=token)
+        table = client.scan("events")
+        assert table.num_rows == 100
+        proj = client.scan("events", columns=["id"], filter={"op": "ge", "col": "id", "value": 95})
+        assert proj.column_names == ["id"]
+        assert sorted(proj.column("id").to_pylist()) == [95, 96, 97, 98, 99]
+
+    def test_do_put_ingest_and_exactly_once(self, gateway):
+        server, loc, token, catalog = gateway
+        client = LakeSoulFlightClient(loc, token=token)
+        new = pa.table({"id": np.arange(100, 120), "v": np.zeros(20)})
+        client.write("events", new, checkpoint_id=1)
+        client.write("events", new, checkpoint_id=1)  # replay → no-op
+        assert client.scan("events").num_rows == 120
+        metrics = json.loads(client.action("metrics")[0])
+        assert metrics["total_put_streams"] == 2
+        assert metrics["rows_in"] == 40  # both streams counted, one committed
+
+    def test_unauthenticated_rejected(self, gateway):
+        _, loc, _, _ = gateway
+        client = LakeSoulFlightClient(loc)  # no token
+        with pytest.raises(flight.FlightUnauthenticatedError):
+            client.scan("events")
+        bad = LakeSoulFlightClient(loc, token="garbage.token.sig")
+        with pytest.raises(flight.FlightUnauthenticatedError):
+            bad.scan("events")
+
+    def test_actions_create_compact_drop(self, gateway):
+        _, loc, token, catalog = gateway
+        client = LakeSoulFlightClient(loc, token=token)
+        schema_hex = SCHEMA.serialize().to_pybytes().hex()
+        client.action("create_table", {"table": "t2", "schema_ipc_hex": schema_hex,
+                                       "primary_keys": ["id"]})
+        assert "default.t2" in client.list_tables()
+        client.write("t2", pa.table({"id": [1], "v": [1.0]}))
+        client.write("t2", pa.table({"id": [2], "v": [2.0]}))
+        out = json.loads(client.action("compact", {"table": "t2"})[0])
+        assert out["compacted"] == 1
+        client.action("drop_table", {"table": "t2"})
+        assert "default.t2" not in client.list_tables()
+
+    def test_incremental_scan_over_flight(self, gateway):
+        server, loc, token, catalog = gateway
+        client = LakeSoulFlightClient(loc, token=token)
+        t = catalog.table("events")
+        ts0 = max(
+            p.timestamp
+            for p in catalog.client.store.get_all_latest_partition_info(t.info.table_id)
+        )
+        time.sleep(0.002)
+        client.write("events", pa.table({"id": [999], "v": [9.0]}))
+        inc = client.scan("events", incremental_start_ms=ts0)
+        assert inc.column("id").to_pylist() == [999]
+
+
+class TestConsole:
+    def test_console_commands(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        t = cat.create_table("t", SCHEMA, primary_keys=["id"])
+        t.write_arrow(pa.table({"id": [1, 2], "v": [1.0, 2.0]}))
+        c = Console(cat)
+        assert "default.t" in c.execute("tables")
+        assert "primary keys: ['id']" in c.execute("show t")
+        assert c.execute("count t") == "2"
+        assert "v0" in c.execute("versions t")
+        assert "unknown command" in c.execute("bogus")
+        assert "error:" in c.execute("show nope")
+        c.execute("drop t")
+        assert c.execute("tables") == "(no tables)"

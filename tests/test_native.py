@@ -136,3 +136,35 @@ class TestNativeEdgeCases:
         t2 = pa.table({"id": pa.array([10], type=pa.uint64()), "v": [2.0]})
         m = merge_sorted_tables([t1, t2], ["id"])
         assert m.column("id").to_pylist() == [10, 2**63 + 1]  # unsigned order
+
+
+class TestNativeWiring:
+    def test_disable_env_honored_after_load(self, monkeypatch):
+        assert native.available()
+        monkeypatch.setenv("LAKESOUL_TPU_DISABLE_NATIVE", "1")
+        assert not native.available()
+        # python fallback still produces identical hashes
+        vals = np.array([1, -5, 2**40], dtype=np.int64)
+        h_py = sh.hash_long_array(vals)
+        monkeypatch.delenv("LAKESOUL_TPU_DISABLE_NATIVE")
+        h_nat = sh.hash_long_array(vals)
+        np.testing.assert_array_equal(h_py, h_nat)
+
+    def test_int_hash_native_vs_python_fallback(self, monkeypatch):
+        rng = np.random.default_rng(3)
+        small = rng.integers(-100, 100, 200, dtype=np.int16)
+        u32 = rng.integers(0, 2**32, 200, dtype=np.uint32)
+        u64 = rng.integers(0, 2**64, 200, dtype=np.uint64)
+        native_hashes = [
+            sh.hash_int_array(small), sh.hash_int_array(u32), sh.hash_long_array(u64)
+        ]
+        monkeypatch.setenv("LAKESOUL_TPU_DISABLE_NATIVE", "1")
+        py_hashes = [
+            sh.hash_int_array(small), sh.hash_int_array(u32), sh.hash_long_array(u64)
+        ]
+        for a, b in zip(native_hashes, py_hashes):
+            np.testing.assert_array_equal(a, b)
+
+    def test_pack_bits_nonbinary_input_matches_numpy(self):
+        arr = np.array([[2, 0, 1, 0, 7, 0, 0, 0]], dtype=np.uint8)
+        np.testing.assert_array_equal(native.pack_bits(arr), np.packbits(arr, axis=-1))

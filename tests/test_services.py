@@ -1,0 +1,161 @@
+"""Compaction service, cleaner, and CDC streaming tests."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.compaction import Cleaner, CompactionService
+from lakesoul_tpu.meta.store import COMPACTION_TRIGGER_VERSION_GAP
+from lakesoul_tpu.streaming import CdcIngestor, CheckpointedWriter
+from lakesoul_tpu.streaming.cdc import checkpoint_commit_id
+
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+@pytest.fixture()
+def catalog(tmp_warehouse):
+    return LakeSoulCatalog(str(tmp_warehouse))
+
+
+class TestCompactionService:
+    def test_trigger_fires_and_compacts(self, catalog):
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        svc = CompactionService(catalog, workers=1, min_file_num=2)
+        svc.start()
+        try:
+            # enough commits to cross the version-gap trigger
+            for i in range(COMPACTION_TRIGGER_VERSION_GAP + 1):
+                t.write_arrow(pa.table({"id": [i], "v": [float(i)]}))
+            svc.drain()
+        finally:
+            svc.stop()
+        assert svc.stats.triggered >= 1
+        assert svc.stats.compacted >= 1
+        plan = t.scan().scan_plan()
+        # post-compaction: merge no longer needed on the compacted head
+        assert any(u.primary_keys == [] for u in plan)
+        got = t.to_arrow().sort_by("id")
+        assert got.num_rows == COMPACTION_TRIGGER_VERSION_GAP + 1
+
+    def test_sweep_compacts_without_trigger(self, catalog):
+        t = catalog.create_table("s", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        t.write_arrow(pa.table({"id": [2], "v": [2.0]}))
+        svc = CompactionService(catalog, min_file_num=2)
+        assert svc.sweep() == 1
+        assert svc.sweep() == 0  # idempotent
+
+
+class TestCleaner:
+    def test_expired_versions_and_files_removed(self, catalog, tmp_path):
+        import os
+
+        t = catalog.create_table("c", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        t.write_arrow(pa.table({"id": [2], "v": [2.0]}))
+        old_files = [u for unit in t.scan().scan_plan() for u in unit.data_files]
+        t.compact()
+        # age everything: pretend the clock advanced past retention
+        future = 10**14
+        cleaner = Cleaner(catalog, retention_ms=1, discard_grace_ms=1)
+        result = cleaner.clean_table("c", now_ms=future)
+        assert result["versions_dropped"] >= 2
+        n_discard = cleaner.clean_discarded_files(now_ms=future)
+        assert n_discard == len(old_files)
+        for f in old_files:
+            assert not os.path.exists(f)
+        # table still reads correctly from the compacted head
+        got = t.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2]
+
+    def test_recent_data_untouched(self, catalog):
+        t = catalog.create_table("r", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        cleaner = Cleaner(catalog)  # default 7-day retention
+        result = cleaner.clean_table("r")
+        assert result == {"versions_dropped": 0, "files_deleted": 0}
+
+
+class TestCheckpointedWriter:
+    def test_exactly_once_replay(self, catalog):
+        t = catalog.create_table("ck", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        w = CheckpointedWriter(t)
+        w.write(pa.table({"id": [1, 2], "v": [1.0, 2.0]}))
+        assert w.checkpoint(1) == 1
+        # replay of the same epoch with the same data: no-op
+        w.write(pa.table({"id": [1, 2], "v": [1.0, 2.0]}))
+        assert w.checkpoint(1) == 0
+        head = catalog.client.store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.version == 0  # only one commit landed
+        assert t.to_arrow().num_rows == 2
+
+    def test_multiple_epochs_accumulate(self, catalog):
+        t = catalog.create_table("ck2", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        w = CheckpointedWriter(t)
+        w.write(pa.table({"id": [1], "v": [1.0]}))
+        w.checkpoint(1)
+        w.write(pa.table({"id": [2], "v": [2.0]}))
+        w.checkpoint(2)
+        assert t.to_arrow().num_rows == 2
+
+    def test_commit_id_deterministic(self):
+        a = checkpoint_commit_id("tid", "-5", 7)
+        b = checkpoint_commit_id("tid", "-5", 7)
+        c = checkpoint_commit_id("tid", "-5", 8)
+        assert a == b and a != c
+
+
+class TestCdcIngestor:
+    def test_cdc_stream_end_to_end(self, catalog):
+        t = catalog.create_table("cdc", SCHEMA, primary_keys=["id"], cdc=True, hash_bucket_num=1)
+        ing = CdcIngestor(t)
+        ing.apply_many(
+            [
+                ("insert", {"id": 1, "v": 1.0}),
+                ("insert", {"id": 2, "v": 2.0}),
+                ("update", {"id": 1, "v": 10.0}),
+            ]
+        )
+        ing.checkpoint(1)
+        ing.apply("delete", {"id": 2})
+        ing.checkpoint(2)
+        got = t.to_arrow()
+        assert got.column("id").to_pylist() == [1]
+        assert got.column("v").to_pylist() == [10.0]
+        # incremental CDC consumers see the delete row kind
+        raw = t.scan().with_cdc_deletes().to_arrow().sort_by("id")
+        kinds = dict(zip(raw.column("id").to_pylist(), raw.column(t.info.cdc_column).to_pylist()))
+        assert kinds[2] == "delete"
+
+    def test_requires_cdc_table(self, catalog):
+        from lakesoul_tpu.errors import ConfigError
+
+        t = catalog.create_table("plain", SCHEMA, primary_keys=["id"])
+        with pytest.raises(ConfigError, match="not CDC-enabled"):
+            CdcIngestor(t)
+
+    def test_online_feature_pipeline(self, catalog):
+        """BASELINE.json config 5: CDC upserts → incremental read → JAX
+        feature pipeline."""
+        import time
+
+        import jax.numpy as jnp
+
+        t = catalog.create_table("feat", SCHEMA, primary_keys=["id"], cdc=True, hash_bucket_num=1)
+        ing = CdcIngestor(t)
+        ing.apply_many([("insert", {"id": i, "v": float(i)}) for i in range(10)])
+        ing.checkpoint(1)
+        ts0 = max(
+            p.timestamp
+            for p in catalog.client.store.get_all_latest_partition_info(t.info.table_id)
+        )
+        time.sleep(0.002)
+        ing.apply_many([("update", {"id": 3, "v": 33.0}), ("insert", {"id": 99, "v": 99.0})])
+        ing.checkpoint(2)
+        # incremental read of just the new epoch → features on device
+        inc = t.scan().incremental(ts0).to_arrow().sort_by("id")
+        assert inc.column("id").to_pylist() == [3, 99]
+        feats = jnp.asarray(inc.column("v").to_numpy(zero_copy_only=False))
+        assert float(feats.sum()) == 132.0
