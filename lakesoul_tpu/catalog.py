@@ -198,8 +198,12 @@ class LakeSoulTable:
             )
         except CommitConflictError:
             # conflict = the partition-version insert never landed, so the
-            # staged files are provably invisible → safe to delete
-            writer.abort()
+            # staged files are provably invisible → safe to delete (close()
+            # already took ownership from the writer, so delete explicitly)
+            from lakesoul_tpu.io.object_store import delete_file
+
+            for out in outputs:
+                delete_file(out.path, self.catalog.storage_options, missing_ok=True)
             raise
         except Exception:
             # any other failure may have happened AFTER the snapshot became
@@ -229,6 +233,76 @@ class LakeSoulTable:
             ),
             CommitOp.DELETE,
         )
+
+    # ----------------------------------------------------------- maintenance
+    def rollback(
+        self,
+        *,
+        to_version: int | None = None,
+        to_timestamp_ms: int | None = None,
+        partitions: dict[str, str] | None = None,
+    ) -> int:
+        """Roll partitions back to an earlier state by committing a NEW
+        version carrying the old snapshot (history is preserved — parity with
+        Spark LakeSoulTable.rollback, tables/LakeSoulTable.scala:341-551).
+        Returns the number of partitions rolled back."""
+        if (to_version is None) == (to_timestamp_ms is None):
+            raise ConfigError("rollback needs exactly one of to_version / to_timestamp_ms")
+        client = self.catalog.client
+        store = client.store
+        heads = client._select_partitions(self._info, partitions)
+        from lakesoul_tpu.meta.entity import MetaInfo, PartitionInfo
+
+        # all partitions in ONE commit: a mid-loop conflict must not leave the
+        # table half rolled back
+        list_partition: list[PartitionInfo] = []
+        read_info: list[PartitionInfo] = []
+        for head in heads:
+            if to_version is not None:
+                target = store.get_partition_info_at_version(
+                    self._info.table_id, head.partition_desc, to_version
+                )
+            else:
+                target = store.get_partition_at_timestamp(
+                    self._info.table_id, head.partition_desc, to_timestamp_ms
+                )
+            if target is None or target.version == head.version:
+                continue
+            list_partition.append(
+                PartitionInfo(
+                    table_id=self._info.table_id,
+                    partition_desc=head.partition_desc,
+                    snapshot=list(target.snapshot),
+                )
+            )
+            read_info.append(head)
+        if not list_partition:
+            return 0
+        client.commit_data(
+            MetaInfo(
+                table_info=self._info,
+                list_partition=list_partition,
+                read_partition_info=read_info,
+            ),
+            CommitOp.UPDATE,  # snapshot REPLACE with conflict detection
+        )
+        return len(list_partition)
+
+    def add_columns(self, fields: list[pa.Field] | pa.Field) -> "LakeSoulTable":
+        """Schema evolution: append nullable columns.  Existing files stay
+        untouched; reads fill the new columns with nulls (reference: Flink
+        auto DDL sync + CanCastSchemaBuilder semantics)."""
+        if isinstance(fields, pa.Field):
+            fields = [fields]
+        schema = self.schema
+        for f in fields:
+            if f.name in schema.names:
+                raise MetadataError(f"column {f.name!r} already exists")
+            if not f.nullable:
+                raise MetadataError(f"added column {f.name!r} must be nullable")
+            schema = schema.append(f)
+        self.catalog.client.update_table_schema(self._info.table_id, schema)
+        return self.refresh()
 
     # ------------------------------------------------------------ compaction
     def compact(self, partitions: dict[str, str] | None = None) -> int:
@@ -279,7 +353,11 @@ class LakeSoulTable:
                     read_partition_info=[head],
                 )
             except CommitConflictError:
-                writer.abort()  # compaction lost the race; staged files invisible
+                # compaction lost the race; staged files provably invisible
+                from lakesoul_tpu.io.object_store import delete_file
+
+                for out in outputs:
+                    delete_file(out.path, self.catalog.storage_options, missing_ok=True)
                 raise
             for f in old_files:
                 client.store.insert_discard_file(f, self._info.table_path, head.partition_desc)

@@ -1,0 +1,21 @@
+"""Daft adapter (parity with python/src/lakesoul/daft/__init__.py:31,44)."""
+
+from __future__ import annotations
+
+
+def read_lakesoul(scan):
+    """LakeSoulScan → daft.DataFrame."""
+    try:
+        import daft
+    except ImportError as e:  # pragma: no cover - daft not in the TPU image
+        raise ImportError("daft is required for read_lakesoul") from e
+    return daft.from_arrow(scan.to_arrow())
+
+
+def write_lakesoul(df, table) -> None:
+    """daft.DataFrame → table (single ACID commit)."""
+    try:
+        import daft  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("daft is required for write_lakesoul") from e
+    table.write_arrow(df.to_arrow())

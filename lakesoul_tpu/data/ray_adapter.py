@@ -1,0 +1,63 @@
+"""Ray Data adapter (parity with python/src/lakesoul/ray/read_lakesoul.py:60,80
+and write_lakesoul.py:23,99): one read task per scan unit; distributed writes
+stage files on workers and the driver commits once."""
+
+from __future__ import annotations
+
+
+def read_lakesoul(scan):
+    """LakeSoulScan → ray.data.Dataset (one block per scan unit)."""
+    try:
+        import ray
+    except ImportError as e:  # pragma: no cover - ray not in the TPU image
+        raise ImportError("ray is required for read_lakesoul") from e
+
+    units = [
+        {"data_files": u.data_files, "primary_keys": u.primary_keys, **scan._unit_kwargs(u)}
+        for u in scan.scan_plan()
+    ]
+
+    def load(unit: dict):
+        from lakesoul_tpu.io.reader import read_scan_unit
+
+        kwargs = {k: v for k, v in unit.items() if k not in ("data_files", "primary_keys")}
+        return read_scan_unit(unit["data_files"], unit["primary_keys"], **kwargs)
+
+    return ray.data.from_items(units).map_batches(
+        lambda b: load(b), batch_format="pyarrow"
+    )
+
+
+def write_lakesoul(dataset, table) -> None:
+    """ray.data.Dataset → table: workers stage parquet via TableWriter, the
+    driver commits all FlushOutputs in one ACID commit (reference: Datasink
+    distributed write + driver-side single commit)."""
+    try:
+        import ray  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("ray is required for write_lakesoul") from e
+
+    cfg = table.io_config()
+    table_path = table.info.table_path
+
+    def stage(batch):
+        from lakesoul_tpu.io.writer import TableWriter
+
+        w = TableWriter(cfg, table_path)
+        w.write_batch(batch)
+        return {"outputs": [w.close()]}
+
+    import pyarrow as pa
+
+    from lakesoul_tpu.meta import CommitOp, DataFileOp
+
+    staged = dataset.map_batches(stage, batch_format="pyarrow").take_all()
+    files_by_partition: dict[str, list[DataFileOp]] = {}
+    for row in staged:
+        for out in row["outputs"]:
+            files_by_partition.setdefault(out.partition_desc, []).append(
+                DataFileOp(path=out.path, file_op="add", size=out.size,
+                           file_exist_cols=out.file_exist_cols)
+            )
+    op = CommitOp.MERGE if table.info.primary_keys else CommitOp.APPEND
+    table.catalog.client.commit_data_files(table.info, files_by_partition, op)

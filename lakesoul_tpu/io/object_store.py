@@ -13,15 +13,61 @@ import os
 
 import fsspec
 
+# storage_options keys consumed by the framework itself (not passed to fsspec)
+OPTION_CACHE_DIR = "lakesoul.cache_dir"
+OPTION_CACHE_DISABLED_PROTOCOLS = ("file", "local", "memory")
 
-def filesystem_for(path: str, storage_options: dict | None = None):
-    """Resolve (fs, normalized_path) for a file or directory path."""
-    fs, p = fsspec.core.url_to_fs(path, **(storage_options or {}))
+
+def filesystem_for(path: str, storage_options: dict | None = None, *, write: bool = False):
+    """Resolve (fs, normalized_path) for a file or directory path.
+
+    When ``storage_options['lakesoul.cache_dir']`` is set and the path is
+    remote, READS go through fsspec's *blockcache* — block-ranged read-through
+    caching, the role of the reference's 16 KiB-page disk cache
+    (rust/lakesoul-io/src/cache/disk_cache.rs): remote ranged GETs land on
+    local disk once and later scans hit the cached blocks without pulling
+    whole objects.  Writes (``write=True``) always bypass the cache."""
+    opts = dict(storage_options or {})
+    cache_dir = opts.pop(OPTION_CACHE_DIR, None)
+    protocol = fsspec.core.split_protocol(path)[0] or "file"
+    if (
+        cache_dir
+        and not write
+        and protocol not in OPTION_CACHE_DISABLED_PROTOCOLS
+    ):
+        fs = fsspec.filesystem(
+            "blockcache",
+            target_protocol=protocol,
+            target_options=opts,
+            cache_storage=str(cache_dir),
+            check_files=False,
+        )
+        _, p = fsspec.core.url_to_fs(path, **opts)
+        return fs, p
+    fs, p = fsspec.core.url_to_fs(path, **opts)
     return fs, p
 
 
+def cache_stats(storage_options: dict | None = None) -> dict:
+    """Best-effort page-cache statistics (reference: cache/stats.rs)."""
+    opts = dict(storage_options or {})
+    cache_dir = opts.get(OPTION_CACHE_DIR)
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return {"files": 0, "bytes": 0}
+    files = 0
+    total = 0
+    for root, _dirs, names in os.walk(cache_dir):
+        for n in names:
+            files += 1
+            try:
+                total += os.path.getsize(os.path.join(root, n))
+            except OSError:
+                pass
+    return {"files": files, "bytes": total}
+
+
 def ensure_dir(path: str, storage_options: dict | None = None) -> None:
-    fs, p = filesystem_for(path, storage_options)
+    fs, p = filesystem_for(path, storage_options, write=True)
     if isinstance(fs, fsspec.implementations.local.LocalFileSystem):
         os.makedirs(p, exist_ok=True)
     else:
@@ -32,7 +78,7 @@ def ensure_dir(path: str, storage_options: dict | None = None) -> None:
 
 
 def delete_file(path: str, storage_options: dict | None = None, missing_ok: bool = True) -> None:
-    fs, p = filesystem_for(path, storage_options)
+    fs, p = filesystem_for(path, storage_options, write=True)
     try:
         fs.rm_file(p)
     except FileNotFoundError:

@@ -63,6 +63,7 @@ class TableWriter:
         self.table_path = table_path.rstrip("/")
         self._cells: dict[tuple[str, int], list[pa.Table]] = {}
         self._staged: list[FlushOutput] = []
+        self._buffered_rows = 0
         self._closed = False
 
     # ------------------------------------------------------------------ write
@@ -78,6 +79,13 @@ class TableWriter:
             return
         for (desc, bucket), piece in self._split(table).items():
             self._cells.setdefault((desc, bucket), []).append(piece)
+        self._buffered_rows += len(table)
+        # bounded memory: spill buffered cells to staged parquet files once
+        # the row budget is hit (role of the reference's memory pool + sort
+        # spill, mem/pool.rs + physical_plan/spill.rs — extra files per cell
+        # simply deepen the merge stack until compaction)
+        if self._buffered_rows >= self.config.max_file_rows:
+            self.flush()
 
     def _split(self, table: pa.Table) -> dict[tuple[str, int], pa.Table]:
         cfg = self.config
@@ -145,7 +153,7 @@ class TableWriter:
                 [f.name for f in cfg.schema if f.name not in cfg.range_partitions]
             )
             path = self._target_path(desc, bucket)
-            fs, p = filesystem_for(path, cfg.object_store_options)
+            fs, p = filesystem_for(path, cfg.object_store_options, write=True)
             pq.write_table(
                 file_table,
                 p,
@@ -166,6 +174,7 @@ class TableWriter:
             outputs.append(out)
             self._staged.append(out)
         self._cells.clear()
+        self._buffered_rows = 0
         return outputs
 
     def _target_path(self, desc: str, bucket: int) -> str:
@@ -176,15 +185,27 @@ class TableWriter:
         suffix = max(bucket, 0)
         return f"{dir_path}/part-{_file_token()}_{suffix:04d}.parquet"
 
+    # ------------------------------------------------------------------ take
+    def take_staged(self) -> list[FlushOutput]:
+        """Hand ownership of every staged-but-untaken output to the caller
+        (for committing).  Taken files are no longer deleted by abort() —
+        once committed they are live table data.  Callers that commit must
+        use this (or close()) rather than flush()'s return value: write_batch
+        may auto-flush on the row budget, staging files between flushes."""
+        out = list(self._staged)
+        self._staged.clear()
+        return out
+
     # ------------------------------------------------------------------ close
     def close(self) -> list[FlushOutput]:
-        """Flush pending data and close; returns ALL staged outputs."""
+        """Flush pending data and close; returns all untaken staged outputs."""
         self.flush()
         self._closed = True
-        return list(self._staged)
+        return self.take_staged()
 
     def abort(self) -> None:
-        """Discard buffers and delete every staged file."""
+        """Discard buffers and delete every staged file not yet taken for
+        commit."""
         self._cells.clear()
         for out in self._staged:
             delete_file(out.path, self.config.object_store_options, missing_ok=True)

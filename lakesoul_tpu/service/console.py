@@ -16,18 +16,34 @@ import pyarrow as pa
 
 
 class Console:
+    SQL_STARTS = ("select", "insert", "create", "drop", "show", "describe")
+
     def __init__(self, catalog):
         self.catalog = catalog
+        from lakesoul_tpu.sql import SqlSession
+
+        self.sql = SqlSession(catalog)
 
     def execute(self, line: str) -> str:
-        toks = shlex.split(line.strip())
-        if not toks:
+        stripped = line.strip().rstrip(";")
+        if not stripped:
             return ""
-        cmd, args = toks[0].lower(), toks[1:]
-        handler = getattr(self, f"cmd_{cmd}", None)
-        if handler is None:
-            return f"unknown command: {cmd!r} (try 'help')"
+        words = stripped.lower().split()
+        first = words[0]
+        # `show`/`drop` are both console commands and SQL keywords: the SQL
+        # forms are `show tables` / `drop table …`
+        is_sql = first in self.SQL_STARTS and not (
+            (first == "show" and (len(words) < 2 or words[1] != "tables"))
+            or (first == "drop" and (len(words) < 2 or words[1] != "table"))
+        )
         try:
+            if is_sql:
+                return self.sql.execute(stripped).to_pandas().to_string()
+            toks = shlex.split(stripped)
+            cmd, args = toks[0].lower(), toks[1:]
+            handler = getattr(self, f"cmd_{cmd}", None)
+            if handler is None:
+                return f"unknown command: {cmd!r} (try 'help')"
             return handler(args)
         except Exception as e:  # surfaced, not fatal — it's a REPL
             return f"error: {type(e).__name__}: {e}"
@@ -35,6 +51,7 @@ class Console:
     # ---------------------------------------------------------------- cmds
     def cmd_help(self, args) -> str:
         return (
+            "SQL: SELECT / INSERT INTO / CREATE TABLE / DROP TABLE / SHOW TABLES / DESCRIBE\n"
             "commands:\n"
             "  tables                       list tables\n"
             "  show <table>                 schema + properties\n"

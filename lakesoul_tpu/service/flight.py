@@ -205,7 +205,9 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             if checkpoint_id is not None:
                 w.checkpoint(checkpoint_id)  # exactly-once epoch commit
             else:
-                outputs = w._ensure_writer().flush()
+                writer = w._ensure_writer()
+                writer.flush()
+                outputs = writer.take_staged()
                 if outputs:
                     from lakesoul_tpu.meta import DataFileOp
 
@@ -249,6 +251,31 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             return [flight.Result(json.dumps({"compacted": n}).encode())]
         if action.type == "metrics":
             return [flight.Result(json.dumps(self.metrics.snapshot()).encode())]
+        if action.type == "sql":
+            # statement execution, Flight-SQL style: result as Arrow IPC bytes
+            from lakesoul_tpu.sql import SqlSession
+            from lakesoul_tpu.sql.parser import SqlError, parse as parse_sql
+
+            ns = body.get("namespace", "default")
+            stmt_text = (body.get("statement") or "").strip()
+            if not stmt_text:
+                raise flight.FlightServerError("empty SQL statement")
+            try:
+                stmt = parse_sql(stmt_text)
+            except SqlError as e:
+                raise flight.FlightServerError(str(e))
+            # same per-table RBAC as do_get/do_put: any statement touching an
+            # existing table is checked (CREATE TABLE targets a new one)
+            target = getattr(stmt, "table", None)
+            from lakesoul_tpu.sql.parser import CreateTable
+
+            if target and not isinstance(stmt, CreateTable):
+                self._check(context, ns, target)
+            result = SqlSession(self.catalog, ns).execute(stmt_text)
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, result.schema) as w:
+                w.write_table(result)
+            return [flight.Result(sink.getvalue().to_pybytes())]
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
@@ -257,6 +284,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             ("drop_table", "drop a table; body: {table, namespace?}"),
             ("compact", "compact a table; body: {table, namespace?, partitions?}"),
             ("metrics", "server stream metrics snapshot"),
+            ("sql", "execute a SQL statement; body: {statement, namespace?}"),
         ]
 
 

@@ -1,0 +1,3 @@
+from lakesoul_tpu.sql.executor import SqlSession
+
+__all__ = ["SqlSession"]

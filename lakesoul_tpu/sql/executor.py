@@ -1,0 +1,203 @@
+"""SQL execution over catalog scans.
+
+Role parity with rust/lakesoul-datafusion's embedded engine: the WHERE tree
+becomes the framework's portable Filter (predicate pushdown + bucket pruning
+for free), projections push into the scan, aggregates/sorts run on Arrow
+compute kernels.  INSERT/CREATE/DROP route through the ACID catalog paths."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.sql import parser as ast
+from lakesoul_tpu.sql.parser import SqlError, parse
+
+_TYPE_MAP = {
+    "bigint": pa.int64(),
+    "long": pa.int64(),
+    "int": pa.int32(),
+    "integer": pa.int32(),
+    "smallint": pa.int16(),
+    "tinyint": pa.int8(),
+    "double": pa.float64(),
+    "float": pa.float32(),
+    "real": pa.float32(),
+    "string": pa.string(),
+    "varchar": pa.string(),
+    "text": pa.string(),
+    "bool": pa.bool_(),
+    "boolean": pa.bool_(),
+    "timestamp": pa.timestamp("us"),
+    "date": pa.date32(),
+    "binary": pa.binary(),
+}
+
+
+def _where_to_filter(node) -> Filter:
+    if isinstance(node, ast.Compare):
+        return Filter(op=node.op, col=node.col, value=node.value)
+    if isinstance(node, ast.InList):
+        return Filter(op="in", col=node.col, value=list(node.values))
+    if isinstance(node, ast.IsNull):
+        return Filter(op="not_null" if node.negated else "is_null", col=node.col)
+    if isinstance(node, ast.BoolOp):
+        args = tuple(_where_to_filter(a) for a in node.args)
+        return Filter(op=node.op, args=args)
+    if isinstance(node, ast.NotOp):
+        return Filter(op="not", args=(_where_to_filter(node.arg),))
+    raise SqlError(f"unsupported WHERE node {node!r}")
+
+
+class SqlSession:
+    """Execute SQL statements against a catalog; results are Arrow tables."""
+
+    def __init__(self, catalog, namespace: str = "default"):
+        self.catalog = catalog
+        self.namespace = namespace
+
+    def execute(self, sql: str) -> pa.Table:
+        stmt = parse(sql)
+        if isinstance(stmt, ast.Select):
+            return self._select(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._create(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._drop(stmt)
+        if isinstance(stmt, ast.ShowTables):
+            return pa.table({"table_name": sorted(self.catalog.list_tables(self.namespace))})
+        if isinstance(stmt, ast.Describe):
+            t = self.catalog.table(stmt.table, self.namespace)
+            return pa.table(
+                {
+                    "column": [f.name for f in t.schema],
+                    "type": [str(f.type) for f in t.schema],
+                    "primary_key": [f.name in t.primary_keys for f in t.schema],
+                }
+            )
+        raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------- DQL
+    def _select(self, stmt: ast.Select) -> pa.Table:
+        scan = self.catalog.table(stmt.table, self.namespace).scan()
+        if stmt.where is not None:
+            scan = scan.filter(_where_to_filter(stmt.where))
+
+        aggs = [it for it in stmt.items if isinstance(it.expr, ast.Agg)]
+        plain = [it for it in stmt.items if isinstance(it.expr, ast.Column)]
+
+        if aggs:
+            needed = list(stmt.group_by)
+            for it in aggs:
+                if it.expr.arg and it.expr.arg not in needed:
+                    needed.append(it.expr.arg)
+            table = (scan.select(needed) if needed else scan).to_arrow()
+            out = self._aggregate(stmt, table)
+        else:
+            if not stmt.star:
+                cols = [it.expr.name for it in plain]
+                scan = scan.select(cols)
+            out = scan.to_arrow()
+            renames = {
+                it.expr.name: it.alias for it in plain if it.alias
+            }
+            if renames:
+                out = out.rename_columns([renames.get(c, c) for c in out.column_names])
+
+        for col_name, desc in reversed(stmt.order_by):
+            out = out.sort_by([(col_name, "descending" if desc else "ascending")])
+        if stmt.limit is not None:
+            out = out.slice(0, stmt.limit)
+        return out
+
+    def _aggregate(self, stmt: ast.Select, table: pa.Table) -> pa.Table:
+        fn_map = {"count": "count", "sum": "sum", "min": "min", "max": "max", "avg": "mean"}
+        if stmt.group_by:
+            specs = []
+            names = []
+            for it in stmt.items:
+                if isinstance(it.expr, ast.Agg):
+                    agg = it.expr
+                    target = agg.arg or stmt.group_by[0]
+                    pa_fn = "count" if agg.arg is None else fn_map[agg.fn]
+                    specs.append((target, pa_fn))
+                    names.append(it.alias or f"{agg.fn}({agg.arg or '*'})")
+                elif it.expr.name not in stmt.group_by:
+                    raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
+            grouped = table.group_by(stmt.group_by).aggregate(specs)
+            # pyarrow names results "<col>_<fn>"; rename to requested labels
+            rename = {}
+            for (target, pa_fn), label in zip(specs, names):
+                rename[f"{target}_{pa_fn}"] = label
+            cols, labels = [], []
+            for it in stmt.items:
+                if isinstance(it.expr, ast.Column):
+                    cols.append(grouped.column(it.expr.name))
+                    labels.append(it.alias or it.expr.name)
+            for (target, pa_fn), label in zip(specs, names):
+                cols.append(grouped.column(f"{target}_{pa_fn}"))
+                labels.append(label)
+            return pa.table(dict(zip(labels, cols)))
+        # global aggregates
+        cols, labels = [], []
+        for it in stmt.items:
+            agg = it.expr
+            if not isinstance(agg, ast.Agg):
+                raise SqlError("mixing plain columns with global aggregates needs GROUP BY")
+            if agg.arg is None:
+                value = pa.array([table.num_rows], type=pa.int64())
+            else:
+                col = table.column(agg.arg)
+                fn = fn_map[agg.fn]
+                value = pa.array([getattr(pc, fn)(col).as_py()])
+            cols.append(value)
+            labels.append(it.alias or f"{agg.fn}({agg.arg or '*'})")
+        return pa.table(dict(zip(labels, cols)))
+
+    # ------------------------------------------------------------------- DML
+    def _insert(self, stmt: ast.Insert) -> pa.Table:
+        t = self.catalog.table(stmt.table, self.namespace)
+        schema = t.schema
+        columns = stmt.columns or [f.name for f in schema]
+        if any(len(r) != len(columns) for r in stmt.rows):
+            raise SqlError("VALUES row arity does not match column list")
+        data = {}
+        for i, name in enumerate(columns):
+            fld = schema.field(name)
+            data[name] = pa.array([r[i] for r in stmt.rows], type=fld.type)
+        t.write_arrow(pa.table(data, schema=pa.schema([schema.field(c) for c in columns])))
+        return pa.table({"inserted": pa.array([len(stmt.rows)], type=pa.int64())})
+
+    # ------------------------------------------------------------------- DDL
+    def _create(self, stmt: ast.CreateTable) -> pa.Table:
+        if stmt.if_not_exists and self.catalog.table_exists(stmt.table, self.namespace):
+            return pa.table({"status": ["exists"]})
+        fields = []
+        pks = []
+        for c in stmt.columns:
+            if c.type_name not in _TYPE_MAP:
+                raise SqlError(f"unknown type {c.type_name!r}")
+            fields.append(pa.field(c.name, _TYPE_MAP[c.type_name]))
+            if c.primary_key:
+                pks.append(c.name)
+        props = {str(k): str(v) for k, v in stmt.properties.items()}
+        hash_bucket_num = props.pop("hashBucketNum", None)
+        self.catalog.create_table(
+            stmt.table,
+            pa.schema(fields),
+            primary_keys=pks or None,
+            range_partitions=stmt.range_partitions or None,
+            hash_bucket_num=int(hash_bucket_num) if hash_bucket_num else None,
+            properties=props or None,
+            namespace=self.namespace,
+        )
+        return pa.table({"status": ["ok"]})
+
+    def _drop(self, stmt: ast.DropTable) -> pa.Table:
+        if stmt.if_exists and not self.catalog.table_exists(stmt.table, self.namespace):
+            return pa.table({"status": ["absent"]})
+        self.catalog.drop_table(stmt.table, self.namespace)
+        return pa.table({"status": ["ok"]})

@@ -1,0 +1,430 @@
+"""Minimal SQL parser: tokenizer + recursive descent → dataclass AST.
+
+Covers the statement surface the reference exposes through its embedded SQL
+engines (rust/lakesoul-datafusion catalog/TableProvider + console):
+SELECT (projection, WHERE, GROUP BY, ORDER BY, LIMIT, aggregates), INSERT
+INTO … VALUES, CREATE TABLE (with PRIMARY KEY / PARTITIONED BY / WITH
+properties), DROP TABLE, SHOW TABLES, DESCRIBE.  WHERE trees compile to the
+framework's portable Filter AST so predicate pushdown works unchanged."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+from lakesoul_tpu.errors import LakeSoulError
+
+
+class SqlError(LakeSoulError):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>-?\d+\.\d+|-?\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\.)
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "order", "by", "limit", "as", "and",
+    "or", "not", "in", "is", "null", "asc", "desc", "insert", "into",
+    "values", "create", "table", "drop", "show", "tables", "describe",
+    "primary", "key", "partitioned", "with", "if", "exists", "distinct",
+    "count", "sum", "min", "max", "avg", "true", "false",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # number | string | op | ident | kw
+    value: str
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlError(f"cannot tokenize SQL at: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        value = m.group()
+        if kind == "ident" and value.lower() in KEYWORDS:
+            tokens.append(Token("kw", value.lower()))
+        else:
+            tokens.append(Token(kind, value))
+    return tokens
+
+
+# ----------------------------------------------------------------- AST nodes
+@dataclass
+class Column:
+    name: str
+
+
+@dataclass
+class Literal:
+    value: Any
+
+
+@dataclass
+class Agg:
+    fn: str  # count | sum | min | max | avg
+    arg: str | None  # None = count(*)
+    alias: str | None = None
+
+
+@dataclass
+class SelectItem:
+    expr: Column | Agg
+    alias: str | None = None
+
+
+@dataclass
+class Compare:
+    op: str
+    col: str
+    value: Any
+
+
+@dataclass
+class InList:
+    col: str
+    values: list
+
+
+@dataclass
+class IsNull:
+    col: str
+    negated: bool
+
+
+@dataclass
+class BoolOp:
+    op: str  # and | or
+    args: list
+
+
+@dataclass
+class NotOp:
+    arg: Any
+
+
+@dataclass
+class Select:
+    items: list[SelectItem]
+    star: bool
+    table: str
+    where: Any = None
+    group_by: list[str] = field(default_factory=list)
+    order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
+    limit: int | None = None
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: list[str]
+    rows: list[list]
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    primary_key: bool = False
+
+
+@dataclass
+class CreateTable:
+    table: str
+    columns: list[ColumnDef]
+    range_partitions: list[str] = field(default_factory=list)
+    properties: dict = field(default_factory=dict)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable:
+    table: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowTables:
+    pass
+
+
+@dataclass
+class Describe:
+    table: str
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.pos = 0
+
+    # ------------------------------------------------------------- plumbing
+    def peek(self) -> Token | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> Token:
+        tok = self.peek()
+        if tok is None:
+            raise SqlError("unexpected end of statement")
+        self.pos += 1
+        return tok
+
+    def accept(self, kind: str, value: str | None = None) -> Token | None:
+        tok = self.peek()
+        if tok and tok.kind == kind and (value is None or tok.value == value):
+            self.pos += 1
+            return tok
+        return None
+
+    def expect(self, kind: str, value: str | None = None) -> Token:
+        tok = self.accept(kind, value)
+        if tok is None:
+            have = self.peek()
+            raise SqlError(f"expected {value or kind}, got {have.value if have else 'EOF'!r}")
+        return tok
+
+    def ident(self) -> str:
+        tok = self.next()
+        if tok.kind not in ("ident", "kw"):
+            raise SqlError(f"expected identifier, got {tok.value!r}")
+        return tok.value
+
+    # ------------------------------------------------------------ statements
+    def parse(self):
+        tok = self.peek()
+        if tok is None:
+            raise SqlError("empty statement")
+        dispatch = {
+            "select": self.parse_select,
+            "insert": self.parse_insert,
+            "create": self.parse_create,
+            "drop": self.parse_drop,
+            "show": self.parse_show,
+            "describe": self.parse_describe,
+        }
+        if tok.kind != "kw" or tok.value not in dispatch:
+            raise SqlError(f"unsupported statement start {tok.value!r}")
+        stmt = dispatch[tok.value]()
+        if self.peek() is not None and not self.accept("op", ";"):
+            extra = self.peek()
+            if extra is not None:
+                raise SqlError(f"unexpected trailing token {extra.value!r}")
+        return stmt
+
+    def parse_select(self) -> Select:
+        self.expect("kw", "select")
+        star = False
+        items: list[SelectItem] = []
+        if self.accept("op", "*"):
+            star = True
+        else:
+            while True:
+                items.append(self._select_item())
+                if not self.accept("op", ","):
+                    break
+        self.expect("kw", "from")
+        table = self.ident()
+        sel = Select(items=items, star=star, table=table)
+        if self.accept("kw", "where"):
+            sel.where = self._bool_expr()
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            sel.group_by.append(self.ident())
+            while self.accept("op", ","):
+                sel.group_by.append(self.ident())
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                col = self.ident()
+                desc = False
+                if self.accept("kw", "desc"):
+                    desc = True
+                else:
+                    self.accept("kw", "asc")
+                sel.order_by.append((col, desc))
+                if not self.accept("op", ","):
+                    break
+        if self.accept("kw", "limit"):
+            sel.limit = int(self.expect("number").value)
+        return sel
+
+    def _select_item(self) -> SelectItem:
+        tok = self.peek()
+        if tok.kind == "kw" and tok.value in ("count", "sum", "min", "max", "avg"):
+            fn = self.next().value
+            self.expect("op", "(")
+            if self.accept("op", "*"):
+                arg = None
+                if fn != "count":
+                    raise SqlError(f"{fn}(*) not supported")
+            else:
+                arg = self.ident()
+            self.expect("op", ")")
+            alias = self.ident() if self.accept("kw", "as") else None
+            return SelectItem(Agg(fn, arg), alias)
+        name = self.ident()
+        alias = self.ident() if self.accept("kw", "as") else None
+        return SelectItem(Column(name), alias)
+
+    # ------------------------------------------------------------- where expr
+    def _bool_expr(self):
+        left = self._bool_term()
+        while self.accept("kw", "or"):
+            right = self._bool_term()
+            if isinstance(left, BoolOp) and left.op == "or":
+                left.args.append(right)
+            else:
+                left = BoolOp("or", [left, right])
+        return left
+
+    def _bool_term(self):
+        left = self._bool_factor()
+        while self.accept("kw", "and"):
+            right = self._bool_factor()
+            if isinstance(left, BoolOp) and left.op == "and":
+                left.args.append(right)
+            else:
+                left = BoolOp("and", [left, right])
+        return left
+
+    def _bool_factor(self):
+        if self.accept("kw", "not"):
+            return NotOp(self._bool_factor())
+        if self.accept("op", "("):
+            e = self._bool_expr()
+            self.expect("op", ")")
+            return e
+        return self._predicate()
+
+    def _predicate(self):
+        col = self.ident()
+        if self.accept("kw", "is"):
+            negated = bool(self.accept("kw", "not"))
+            self.expect("kw", "null")
+            return IsNull(col, negated)
+        if self.accept("kw", "not"):
+            self.expect("kw", "in")
+            return NotOp(InList(col, self._value_list()))
+        if self.accept("kw", "in"):
+            return InList(col, self._value_list())
+        op_tok = self.next()
+        op_map = {"=": "eq", "!=": "ne", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+        if op_tok.kind != "op" or op_tok.value not in op_map:
+            raise SqlError(f"expected comparison operator, got {op_tok.value!r}")
+        return Compare(op_map[op_tok.value], col, self._value())
+
+    def _value_list(self) -> list:
+        self.expect("op", "(")
+        vals = [self._value()]
+        while self.accept("op", ","):
+            vals.append(self._value())
+        self.expect("op", ")")
+        return vals
+
+    def _value(self):
+        tok = self.next()
+        if tok.kind == "number":
+            return float(tok.value) if "." in tok.value else int(tok.value)
+        if tok.kind == "string":
+            return tok.value[1:-1].replace("''", "'")
+        if tok.kind == "kw" and tok.value in ("true", "false"):
+            return tok.value == "true"
+        if tok.kind == "kw" and tok.value == "null":
+            return None
+        raise SqlError(f"expected literal, got {tok.value!r}")
+
+    # ---------------------------------------------------------------- others
+    def parse_insert(self) -> Insert:
+        self.expect("kw", "insert")
+        self.expect("kw", "into")
+        table = self.ident()
+        columns: list[str] = []
+        if self.accept("op", "("):
+            columns.append(self.ident())
+            while self.accept("op", ","):
+                columns.append(self.ident())
+            self.expect("op", ")")
+        self.expect("kw", "values")
+        rows = [self._value_list()]
+        while self.accept("op", ","):
+            rows.append(self._value_list())
+        return Insert(table, columns, rows)
+
+    def parse_create(self) -> CreateTable:
+        self.expect("kw", "create")
+        self.expect("kw", "table")
+        if_not_exists = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "not")
+            self.expect("kw", "exists")
+            if_not_exists = True
+        table = self.ident()
+        self.expect("op", "(")
+        cols = []
+        while True:
+            name = self.ident()
+            type_name = self.ident()
+            pk = False
+            if self.accept("kw", "primary"):
+                self.expect("kw", "key")
+                pk = True
+            cols.append(ColumnDef(name, type_name.lower(), pk))
+            if not self.accept("op", ","):
+                break
+        self.expect("op", ")")
+        stmt = CreateTable(table, cols, if_not_exists=if_not_exists)
+        if self.accept("kw", "partitioned"):
+            self.expect("kw", "by")
+            self.expect("op", "(")
+            stmt.range_partitions.append(self.ident())
+            while self.accept("op", ","):
+                stmt.range_partitions.append(self.ident())
+            self.expect("op", ")")
+        if self.accept("kw", "with"):
+            self.expect("op", "(")
+            while True:
+                key = self._value() if self.peek().kind == "string" else self.ident()
+                self.expect("op", "=")
+                stmt.properties[str(key)] = self._value()
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        return stmt
+
+    def parse_drop(self) -> DropTable:
+        self.expect("kw", "drop")
+        self.expect("kw", "table")
+        if_exists = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "exists")
+            if_exists = True
+        return DropTable(self.ident(), if_exists)
+
+    def parse_show(self) -> ShowTables:
+        self.expect("kw", "show")
+        self.expect("kw", "tables")
+        return ShowTables()
+
+    def parse_describe(self) -> Describe:
+        self.expect("kw", "describe")
+        return Describe(self.ident())
+
+
+def parse(sql: str):
+    return Parser(sql).parse()

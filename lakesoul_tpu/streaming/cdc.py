@@ -63,7 +63,10 @@ class CheckpointedWriter:
         Returns the number of partitions committed (0 on replay/no data)."""
         if self._writer is None:
             return 0
-        outputs = self._writer.flush()
+        self._writer.flush()
+        # take_staged, not flush()'s return: write_batch may have auto-flushed
+        # earlier files of this epoch on the row budget
+        outputs = self._writer.take_staged()
         if not outputs:
             return 0
         files_by_partition: dict[str, list[DataFileOp]] = {}

@@ -39,7 +39,7 @@ class ManifestStore:
     def __init__(self, root: str, storage_options: dict | None = None):
         self.root = root.rstrip("/")
         self.storage_options = storage_options or {}
-        self.fs, self.root_path = filesystem_for(self.root, self.storage_options)
+        self.fs, self.root_path = filesystem_for(self.root, self.storage_options, write=True)
 
     # ------------------------------------------------------------------ write
     def write_index(self, index: IvfRabitqIndex, *, generation: int | None = None) -> int:

@@ -159,3 +159,25 @@ class TestCdcIngestor:
         assert inc.column("id").to_pylist() == [3, 99]
         feats = jnp.asarray(inc.column("v").to_numpy(zero_copy_only=False))
         assert float(feats.sum()) == 132.0
+
+
+class TestAutoFlushCheckpointInteraction:
+    def test_checkpoint_commits_auto_flushed_files(self, catalog):
+        # write_batch auto-flushes on the row budget; the checkpoint must
+        # commit those files too, not just the final flush's
+        t = catalog.create_table("af", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        w = CheckpointedWriter(t)
+        w._ensure_writer().config.max_file_rows = 50
+        for i in range(5):
+            w.write(pa.table({"id": np.arange(i * 40, (i + 1) * 40), "v": np.zeros(40)}))
+        assert w.checkpoint(1) >= 1
+        assert t.to_arrow().num_rows == 200  # every auto-flushed file committed
+
+    def test_abort_after_checkpoint_keeps_committed_files(self, catalog):
+        t = catalog.create_table("af2", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        w = CheckpointedWriter(t)
+        w.write(pa.table({"id": [1], "v": [1.0]}))
+        w.checkpoint(1)
+        w.write(pa.table({"id": [2], "v": [2.0]}))
+        w.abort()  # must only discard the uncommitted epoch
+        assert t.to_arrow().column("id").to_pylist() == [1]

@@ -1,0 +1,141 @@
+"""Mini SQL engine tests: parse + execute over lakehouse tables."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.sql import SqlSession
+from lakesoul_tpu.sql.parser import SqlError, parse
+
+
+@pytest.fixture()
+def session(tmp_warehouse):
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    s = SqlSession(catalog)
+    s.execute(
+        "CREATE TABLE users (id bigint PRIMARY KEY, name string, age int, city string)"
+        " WITH (hashBucketNum = '2')"
+    )
+    s.execute(
+        "INSERT INTO users VALUES"
+        " (1, 'alice', 30, 'sf'), (2, 'bob', 25, 'nyc'),"
+        " (3, 'carol', 35, 'sf'), (4, 'dave', 28, 'nyc')"
+    )
+    return s
+
+
+class TestParser:
+    def test_select_parse(self):
+        stmt = parse(
+            "SELECT id, name AS n FROM t WHERE age > 20 AND city = 'sf'"
+            " ORDER BY id DESC LIMIT 5"
+        )
+        assert stmt.table == "t" and stmt.limit == 5
+        assert stmt.order_by == [("id", True)]
+        assert stmt.where.op == "and"
+
+    def test_string_escapes_and_floats(self):
+        stmt = parse("SELECT a FROM t WHERE s = 'it''s' AND x >= -1.5")
+        comps = stmt.where.args
+        assert comps[0].value == "it's"
+        assert comps[1].value == -1.5
+
+    def test_errors(self):
+        with pytest.raises(SqlError):
+            parse("SELEC x FROM t")
+        with pytest.raises(SqlError):
+            parse("SELECT FROM t")
+        with pytest.raises(SqlError):
+            parse("SELECT a FROM t WHERE")
+        with pytest.raises(SqlError):
+            parse("SELECT a FROM t extra garbage")
+
+
+class TestExecute:
+    def test_select_where_order_limit(self, session):
+        out = session.execute(
+            "SELECT id, name FROM users WHERE city = 'sf' ORDER BY id"
+        )
+        assert out.column("id").to_pylist() == [1, 3]
+        out2 = session.execute("SELECT * FROM users ORDER BY age DESC LIMIT 2")
+        assert out2.column("name").to_pylist() == ["carol", "alice"]
+
+    def test_in_and_null_predicates(self, session):
+        session.execute("INSERT INTO users (id, name) VALUES (5, 'eve')")
+        out = session.execute("SELECT id FROM users WHERE age IS NULL")
+        assert out.column("id").to_pylist() == [5]
+        out2 = session.execute("SELECT id FROM users WHERE id IN (2, 5) ORDER BY id")
+        assert out2.column("id").to_pylist() == [2, 5]
+        out3 = session.execute(
+            "SELECT id FROM users WHERE id NOT IN (1, 2, 3, 5) AND age IS NOT NULL"
+        )
+        assert out3.column("id").to_pylist() == [4]
+
+    def test_global_aggregates(self, session):
+        out = session.execute("SELECT count(*) AS n, avg(age) AS a, max(age) FROM users")
+        assert out.column("n").to_pylist() == [4]
+        assert out.column("a").to_pylist() == [29.5]
+        assert out.column("max(age)").to_pylist() == [35]
+
+    def test_group_by(self, session):
+        out = session.execute(
+            "SELECT city, count(*) AS n, avg(age) AS mean_age FROM users"
+            " GROUP BY city ORDER BY city"
+        )
+        assert out.column("city").to_pylist() == ["nyc", "sf"]
+        assert out.column("n").to_pylist() == [2, 2]
+        assert out.column("mean_age").to_pylist() == [26.5, 32.5]
+
+    def test_upsert_semantics_via_insert(self, session):
+        session.execute("INSERT INTO users VALUES (1, 'ALICE', 31, 'sf')")
+        out = session.execute("SELECT name, age FROM users WHERE id = 1")
+        assert out.column("name").to_pylist() == ["ALICE"]  # PK upsert merged
+
+    def test_show_describe_drop(self, session):
+        assert "users" in session.execute("SHOW TABLES").column("table_name").to_pylist()
+        desc = session.execute("DESCRIBE users")
+        assert desc.column("primary_key").to_pylist()[0] is True
+        session.execute("DROP TABLE users")
+        assert session.execute("SHOW TABLES").num_rows == 0
+        assert session.execute("DROP TABLE IF EXISTS users").column("status").to_pylist() == ["absent"]
+
+    def test_create_partitioned(self, session):
+        session.execute(
+            "CREATE TABLE ev (id bigint PRIMARY KEY, v double, day string)"
+            " PARTITIONED BY (day)"
+        )
+        session.execute("INSERT INTO ev VALUES (1, 0.5, 'd1'), (2, 1.5, 'd2')")
+        out = session.execute("SELECT id FROM ev WHERE day = 'd2'")
+        assert out.column("id").to_pylist() == [2]
+        t = session.catalog.table("ev")
+        assert t.info.range_partition_columns == ["day"]
+
+
+class TestSqlOverFlight:
+    def test_sql_action(self, tmp_warehouse):
+        from lakesoul_tpu.service.flight import LakeSoulFlightClient, LakeSoulFlightServer
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        SqlSession(catalog).execute("CREATE TABLE t (id bigint PRIMARY KEY, v double)")
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0")
+        try:
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            client.action("sql", {"statement": "INSERT INTO t VALUES (1, 2.5)"})
+            raw = client.action("sql", {"statement": "SELECT * FROM t"})[0]
+            result = pa.ipc.open_stream(raw).read_all()
+            assert result.column("v").to_pylist() == [2.5]
+        finally:
+            server.shutdown()
+
+
+class TestSqlConsole:
+    def test_sql_in_console(self, tmp_warehouse):
+        from lakesoul_tpu.service.console import Console
+
+        c = Console(LakeSoulCatalog(str(tmp_warehouse)))
+        c.execute("CREATE TABLE t (id bigint, v double)")
+        c.execute("INSERT INTO t VALUES (1, 1.0), (2, 2.0)")
+        out = c.execute("SELECT count(*) AS n FROM t")
+        assert "2" in out
+        assert "error" in c.execute("SELECT * FROM missing_table")
