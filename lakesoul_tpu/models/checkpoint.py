@@ -1,0 +1,57 @@
+"""Training-state checkpoints via Orbax.
+
+Completes the checkpoint/resume story (SURVEY §5): the *storage* layer has
+version-chain time travel; this covers the *model* side — params/opt_state
+snapshots with step numbering, save/restore/latest, sharding-aware restore
+onto a mesh."""
+
+from __future__ import annotations
+
+
+class TrainCheckpointer:
+    """Save/restore (params, opt_state, step) under a directory.
+
+    ::
+
+        ckpt = TrainCheckpointer(f"{warehouse}/_checkpoints/bert")
+        ckpt.save(step, params, opt_state)
+        params, opt_state, step = ckpt.restore_latest(
+            like=(params, opt_state))   # `like` carries shardings/dtypes
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, params, opt_state) -> None:
+        self._mngr.save(
+            step,
+            args=self._ocp.args.StandardSave({"params": params, "opt_state": opt_state}),
+        )
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore_latest(self, *, like=None):
+        """→ (params, opt_state, step); ``like=(params, opt_state)`` restores
+        with the same shardings/structure as the live state."""
+        step = self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        if like is not None:
+            template = {"params": like[0], "opt_state": like[1]}
+            restored = self._mngr.restore(
+                step, args=self._ocp.args.StandardRestore(template)
+            )
+        else:
+            restored = self._mngr.restore(step)
+        return restored["params"], restored["opt_state"], step
+
+    def close(self) -> None:
+        self._mngr.close()

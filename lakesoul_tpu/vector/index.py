@@ -51,6 +51,8 @@ class IvfRabitqIndex:
         self.clusters: list[_Cluster] = []
         self.deltas: list[list[_Cluster]] = []
         self.keep_raw = True
+        self._device_cache_enabled = False
+        self._device_bundle = None
 
     # ------------------------------------------------------------------ train
     @classmethod
@@ -130,6 +132,7 @@ class IvfRabitqIndex:
             - 2.0 * vectors @ self.centroids.T
             + np.sum(self.centroids**2, axis=1)[None, :]
         )
+        self._invalidate_device_cache()
         assign = np.argmin(d2, axis=1)
         for c in np.unique(assign):
             m = assign == c
@@ -139,6 +142,7 @@ class IvfRabitqIndex:
 
     def merge_deltas(self) -> None:
         """Fold delta segments into base clusters (compaction of the index)."""
+        self._invalidate_device_cache()
         for c, deltas in enumerate(self.deltas):
             if not deltas:
                 continue
@@ -163,11 +167,113 @@ class IvfRabitqIndex:
             len(s.ids) for ds in self.deltas for s in ds
         )
 
+    # ------------------------------------------------------- device residency
+    def enable_device_cache(self) -> None:
+        """Pin the shard's arrays in device HBM: subsequent searches upload
+        only the query + per-cluster scalars (one device call, no candidate
+        re-upload).  Invalidated automatically by insert/merge."""
+        self._device_cache_enabled = True
+
+    def _invalidate_device_cache(self) -> None:
+        self._device_bundle = None
+
+    def _get_device_bundle(self):
+        import jax.numpy as jnp
+
+        from lakesoul_tpu.vector.kernels import _pow2_bucket
+
+        bundle = getattr(self, "_device_bundle", None)
+        if bundle is not None:
+            return bundle
+        segs = [
+            (c, seg)
+            for c in range(len(self.clusters))
+            for seg in self._cluster_segments(c)
+            if len(seg.ids)
+        ]
+        if not segs:
+            return None
+        codes = np.concatenate([s.codes for _, s in segs])
+        n = len(codes)
+        n_pad = _pow2_bucket(n)
+        pad = n_pad - n
+
+        def padded(a, const=0.0, dtype=np.float32):
+            a = np.asarray(a, dtype)
+            return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1), constant_values=const)
+
+        from lakesoul_tpu.vector.kernels import PAD_FACTOR, PAD_NORM, PAD_RAW
+
+        bundle = {
+            "codes": jnp.asarray(np.pad(codes, ((0, pad), (0, 0)))),
+            "norms": jnp.asarray(padded(np.concatenate([s.norms for _, s in segs]), PAD_NORM)),
+            "factors": jnp.asarray(padded(np.concatenate([s.factors for _, s in segs]), PAD_FACTOR)),
+            "cdc": jnp.asarray(padded(np.concatenate([np.asarray(s.code_dot_c) for _, s in segs]))),
+            "cluster_id": jnp.asarray(
+                np.pad(
+                    np.concatenate(
+                        [np.full(len(s.ids), c, np.int32) for c, s in segs]
+                    ),
+                    (0, pad),
+                )
+            ),
+            "raw": (
+                jnp.asarray(
+                    np.pad(
+                        np.concatenate([s.raw for _, s in segs]),
+                        ((0, pad), (0, 0)),
+                        constant_values=PAD_RAW,
+                    )
+                )
+                if self.keep_raw and all(s.raw is not None for _, s in segs)
+                else None
+            ),
+            "ids": np.concatenate([s.ids for _, s in segs]),  # host side
+            "n": n,
+        }
+        self._device_bundle = bundle
+        return bundle
+
+    def _search_device_resident(self, query, params: SearchParams, probe):
+        import jax.numpy as jnp
+
+        from lakesoul_tpu.vector.kernels import _fused_search_resident, _on_tpu
+
+        bundle = self._get_device_bundle()
+        if bundle is None:
+            return np.zeros(0, np.uint64), np.zeros(0, np.float32)
+        q_glob = self.quantizer.rotate(query)
+        xc = self._rotated_centroids() - q_glob[None, :]
+        csq_c = np.sum(xc * xc, axis=1).astype(np.float32)
+        csum_c = np.sum(xc, axis=1).astype(np.float32)
+        probe_mask = np.zeros(len(self.centroids), dtype=bool)
+        probe_mask[probe] = True
+        do_rerank = bundle["raw"] is not None
+        s = min(max(params.top_k * 4, params.top_k), int(bundle["codes"].shape[0]))
+        k = min(params.top_k, int(bundle["codes"].shape[0]))
+        dists, idx = _fused_search_resident(
+            bundle["codes"], bundle["norms"], bundle["factors"], bundle["cdc"],
+            bundle["cluster_id"], jnp.asarray(probe_mask),
+            jnp.asarray(csq_c), jnp.asarray(csum_c), jnp.asarray(q_glob),
+            bundle["raw"] if do_rerank else jnp.zeros((1, 1), jnp.float32),
+            jnp.asarray(query, jnp.float32),
+            d=self.quantizer.padded_dim, s=s, k=k,
+            use_pallas=_on_tpu(), do_rerank=do_rerank,
+        )
+        dists, idx = np.asarray(dists), np.asarray(idx)
+        valid = (idx < bundle["n"]) & np.isfinite(dists)
+        idx, dists = idx[valid], dists[valid]
+        kk = min(params.top_k, len(idx))
+        return bundle["ids"][idx[:kk]], dists[:kk]
+
     # ----------------------------------------------------------------- search
-    def _rotated_centroid(self, c: int) -> np.ndarray:
+    def _rotated_centroids(self) -> np.ndarray:
         if self._centroids_rot is None or len(self._centroids_rot) != len(self.centroids):
             self._centroids_rot = self.quantizer.rotate(self.centroids)
-        return self._centroids_rot[c]
+        return self._centroids_rot
+
+    def _rotated_centroid(self, c: int) -> np.ndarray:
+        return self._rotated_centroids()[c]
 
     def _cluster_segments(self, c: int):
         yield self.clusters[c]
@@ -193,6 +299,13 @@ class IvfRabitqIndex:
         nprobe = min(params.nprobe, len(self.centroids))
         cd = np.sum((self.centroids - query[None, :]) ** 2, axis=1)
         probe = np.argsort(cd)[:nprobe]
+
+        if (
+            getattr(self, "_device_cache_enabled", False)
+            and allowed_ids is None
+            and rerank == self.keep_raw
+        ):
+            return self._search_device_resident(query, params, probe)
 
         # All probed segments are concatenated into ONE fused device call.
         # Rotation is linear, so the estimator works in the *global* query
@@ -258,5 +371,63 @@ class IvfRabitqIndex:
         return self.search(query, params, allowed_ids=np.asarray(allowed_ids, np.uint64))
 
     def batch_search(self, queries: np.ndarray, params: SearchParams = SearchParams()):
-        out = [self.search(q, params) for q in np.asarray(queries, np.float32)]
-        return [o[0] for o in out], [o[1] for o in out]
+        """Search many queries; with the device cache enabled, all queries run
+        in ONE device call (amortizing dispatch/readback latency)."""
+        queries = np.asarray(queries, np.float32)
+        if getattr(self, "_device_cache_enabled", False):
+            out = self._batch_search_device_resident(queries, params)
+            if out is not None:
+                return out
+        results = [self.search(q, params) for q in queries]
+        return [o[0] for o in results], [o[1] for o in results]
+
+    def _batch_search_device_resident(self, queries: np.ndarray, params: SearchParams):
+        import jax.numpy as jnp
+
+        from lakesoul_tpu.vector.kernels import _fused_search_resident_batch, _on_tpu
+
+        bundle = self._get_device_bundle()
+        if bundle is None:
+            return None
+        nq = len(queries)
+        # bucket Q to a pow2 so variable batch sizes reuse compiled shapes
+        nq_pad = 8
+        while nq_pad < nq:
+            nq_pad *= 2
+        if nq_pad != nq:
+            queries = np.pad(queries, ((0, nq_pad - nq), (0, 0)))
+        nprobe = min(params.nprobe, len(self.centroids))
+        cd = (
+            np.sum(queries[:nq] ** 2, axis=1, keepdims=True)
+            - 2.0 * queries[:nq] @ self.centroids.T
+            + np.sum(self.centroids**2, axis=1)[None, :]
+        )  # [Q, nlist]
+        probe = np.argsort(cd, axis=1)[:, :nprobe]
+        probe_mask = np.zeros((len(self.centroids), nq_pad), dtype=bool)
+        for qi in range(nq):  # pad queries stay fully masked → inf distances
+            probe_mask[probe[qi], qi] = True
+        q_glob = self.quantizer.rotate(queries)  # [Q, d]
+        xc = self._rotated_centroids()[:, None, :] - q_glob[None, :, :]  # [nlist, Q, d]
+        csq_c = np.sum(xc * xc, axis=-1).astype(np.float32)
+        csum_c = np.sum(xc, axis=-1).astype(np.float32)
+        do_rerank = bundle["raw"] is not None
+        n_pad = int(bundle["codes"].shape[0])
+        s = min(max(params.top_k * 4, params.top_k), n_pad)
+        k = min(params.top_k, n_pad)
+        dists, idx = _fused_search_resident_batch(
+            bundle["codes"], bundle["norms"], bundle["factors"], bundle["cdc"],
+            bundle["cluster_id"], jnp.asarray(probe_mask),
+            jnp.asarray(csq_c), jnp.asarray(csum_c), jnp.asarray(q_glob),
+            bundle["raw"] if do_rerank else jnp.zeros((1, 1), jnp.float32),
+            jnp.asarray(queries),
+            d=self.quantizer.padded_dim, s=s, k=k,
+            use_pallas=_on_tpu(), do_rerank=do_rerank,
+        )
+        dists, idx = np.asarray(dists), np.asarray(idx)
+        ids_out, d_out = [], []
+        for qi in range(nq):
+            valid = (idx[qi] < bundle["n"]) & np.isfinite(dists[qi])
+            sel = idx[qi][valid][: params.top_k]
+            ids_out.append(bundle["ids"][sel])
+            d_out.append(dists[qi][valid][: params.top_k])
+        return ids_out, d_out

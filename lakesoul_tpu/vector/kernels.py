@@ -126,6 +126,14 @@ def packed_scan(packed_codes, norms, factors, q_rot, *, d: int, pallas: bool | N
     return np.asarray(out)[:n]
 
 
+# pad sentinels shared by every padded-candidate path (fused_search host
+# wrapper and the device-resident bundle): pad rows must sort last and divide
+# safely
+PAD_NORM = np.float32(1e9)
+PAD_FACTOR = np.float32(1.0)
+PAD_RAW = np.float32(1e9)
+
+
 def _packed_dot_kernel(q_ref, codes_ref, out_ref):
     """bits·Q for one tile (same Mosaic-friendly plane-concat trick as the
     full scan kernel)."""
@@ -135,6 +143,43 @@ def _packed_dot_kernel(q_ref, codes_ref, out_ref):
     )
     bq = jnp.dot(planes, q_ref[:].T, preferred_element_type=jnp.float32)
     out_ref[0, :] = bq[:, 0]
+
+
+def _packed_dot_batch_kernel(q_ref, codes_ref, out_ref):
+    """bits·Q for one tile against MANY queries: the unpacked plane matrix
+    only ever exists per (tile, 8·d8) block in VMEM — HBM holds packed codes
+    regardless of shard size."""
+    packed = codes_ref[:].astype(jnp.int32)
+    planes = jnp.concatenate(
+        [((packed >> (7 - j)) & 1).astype(jnp.float32) for j in range(8)], axis=1
+    )  # [T, 8*d8]
+    out_ref[:, :] = jnp.dot(planes, q_ref[:].T, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def packed_dot_batch_pallas(packed_codes, q_rot_batch, *, tile: int = 512):
+    """bits·Q over [N, d8] packed codes × [Q, d] queries → [N, Q] f32."""
+    n, d8 = packed_codes.shape
+    nq = q_rot_batch.shape[0]
+    n_pad = ((n + tile - 1) // tile) * tile
+    if n_pad != n:
+        packed_codes = jnp.pad(packed_codes, ((0, n_pad - n), (0, 0)))
+    q_pad = jnp.pad(
+        q_rot_batch.astype(jnp.float32), ((0, 0), (0, d8 * 8 - q_rot_batch.shape[1]))
+    )
+    # per-query plane-concat layout: [Q, 8*d8] with q[:, j*d8 + p] = q[:, 8p+j]
+    q_r = q_pad.reshape(nq, d8, 8).transpose(0, 2, 1).reshape(nq, d8 * 8)
+    out = pl.pallas_call(
+        _packed_dot_batch_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, nq), jnp.float32),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((nq, d8 * 8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, nq), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    )(q_r, packed_codes)
+    return out[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -197,6 +242,76 @@ def _fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, que
     return -neg, idx_s[order]
 
 
+@functools.partial(jax.jit, static_argnames=("d", "s", "k", "use_pallas", "do_rerank"))
+def _fused_search_resident(codes, norms, factors, code_dot_c, cluster_id, probe_mask,
+                           csq_c, csum_c, q_glob, raw, query,
+                           *, d, s, k, use_pallas, do_rerank):
+    """Device-resident variant: the WHOLE shard stays in HBM (codes, factors,
+    raw, cluster ids); per query only the rotated query and three (nlist,)
+    scalar vectors travel.  Non-probed clusters are masked to +inf — on the
+    MXU, scanning everything beats re-uploading per-probe concatenations
+    (compute is cheaper than transfers)."""
+    bq = (
+        packed_dot_pallas(codes, q_glob)
+        if use_pallas
+        else _packed_dot_jnp(codes, q_glob)
+    )
+    csq = csq_c[cluster_id]
+    csum = csum_c[cluster_id]
+    dot_obar_xc = (2.0 * (code_dot_c - bq) - csum) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    est = norms * norms + csq + 2.0 * norms * dot_obar_xc / factors
+    est = jnp.where(probe_mask[cluster_id], est, jnp.inf)
+    if not do_rerank:
+        neg, idx = jax.lax.top_k(-est, k)
+        return -neg, idx
+    neg_s, idx_s = jax.lax.top_k(-est, s)
+    sub = raw[idx_s]
+    q = query.astype(jnp.float32)
+    exact = jnp.sum(sub * sub, axis=1) - 2.0 * (sub @ q) + jnp.sum(q * q)
+    exact = jnp.where(jnp.isfinite(-neg_s), exact, jnp.inf)  # masked rows stay out
+    neg, order = jax.lax.top_k(-exact, k)
+    return -neg, idx_s[order]
+
+
+@functools.partial(jax.jit, static_argnames=("d", "s", "k", "use_pallas", "do_rerank"))
+def _fused_search_resident_batch(codes, norms, factors, code_dot_c, cluster_id,
+                                 probe_mask, csq_c, csum_c, q_glob, raw, queries,
+                                 *, d, s, k, use_pallas, do_rerank):
+    """Batched device-resident search: Q queries amortize one dispatch +
+    readback.  On TPU the packed-code Pallas kernel keeps codes packed in HBM
+    (plane unpack happens per tile in VMEM); the jnp fallback materializes
+    the unpacked bit matrix and is only meant for CPU-sized shards."""
+    if use_pallas:
+        bq = packed_dot_batch_pallas(codes, q_glob)       # [N, Q]
+    else:
+        from lakesoul_tpu.vector.rabitq import unpack_bits_jnp
+
+        bits = unpack_bits_jnp(codes, d)                  # [N, d]
+        bq = bits @ q_glob.T                              # [N, Q] MXU
+    csq = csq_c[cluster_id]                               # [N, Q]
+    csum = csum_c[cluster_id]
+    dot_obar_xc = (2.0 * (code_dot_c[:, None] - bq) - csum) / jnp.sqrt(
+        jnp.asarray(d, jnp.float32)
+    )
+    est = norms[:, None] ** 2 + csq + 2.0 * norms[:, None] * dot_obar_xc / factors[:, None]
+    est = jnp.where(probe_mask[cluster_id], est, jnp.inf)  # [N, Q]
+    est_t = est.T                                          # [Q, N]
+    if not do_rerank:
+        neg, idx = jax.lax.top_k(-est_t, k)
+        return -neg, idx
+    neg_s, idx_s = jax.lax.top_k(-est_t, s)                # [Q, s]
+    sub = raw[idx_s]                                       # [Q, s, dim]
+    q32 = queries.astype(jnp.float32)
+    exact = (
+        jnp.sum(sub * sub, axis=-1)
+        - 2.0 * jnp.einsum("qsd,qd->qs", sub, q32)
+        + jnp.sum(q32 * q32, axis=-1)[:, None]
+    )
+    exact = jnp.where(jnp.isfinite(-neg_s), exact, jnp.inf)
+    neg, order = jax.lax.top_k(-exact, k)                  # [Q, k]
+    return -neg, jnp.take_along_axis(idx_s, order, axis=1)
+
+
 def fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, query,
                  *, d, top_k, shortlist, pallas: bool | None = None):
     """Host wrapper: pow2-pad candidate arrays, run the fused kernel, return
@@ -207,14 +322,14 @@ def fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, quer
     if n_pad != n:
         codes = np.pad(np.asarray(codes), ((0, n_pad - n), (0, 0)))
         # pad rows get a huge norm → huge estimated distance → never selected
-        norms = np.pad(np.asarray(norms), (0, n_pad - n), constant_values=1e9)
-        factors = np.pad(np.asarray(factors), (0, n_pad - n), constant_values=1.0)
+        norms = np.pad(np.asarray(norms), (0, n_pad - n), constant_values=PAD_NORM)
+        factors = np.pad(np.asarray(factors), (0, n_pad - n), constant_values=PAD_FACTOR)
         code_dot_c = np.pad(np.asarray(code_dot_c), (0, n_pad - n))
         csq = np.pad(np.asarray(csq), (0, n_pad - n))
         csum = np.pad(np.asarray(csum), (0, n_pad - n))
         if raw is not None:
             raw = np.pad(
-                np.asarray(raw), ((0, n_pad - n), (0, 0)), constant_values=1e9
+                np.asarray(raw), ((0, n_pad - n), (0, 0)), constant_values=PAD_RAW
             )
     do_rerank = raw is not None
     s = min(shortlist, n_pad)

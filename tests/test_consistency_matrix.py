@@ -1,0 +1,159 @@
+"""Cross-surface consistency matrix.
+
+The reference's answer to "same semantics everywhere" is a matrix harness
+running every case through every writer×reader engine pair and diffing
+normalized tables (python/tests/compat/run_matrix.py).  Here the "engines"
+are this framework's write and read surfaces — each pair must produce the
+identical logical table."""
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.sql import SqlSession
+
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("name", pa.string())])
+
+ROWS = [
+    {"id": 1, "v": 1.5, "name": "a"},
+    {"id": 2, "v": 2.5, "name": "b"},
+    {"id": 3, "v": None, "name": None},
+]
+UPSERT_ROWS = [{"id": 2, "v": 99.0, "name": "B"}]
+EXPECTED = [
+    {"id": 1, "v": 1.5, "name": "a"},
+    {"id": 2, "v": 99.0, "name": "B"},
+    {"id": 3, "v": None, "name": None},
+]
+
+
+def to_table(rows):
+    return pa.table(
+        {
+            "id": pa.array([r["id"] for r in rows], type=pa.int64()),
+            "v": pa.array([r["v"] for r in rows], type=pa.float64()),
+            "name": pa.array([r["name"] for r in rows], type=pa.string()),
+        }
+    )
+
+
+# ----------------------------------------------------------------- writers
+def write_catalog(catalog, name):
+    t = catalog.create_table(name, SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+    t.write_arrow(to_table(ROWS))
+    t.upsert(to_table(UPSERT_ROWS))
+
+
+def write_sql(catalog, name):
+    sql = SqlSession(catalog)
+    sql.execute(
+        f"CREATE TABLE {name} (id bigint PRIMARY KEY, v double, name string)"
+        " WITH (hashBucketNum = '2')"
+    )
+    sql.execute(
+        f"INSERT INTO {name} VALUES (1, 1.5, 'a'), (2, 2.5, 'b'), (3, NULL, NULL)"
+    )
+    sql.execute(f"INSERT INTO {name} VALUES (2, 99.0, 'B')")
+
+
+def write_checkpointed(catalog, name):
+    from lakesoul_tpu.streaming import CheckpointedWriter
+
+    t = catalog.create_table(name, SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+    w = CheckpointedWriter(t)
+    w.write(to_table(ROWS))
+    w.checkpoint(1)
+    w.write(to_table(UPSERT_ROWS))
+    w.checkpoint(2)
+
+
+def write_flight(catalog, name, server_port, token):
+    from lakesoul_tpu.service.flight import LakeSoulFlightClient
+
+    client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server_port}", token=token)
+    schema_hex = SCHEMA.serialize().to_pybytes().hex()
+    client.action(
+        "create_table",
+        {"table": name, "schema_ipc_hex": schema_hex, "primary_keys": ["id"],
+         "hash_bucket_num": 2},
+    )
+    client.write(name, to_table(ROWS))
+    client.write(name, to_table(UPSERT_ROWS))
+
+
+# ----------------------------------------------------------------- readers
+def read_scan(catalog, name, **_):
+    return catalog.table(name).to_arrow()
+
+
+def read_sql(catalog, name, **_):
+    return SqlSession(catalog).execute(f"SELECT * FROM {name}")
+
+
+def read_batches(catalog, name, **_):
+    batches = list(catalog.table(name).scan().batch_size(2).to_batches())
+    return pa.Table.from_batches(batches, schema=batches[0].schema)
+
+
+def read_flight(catalog, name, server_port=None, token=None):
+    from lakesoul_tpu.service.flight import LakeSoulFlightClient
+
+    client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server_port}", token=token)
+    return client.scan(name)
+
+
+def read_torch(catalog, name, **_):
+    ds = catalog.table(name).scan().to_torch()
+    batches = list(ds)
+    return pa.Table.from_batches(batches, schema=batches[0].schema)
+
+
+def normalize(table: pa.Table):
+    """Sort by PK and convert to plain python for diffing (compat/normalize.py
+    role)."""
+    table = table.select(["id", "v", "name"]).sort_by("id")
+    return table.to_pylist()
+
+
+WRITERS = {
+    "catalog": write_catalog,
+    "sql": write_sql,
+    "checkpointed": write_checkpointed,
+    "flight": write_flight,
+}
+READERS = {
+    "scan": read_scan,
+    "sql": read_sql,
+    "batches": read_batches,
+    "flight": read_flight,
+    "torch": read_torch,
+}
+
+
+@pytest.fixture(scope="module")
+def matrix_env(tmp_path_factory):
+    wh = tmp_path_factory.mktemp("matrix_wh")
+    catalog = LakeSoulCatalog(str(wh))
+    from lakesoul_tpu.service.flight import LakeSoulFlightServer
+
+    server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0")
+    yield catalog, server.port, None
+    server.shutdown()
+
+
+@pytest.mark.parametrize("writer", sorted(WRITERS))
+@pytest.mark.parametrize("reader", sorted(READERS))
+def test_matrix(matrix_env, writer, reader):
+    catalog, port, token = matrix_env
+    name = f"m_{writer}"
+    if not catalog.table_exists(name):
+        if writer == "flight":
+            WRITERS[writer](catalog, name, port, token)
+        else:
+            WRITERS[writer](catalog, name)
+    got = READERS[reader](catalog, name, server_port=port, token=token)
+    assert normalize(got) == EXPECTED, f"writer={writer} reader={reader}"

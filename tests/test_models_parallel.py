@@ -156,3 +156,38 @@ class TestGraftEntry:
         fn, args = ge.entry()
         shape = jax.eval_shape(fn, *args)
         assert shape.shape == (8, 128, 30522)
+
+
+class TestTrainCheckpoint:
+    def test_save_restore_round_trip(self, tmp_path):
+        import optax
+
+        from lakesoul_tpu.models.checkpoint import TrainCheckpointer
+        from lakesoul_tpu.models.mlp import init_mlp_params
+
+        params = init_mlp_params(jax.random.key(0), 4)
+        tx = optax.adam(1e-3)
+        opt_state = tx.init(params)
+        ckpt = TrainCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+        try:
+            ckpt.save(1, params, opt_state)
+            bumped = jax.tree.map(lambda x: x + 1.0, params)
+            ckpt.save(2, bumped, opt_state)
+            assert ckpt.latest_step() == 2
+            p2, o2, step = ckpt.restore_latest(like=(params, opt_state))
+            assert step == 2
+            np.testing.assert_allclose(
+                np.asarray(p2[0]["w"]), np.asarray(bumped[0]["w"])
+            )
+        finally:
+            ckpt.close()
+
+    def test_restore_empty_raises(self, tmp_path):
+        from lakesoul_tpu.models.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(str(tmp_path / "empty"))
+        try:
+            with pytest.raises(FileNotFoundError):
+                ckpt.restore_latest()
+        finally:
+            ckpt.close()

@@ -242,3 +242,38 @@ class TestTableIntegration:
         rows = t.scan().vector_search("emb", q, top_k=5, nprobe=8).to_arrow()
         assert 123 in rows.column("id").to_pylist()
         assert rows.num_rows <= 5
+
+
+class TestDeviceResidentCache:
+    def test_resident_matches_default_path(self):
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(1500, 32)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=32, nlist=8)
+        idx = IvfRabitqIndex.train(vecs, np.arange(1500, dtype=np.uint64), cfg)
+        q = vecs[42]
+        base_ids, base_d = idx.search(q, SearchParams(top_k=5, nprobe=8))
+        idx.enable_device_cache()
+        res_ids, res_d = idx.search(q, SearchParams(top_k=5, nprobe=8))
+        np.testing.assert_array_equal(base_ids, res_ids)
+        np.testing.assert_allclose(base_d, res_d, rtol=1e-5)
+
+    def test_cache_invalidated_on_insert(self):
+        rng = np.random.default_rng(1)
+        vecs = rng.normal(size=(300, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4)
+        idx = IvfRabitqIndex.train(vecs, np.arange(300, dtype=np.uint64), cfg)
+        idx.enable_device_cache()
+        idx.search(vecs[0], SearchParams(top_k=1, nprobe=4))
+        idx.insert_batch(vecs[:1] + 0.001, np.array([7777], dtype=np.uint64))
+        ids, _ = idx.search(vecs[0], SearchParams(top_k=2, nprobe=4))
+        assert 7777 in [int(i) for i in ids]  # new delta visible post-invalidate
+
+    def test_filtered_search_bypasses_resident_path(self):
+        rng = np.random.default_rng(2)
+        vecs = rng.normal(size=(200, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4)
+        idx = IvfRabitqIndex.train(vecs, np.arange(200, dtype=np.uint64), cfg)
+        idx.enable_device_cache()
+        ids, _ = idx.search_filtered(vecs[5], np.array([5, 6], dtype=np.uint64),
+                                     SearchParams(top_k=2, nprobe=4))
+        assert set(int(i) for i in ids) <= {5, 6}
