@@ -46,10 +46,13 @@ class IOConfig:
     merge_operators: dict[str, str] = field(default_factory=dict)
     batch_size: int = DEFAULT_BATCH_SIZE
     prefetch_size: int = 2
-    # parquet write options — reference writes zstd(1) without dictionary
-    # (writer/mod.rs:215-240)
-    compression: str = "zstd"
-    compression_level: int = 1
+    # parquet write options.  TPU-first default: lz4 decodes ~3x faster than
+    # the reference's zstd(1) (writer/mod.rs:215-240) at ~14% larger files —
+    # the right trade when the pipeline feeds HBM from a 1-2 core host.
+    # Reference-written zstd files read fine; set compression="zstd",
+    # compression_level=1 for byte-role parity on write.
+    compression: str = "lz4"
+    compression_level: int | None = None
     max_row_group_size: int = DEFAULT_MAX_ROW_GROUP_SIZE
     # target max rows per staged file before rolling to a new one
     max_file_rows: int = 5_000_000

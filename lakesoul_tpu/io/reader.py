@@ -32,6 +32,11 @@ def _read_one_file(
     if arrow_filter is not None:
         ds = pads.dataset(p, format="parquet", filesystem=fs)
         return ds.to_table(columns=columns, filter=arrow_filter)
+    import fsspec.implementations.local
+
+    if isinstance(fs, fsspec.implementations.local.LocalFileSystem):
+        # local files: memory-map instead of read-into-buffer (~1.5x decode)
+        return pq.read_table(p, columns=columns, memory_map=True)
     return pq.read_table(p, columns=columns, filesystem=fs)
 
 

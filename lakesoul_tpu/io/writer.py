@@ -159,7 +159,12 @@ class TableWriter:
                 p,
                 filesystem=fs,
                 compression=cfg.compression,
-                compression_level=cfg.compression_level,
+                # level only applies to leveled codecs (zstd/gzip/brotli)
+                compression_level=(
+                    cfg.compression_level
+                    if cfg.compression in ("zstd", "gzip", "brotli")
+                    else None
+                ),
                 use_dictionary=False,
                 row_group_size=cfg.max_row_group_size,
             )

@@ -20,11 +20,13 @@ class TrainCheckpointer:
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
+        import os
+
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self._mngr = ocp.CheckpointManager(
-            directory,
+            os.path.abspath(directory),  # Orbax requires absolute paths
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
 

@@ -390,6 +390,18 @@ class IvfRabitqIndex:
         if bundle is None:
             return None
         nq = len(queries)
+        # chunk oversized batches: the kernel holds the (Q, 8*d8) query block
+        # and (tile, Q) output tile in VMEM, so Q is capped per call
+        MAX_Q = 256
+        if nq > MAX_Q:
+            ids_all, d_all = [], []
+            for start in range(0, nq, MAX_Q):
+                ids_c, d_c = self._batch_search_device_resident(
+                    queries[start : start + MAX_Q], params
+                )
+                ids_all.extend(ids_c)
+                d_all.extend(d_c)
+            return ids_all, d_all
         # bucket Q to a pow2 so variable batch sizes reuse compiled shapes
         nq_pad = 8
         while nq_pad < nq:
@@ -407,9 +419,17 @@ class IvfRabitqIndex:
         for qi in range(nq):  # pad queries stay fully masked → inf distances
             probe_mask[probe[qi], qi] = True
         q_glob = self.quantizer.rotate(queries)  # [Q, d]
-        xc = self._rotated_centroids()[:, None, :] - q_glob[None, :, :]  # [nlist, Q, d]
-        csq_c = np.sum(xc * xc, axis=-1).astype(np.float32)
-        csum_c = np.sum(xc, axis=-1).astype(np.float32)
+        # closed forms — no [nlist, Q, d] intermediate:
+        #   ||c - q||² = ||c||² - 2 c·q + ||q||² ;  Σ(c - q) = Σc - Σq
+        cent = self._rotated_centroids()
+        csq_c = (
+            np.sum(cent * cent, axis=1)[:, None]
+            - 2.0 * (cent @ q_glob.T)
+            + np.sum(q_glob * q_glob, axis=1)[None, :]
+        ).astype(np.float32)
+        csum_c = (
+            np.sum(cent, axis=1)[:, None] - np.sum(q_glob, axis=1)[None, :]
+        ).astype(np.float32)
         do_rerank = bundle["raw"] is not None
         n_pad = int(bundle["codes"].shape[0])
         s = min(max(params.top_k * 4, params.top_k), n_pad)

@@ -277,3 +277,34 @@ class TestDeviceResidentCache:
         ids, _ = idx.search_filtered(vecs[5], np.array([5, 6], dtype=np.uint64),
                                      SearchParams(top_k=2, nprobe=4))
         assert set(int(i) for i in ids) <= {5, 6}
+
+
+class TestBatchChunking:
+    def test_large_batch_chunks_and_matches(self):
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(2000, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=8)
+        idx = IvfRabitqIndex.train(vecs, np.arange(2000, dtype=np.uint64), cfg)
+        idx.enable_device_cache()
+        queries = vecs[:600]  # > MAX_Q=256 → 3 chunks
+        ids, dists = idx.batch_search(queries, SearchParams(top_k=3, nprobe=8))
+        assert len(ids) == 600
+        hits = sum(int(i in [int(x) for x in ids[i]]) for i in range(600))
+        assert hits >= 590  # self-recall across chunk boundaries
+
+    def test_relative_checkpoint_dir(self, tmp_path, monkeypatch):
+        import optax
+        import jax as _jax
+
+        from lakesoul_tpu.models.checkpoint import TrainCheckpointer
+        from lakesoul_tpu.models.mlp import init_mlp_params
+
+        monkeypatch.chdir(tmp_path)
+        params = init_mlp_params(_jax.random.key(0), 2)
+        tx = optax.sgd(0.1)
+        ck = TrainCheckpointer("rel_ckpts")  # relative path must work
+        try:
+            ck.save(1, params, tx.init(params))
+            assert ck.latest_step() == 1
+        finally:
+            ck.close()
