@@ -22,6 +22,14 @@ from lakesoul_tpu.vector.kmeans import kmeans
 from lakesoul_tpu.vector.rabitq import RabitqQuantizer
 
 
+def _finalize_topk(ids: np.ndarray, dists: np.ndarray, idx: np.ndarray, top_k: int):
+    """Drop pad rows from a fused-search result and cut to top_k."""
+    valid = (idx < len(ids)) & np.isfinite(dists)
+    idx, dists = idx[valid], dists[valid]
+    k = min(top_k, len(ids))
+    return ids[idx[:k]], dists[:k]
+
+
 @dataclass(frozen=True)
 class SearchParams:
     """reference: SearchParams{top_k, nprobe} (ivf/mod.rs:29)."""
@@ -32,12 +40,13 @@ class SearchParams:
 
 @dataclass
 class _Cluster:
-    codes: np.ndarray  # [n, padded/8] uint8
+    codes: np.ndarray  # 1-bit: [n, padded/8] uint8 packed; ex: [n, padded] int8
     norms: np.ndarray  # [n] f32
     factors: np.ndarray  # [n] f32
     ids: np.ndarray  # [n] u64 row ids
-    code_dot_c: np.ndarray | None = None  # [n] f32: bits · P(centroid)
+    code_dot_c: np.ndarray | None = None  # [n] f32: u_hat · P(centroid)
     raw: np.ndarray | None = None  # [n, dim] f32 (kept for exact re-rank)
+    scales: np.ndarray | None = None  # [n] f32, ex-codes only (u_hat = codes*scales)
 
 
 class IvfRabitqIndex:
@@ -98,18 +107,32 @@ class IvfRabitqIndex:
             raise VectorIndexError("no vectors to train on")
         return cls.train(np.concatenate(vs), np.concatenate(ds), config, **kw)
 
+    @property
+    def _ex_bits(self) -> bool:
+        return self.config.total_bits > 1
+
     def _make_cluster(self, vectors, ids, centroid) -> _Cluster:
         if len(vectors) == 0:
-            d8 = self.quantizer.padded_dim // 8
+            if self._ex_bits:
+                codes0 = np.zeros((0, self.quantizer.padded_dim), np.int8)
+            else:
+                codes0 = np.zeros((0, self.quantizer.padded_dim // 8), np.uint8)
             return _Cluster(
-                codes=np.zeros((0, d8), np.uint8),
+                codes=codes0,
                 norms=np.zeros(0, np.float32),
                 factors=np.ones(0, np.float32),
                 ids=np.zeros(0, np.uint64),
                 code_dot_c=np.zeros(0, np.float32),
                 raw=np.zeros((0, self.config.dim), np.float32) if self.keep_raw else None,
+                scales=np.zeros(0, np.float32) if self._ex_bits else None,
             )
-        codes, norms, factors, code_dot_c = self.quantizer.quantize(vectors, centroid)
+        if self._ex_bits:
+            codes, scales, norms, factors, code_dot_c = self.quantizer.quantize_ex(
+                vectors, centroid, min(self.config.total_bits, 8)
+            )
+        else:
+            codes, norms, factors, code_dot_c = self.quantizer.quantize(vectors, centroid)
+            scales = None
         return _Cluster(
             codes=codes,
             norms=norms,
@@ -117,6 +140,7 @@ class IvfRabitqIndex:
             ids=ids,
             code_dot_c=code_dot_c,
             raw=vectors.copy() if self.keep_raw else None,
+            scales=scales,
         )
 
     # ----------------------------------------------------------------- insert
@@ -153,6 +177,11 @@ class IvfRabitqIndex:
                 factors=np.concatenate([s.factors for s in segs]),
                 ids=np.concatenate([s.ids for s in segs]),
                 code_dot_c=np.concatenate([np.asarray(s.code_dot_c) for s in segs]),
+                scales=(
+                    np.concatenate([np.asarray(s.scales) for s in segs])
+                    if all(s.scales is not None for s in segs)
+                    else None
+                ),
                 raw=(
                     np.concatenate([s.raw for s in segs])
                     if self.keep_raw and all(s.raw is not None for s in segs)
@@ -304,6 +333,7 @@ class IvfRabitqIndex:
             getattr(self, "_device_cache_enabled", False)
             and allowed_ids is None
             and rerank == self.keep_raw
+            and not self._ex_bits
         ):
             return self._search_device_resident(query, params, probe)
 
@@ -314,12 +344,13 @@ class IvfRabitqIndex:
         # where <o_bar, xc> needs only bits·Q (one MXU scan) plus the
         # build-time per-row constant code_dot_c = bits·P(c) and two
         # per-cluster scalars (||xc||², Σxc) broadcast per row on the host.
-        cand = {k: [] for k in ("ids", "codes", "norms", "factors", "cdc", "csq", "csum", "raw")}
+        cand = {k: [] for k in ("ids", "codes", "norms", "factors", "cdc", "csq", "csum", "raw", "scales")}
         q_glob = self.quantizer.rotate(query)  # P(query), computed once
+        ex = self._ex_bits
         for c in probe:
             xc = self._rotated_centroid(c) - q_glob
             xc_sq = np.float32(np.dot(xc, xc))
-            xc_sum = np.float32(np.sum(xc))
+            xc_sum = np.float32(0.0) if ex else np.float32(np.sum(xc))  # ex path never uses csum
             for seg in self._cluster_segments(c):
                 if len(seg.ids) == 0:
                     continue
@@ -340,14 +371,35 @@ class IvfRabitqIndex:
                 cand["csq"].append(np.full(n_seg, xc_sq, np.float32))
                 cand["csum"].append(np.full(n_seg, xc_sum, np.float32))
                 cand["raw"].append(seg.raw[sel] if seg.raw is not None else None)
+                if ex and seg.scales is None:
+                    raise VectorIndexError(
+                        "index config says total_bits > 1 but segment has no scales"
+                        " (legacy 1-bit shard?) — rebuild the index"
+                    )
+                cand["scales"].append(seg.scales[sel] if seg.scales is not None else None)
 
         if not cand["ids"]:
             return np.zeros(0, np.uint64), np.zeros(0, np.float32)
         ids = np.concatenate(cand["ids"])
 
-        from lakesoul_tpu.vector.kernels import fused_search
+        from lakesoul_tpu.vector.kernels import fused_search, fused_search_ex
 
         use_rerank = rerank and self.keep_raw and all(r is not None for r in cand["raw"])
+        if self._ex_bits:
+            dists, idx = fused_search_ex(
+                np.concatenate(cand["codes"]),
+                np.concatenate(cand["scales"]),
+                np.concatenate(cand["norms"]),
+                np.concatenate(cand["factors"]),
+                np.concatenate(cand["cdc"]),
+                np.concatenate(cand["csq"]),
+                q_glob,
+                np.concatenate(cand["raw"]) if use_rerank else None,
+                query,
+                top_k=params.top_k,
+                shortlist=max(params.top_k * 4, params.top_k),
+            )
+            return _finalize_topk(ids, dists, idx, params.top_k)
         dists, idx = fused_search(
             np.concatenate(cand["codes"]),
             np.concatenate(cand["norms"]),
@@ -362,10 +414,7 @@ class IvfRabitqIndex:
             top_k=params.top_k,
             shortlist=max(params.top_k * 4, params.top_k),
         )
-        valid = idx < len(ids)
-        idx, dists = idx[valid], dists[valid]
-        k = min(params.top_k, len(ids))
-        return ids[idx[:k]], dists[:k]
+        return _finalize_topk(ids, dists, idx, params.top_k)
 
     def search_filtered(self, query, allowed_ids, params: SearchParams = SearchParams()):
         return self.search(query, params, allowed_ids=np.asarray(allowed_ids, np.uint64))
@@ -374,7 +423,8 @@ class IvfRabitqIndex:
         """Search many queries; with the device cache enabled, all queries run
         in ONE device call (amortizing dispatch/readback latency)."""
         queries = np.asarray(queries, np.float32)
-        if getattr(self, "_device_cache_enabled", False):
+        if getattr(self, "_device_cache_enabled", False) and not self._ex_bits:
+            # (ex-code int8 shards have no resident kernel yet — PARITY.md)
             out = self._batch_search_device_resident(queries, params)
             if out is not None:
                 return out

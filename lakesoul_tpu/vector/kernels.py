@@ -312,6 +312,68 @@ def _fused_search_resident_batch(codes, norms, factors, code_dot_c, cluster_id,
     return -neg, jnp.take_along_axis(idx_s, order, axis=1)
 
 
+@functools.partial(jax.jit, static_argnames=("s", "k", "do_rerank"))
+def _fused_search_ex(codes, scales, norms, factors, code_dot_c, csq, q_glob, raw,
+                     query, *, s, k, do_rerank):
+    """Fused search over int8 ex-codes (total_bits > 1): one MXU int8 matvec
+    u_hat·Q, then the global-frame estimator
+        dist² ≈ ||r||² + ||xc||² + 2·||r||·(code_dot_c - u_hat·Q)/factor
+    (csum is unnecessary: u_hat is a real-valued vector, not ±1 bits)."""
+    g = (codes.astype(jnp.int32) @ q_glob.astype(jnp.float32)) * scales  # [N]
+    est = norms * norms + csq + 2.0 * norms * (code_dot_c - g) / factors
+    if not do_rerank:
+        neg, idx = jax.lax.top_k(-est, k)
+        return -neg, idx
+    neg_s, idx_s = jax.lax.top_k(-est, s)
+    sub = raw[idx_s]
+    q = query.astype(jnp.float32)
+    exact = jnp.sum(sub * sub, axis=1) - 2.0 * (sub @ q) + jnp.sum(q * q)
+    neg, order = jax.lax.top_k(-exact, k)
+    return -neg, idx_s[order]
+
+
+def _pad_tail(a, n_pad: int, const=0):
+    """Pad a candidate array's first axis to n_pad with a constant."""
+    a = np.asarray(a)
+    pad = n_pad - len(a)
+    if pad <= 0:
+        return a
+    width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, width, constant_values=const)
+
+
+def fused_search_ex(codes, scales, norms, factors, code_dot_c, csq, q_glob, raw,
+                    query, *, top_k, shortlist):
+    """Host wrapper for the int8 ex-code path (pow2 padding, pad filtering
+    mirrors fused_search)."""
+    n = len(codes)
+    n_pad = _pow2_bucket(n)
+    codes = _pad_tail(codes, n_pad)
+    scales = _pad_tail(scales, n_pad)
+    norms = _pad_tail(norms, n_pad, PAD_NORM)
+    factors = _pad_tail(factors, n_pad, PAD_FACTOR)
+    code_dot_c = _pad_tail(code_dot_c, n_pad)
+    csq = _pad_tail(csq, n_pad)
+    if raw is not None:
+        raw = _pad_tail(raw, n_pad, PAD_RAW)
+    do_rerank = raw is not None
+    s = min(shortlist, n_pad)
+    k = min(top_k, n_pad)
+    dists, idx = _fused_search_ex(
+        jnp.asarray(codes),
+        jnp.asarray(np.asarray(scales, np.float32)),
+        jnp.asarray(np.asarray(norms, np.float32)),
+        jnp.asarray(np.asarray(factors, np.float32)),
+        jnp.asarray(np.asarray(code_dot_c, np.float32)),
+        jnp.asarray(np.asarray(csq, np.float32)),
+        jnp.asarray(q_glob, dtype=jnp.float32),
+        jnp.asarray(raw) if do_rerank else jnp.zeros((1, 1), jnp.float32),
+        jnp.asarray(query, dtype=jnp.float32),
+        s=s, k=k, do_rerank=do_rerank,
+    )
+    return np.asarray(dists), np.asarray(idx)
+
+
 def fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, query,
                  *, d, top_k, shortlist, pallas: bool | None = None):
     """Host wrapper: pow2-pad candidate arrays, run the fused kernel, return
@@ -319,18 +381,15 @@ def fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, quer
     are pad rows the caller must drop."""
     n = len(codes)
     n_pad = _pow2_bucket(n)
-    if n_pad != n:
-        codes = np.pad(np.asarray(codes), ((0, n_pad - n), (0, 0)))
-        # pad rows get a huge norm → huge estimated distance → never selected
-        norms = np.pad(np.asarray(norms), (0, n_pad - n), constant_values=PAD_NORM)
-        factors = np.pad(np.asarray(factors), (0, n_pad - n), constant_values=PAD_FACTOR)
-        code_dot_c = np.pad(np.asarray(code_dot_c), (0, n_pad - n))
-        csq = np.pad(np.asarray(csq), (0, n_pad - n))
-        csum = np.pad(np.asarray(csum), (0, n_pad - n))
-        if raw is not None:
-            raw = np.pad(
-                np.asarray(raw), ((0, n_pad - n), (0, 0)), constant_values=PAD_RAW
-            )
+    codes = _pad_tail(codes, n_pad)
+    # pad rows get a huge norm → huge estimated distance → never selected
+    norms = _pad_tail(norms, n_pad, PAD_NORM)
+    factors = _pad_tail(factors, n_pad, PAD_FACTOR)
+    code_dot_c = _pad_tail(code_dot_c, n_pad)
+    csq = _pad_tail(csq, n_pad)
+    csum = _pad_tail(csum, n_pad)
+    if raw is not None:
+        raw = _pad_tail(raw, n_pad, PAD_RAW)
     do_rerank = raw is not None
     s = min(shortlist, n_pad)
     k = min(top_k, n_pad)

@@ -84,6 +84,8 @@ class ManifestStore:
         }
         if cluster.code_dot_c is not None:
             arrays["code_dot_c"] = cluster.code_dot_c
+        if cluster.scales is not None:
+            arrays["scales"] = cluster.scales
         if cluster.raw is not None:
             arrays["raw"] = cluster.raw
         np.savez(buf, **arrays)
@@ -122,6 +124,14 @@ class ManifestStore:
         index.clusters = [
             self._read_segment(p) for p in manifest["base_segments"]
         ]
+        if config.total_bits > 1 and any(
+            c.scales is None for c in index.clusters if len(c.ids)
+        ):
+            # legacy shard: written when total_bits > 1 was accepted but only
+            # 1-bit quantization existed (no scales persisted) — treat as 1-bit
+            import dataclasses
+
+            index.config = dataclasses.replace(config, total_bits=1)
         index.deltas = [[] for _ in index.clusters]
         for entry in manifest["delta_segments"]:
             index.deltas[entry["cluster"]].append(self._read_segment(entry["path"]))
@@ -137,4 +147,5 @@ class ManifestStore:
             ids=z["ids"],
             code_dot_c=z["code_dot_c"] if "code_dot_c" in z.files else None,
             raw=z["raw"] if "raw" in z.files else None,
+            scales=z["scales"] if "scales" in z.files else None,
         )

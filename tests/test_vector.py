@@ -308,3 +308,94 @@ class TestBatchChunking:
             assert ck.latest_step() == 1
         finally:
             ck.close()
+
+
+class TestExCodes:
+    def _recall(self, index, vectors, n_queries=20, seed=3, nprobe=8):
+        rng = np.random.default_rng(seed)
+        recalls = []
+        for _ in range(n_queries):
+            q = rng.normal(size=vectors.shape[1]).astype(np.float32)
+            true = set(brute_force_knn(vectors, q, 10))
+            got, _ = index.search(q, SearchParams(top_k=10, nprobe=nprobe))
+            recalls.append(len(true & set(int(i) for i in got)) / 10)
+        return float(np.mean(recalls))
+
+    def test_ex_codes_beat_one_bit_without_rerank(self):
+        rng = np.random.default_rng(0)
+        vectors = rng.normal(size=(2000, 32)).astype(np.float32)
+        ids = np.arange(2000, dtype=np.uint64)
+        r1 = self._recall(
+            IvfRabitqIndex.train(
+                vectors, ids, VectorIndexConfig(column="e", dim=32, nlist=16),
+                keep_raw=False,
+            ),
+            vectors,
+        )
+        r8 = self._recall(
+            IvfRabitqIndex.train(
+                vectors, ids,
+                VectorIndexConfig(column="e", dim=32, nlist=16, total_bits=8),
+                keep_raw=False,
+            ),
+            vectors,
+        )
+        assert r8 > r1 + 0.2, f"1-bit {r1} vs 8-bit {r8}"
+        assert r8 >= 0.8
+
+    def test_ex_codes_with_rerank_and_inserts(self):
+        rng = np.random.default_rng(1)
+        vectors = rng.normal(size=(1000, 24)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=24, nlist=8, total_bits=4)
+        idx = IvfRabitqIndex.train(vectors, np.arange(1000, dtype=np.uint64), cfg)
+        got, _ = idx.search(vectors[7], SearchParams(top_k=1, nprobe=8))
+        assert int(got[0]) == 7
+        idx.insert_batch(vectors[:2] + 0.001, np.array([5001, 5002], dtype=np.uint64))
+        got, _ = idx.search(vectors[0], SearchParams(top_k=2, nprobe=8))
+        assert {int(i) for i in got} == {0, 5001}
+        idx.merge_deltas()
+        got2, _ = idx.search(vectors[0], SearchParams(top_k=2, nprobe=8))
+        assert {int(i) for i in got2} == {0, 5001}
+
+    def test_ex_codes_manifest_round_trip(self, tmp_path):
+        rng = np.random.default_rng(2)
+        vectors = rng.normal(size=(300, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=6)
+        idx = IvfRabitqIndex.train(vectors, np.arange(300, dtype=np.uint64), cfg)
+        store = ManifestStore(str(tmp_path / "exidx"))
+        store.write_index(idx)
+        loaded = store.read_latest()
+        q = vectors[11]
+        a, _ = idx.search(q, SearchParams(top_k=5, nprobe=4))
+        b, _ = loaded.search(q, SearchParams(top_k=5, nprobe=4))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestExCodeGuards:
+    def test_batch_search_ex_bits_skips_resident_path(self):
+        rng = np.random.default_rng(4)
+        vecs = rng.normal(size=(500, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=8)
+        idx = IvfRabitqIndex.train(vecs, np.arange(500, dtype=np.uint64), cfg)
+        idx.enable_device_cache()  # must NOT misinterpret int8 codes as bits
+        ids, _ = idx.batch_search(vecs[:5], SearchParams(top_k=1, nprobe=4))
+        assert [int(ids[i][0]) for i in range(5)] == [0, 1, 2, 3, 4]
+
+    def test_legacy_manifest_without_scales_downgrades(self, tmp_path):
+        # simulate a shard written by the pre-ex-code version: config says
+        # total_bits=8 but segments carry packed 1-bit codes and no scales
+        rng = np.random.default_rng(5)
+        vecs = rng.normal(size=(200, 16)).astype(np.float32)
+        one_bit = IvfRabitqIndex.train(
+            vecs, np.arange(200, dtype=np.uint64),
+            VectorIndexConfig(column="e", dim=16, nlist=4),
+        )
+        import dataclasses
+
+        one_bit.config = dataclasses.replace(one_bit.config, total_bits=8)
+        store = ManifestStore(str(tmp_path / "legacy"))
+        store.write_index(one_bit)
+        loaded = store.read_latest()
+        assert loaded.config.total_bits == 1  # downgraded, searchable
+        ids, _ = loaded.search(vecs[3], SearchParams(top_k=1, nprobe=4))
+        assert int(ids[0]) == 3
