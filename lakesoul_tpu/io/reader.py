@@ -29,15 +29,35 @@ def _read_one_file(
     storage_options: dict | None,
 ) -> pa.Table:
     fs, p = filesystem_for(path, storage_options)
-    if arrow_filter is not None:
-        ds = pads.dataset(p, format="parquet", filesystem=fs)
-        return ds.to_table(columns=columns, filter=arrow_filter)
     import fsspec.implementations.local
 
-    if isinstance(fs, fsspec.implementations.local.LocalFileSystem):
-        # local files: memory-map instead of read-into-buffer (~1.5x decode)
-        return pq.read_table(p, columns=columns, memory_map=True)
-    return pq.read_table(p, columns=columns, filesystem=fs)
+    local = isinstance(fs, fsspec.implementations.local.LocalFileSystem)
+    if arrow_filter is not None:
+        ds = pads.dataset(p, format="parquet", filesystem=fs)
+        try:
+            return ds.to_table(columns=columns, filter=arrow_filter)
+        except (pa.lib.ArrowInvalid, KeyError):
+            # schema evolution: the file predates add_columns.  Drop missing
+            # projected columns (uniform_table fills them) and skip pushdown
+            # when the filter references a missing column — the caller's
+            # post-merge filter applies exact semantics over the null fill.
+            avail = set(ds.schema.names)
+            cols = [c for c in columns if c in avail] if columns is not None else None
+            try:
+                return ds.to_table(columns=cols, filter=arrow_filter)
+            except (pa.lib.ArrowInvalid, KeyError):
+                return ds.to_table(columns=cols)
+    try:
+        if local:
+            # local files: memory-map instead of read-into-buffer (~1.5x decode)
+            return pq.read_table(p, columns=columns, memory_map=True)
+        return pq.read_table(p, columns=columns, filesystem=fs)
+    except (pa.lib.ArrowInvalid, KeyError):
+        avail = set(pq.read_schema(p, filesystem=None if local else fs, memory_map=local).names)
+        cols = [c for c in columns if c in avail] if columns is not None else None
+        if local:
+            return pq.read_table(p, columns=cols, memory_map=True)
+        return pq.read_table(p, columns=cols, filesystem=fs)
 
 
 def read_scan_unit(
@@ -102,9 +122,10 @@ def read_scan_unit(
         elif primary_keys and not refs <= set(primary_keys):
             file_filter = None
         else:
+            # pushdown is per-file best-effort (schema evolution can force a
+            # file to skip it), so the exact filter is always re-applied
+            # post-merge
             file_filter = arrow_filter
-            if not primary_keys:
-                post_filter = None  # exact pushdown already applied
 
     tables = []
     for path in files:

@@ -16,7 +16,7 @@ import pyarrow as pa
 
 
 class Console:
-    SQL_STARTS = ("select", "insert", "create", "drop", "show", "describe")
+    SQL_STARTS = ("select", "insert", "create", "drop", "show", "describe", "alter", "call")
 
     def __init__(self, catalog):
         self.catalog = catalog

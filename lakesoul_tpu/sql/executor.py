@@ -69,6 +69,15 @@ class SqlSession:
             return self._drop(stmt)
         if isinstance(stmt, ast.ShowTables):
             return pa.table({"table_name": sorted(self.catalog.list_tables(self.namespace))})
+        if isinstance(stmt, ast.AlterAddColumn):
+            if stmt.type_name not in _TYPE_MAP:
+                raise SqlError(f"unknown type {stmt.type_name!r}")
+            self.catalog.table(stmt.table, self.namespace).add_columns(
+                pa.field(stmt.column, _TYPE_MAP[stmt.type_name])
+            )
+            return pa.table({"status": ["ok"]})
+        if isinstance(stmt, ast.Call):
+            return self._call(stmt)
         if isinstance(stmt, ast.Describe):
             t = self.catalog.table(stmt.table, self.namespace)
             return pa.table(
@@ -79,6 +88,34 @@ class SqlSession:
                 }
             )
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    _CALL_ARITY = {"compact": 1, "rollback": 2, "build_vector_index": 2, "clean": 0}
+
+    def _call(self, stmt) -> pa.Table:
+        """Maintenance procedures (reference: Spark CALL commands)."""
+        args = list(stmt.args)
+        want = self._CALL_ARITY.get(stmt.procedure)
+        if want is not None and len(args) != want:
+            raise SqlError(
+                f"CALL {stmt.procedure} expects {want} argument(s), got {len(args)}"
+            )
+        if stmt.procedure == "compact":
+            n = self.catalog.table(str(args[0]), self.namespace).compact()
+            return pa.table({"compacted_partitions": pa.array([n], pa.int64())})
+        if stmt.procedure == "rollback":
+            t = self.catalog.table(str(args[0]), self.namespace)
+            n = t.rollback(to_version=int(args[1]))
+            return pa.table({"rolled_back_partitions": pa.array([n], pa.int64())})
+        if stmt.procedure == "build_vector_index":
+            t = self.catalog.table(str(args[0]), self.namespace)
+            n = t.build_vector_index(str(args[1]))
+            return pa.table({"indexed_vectors": pa.array([n], pa.int64())})
+        if stmt.procedure == "clean":
+            from lakesoul_tpu.compaction import Cleaner
+
+            result = Cleaner(self.catalog).clean_all()
+            return pa.table({k: pa.array([v], pa.int64()) for k, v in result.items()})
+        raise SqlError(f"unknown procedure {stmt.procedure!r}")
 
     # ------------------------------------------------------------------- DQL
     def _select(self, stmt: ast.Select) -> pa.Table:

@@ -36,7 +36,8 @@ KEYWORDS = {
     "or", "not", "in", "is", "null", "asc", "desc", "insert", "into",
     "values", "create", "table", "drop", "show", "tables", "describe",
     "primary", "key", "partitioned", "with", "if", "exists", "distinct",
-    "count", "sum", "min", "max", "avg", "true", "false",
+    "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
+    "column", "call",
 }
 
 
@@ -169,6 +170,19 @@ class Describe:
     table: str
 
 
+@dataclass
+class AlterAddColumn:
+    table: str
+    column: str
+    type_name: str
+
+
+@dataclass
+class Call:
+    procedure: str  # compact | rollback | clean | build_vector_index
+    args: list
+
+
 class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
@@ -217,6 +231,8 @@ class Parser:
             "drop": self.parse_drop,
             "show": self.parse_show,
             "describe": self.parse_describe,
+            "alter": self.parse_alter,
+            "call": self.parse_call,
         }
         if tok.kind != "kw" or tok.value not in dispatch:
             raise SqlError(f"unsupported statement start {tok.value!r}")
@@ -415,6 +431,37 @@ class Parser:
             self.expect("kw", "exists")
             if_exists = True
         return DropTable(self.ident(), if_exists)
+
+    def parse_alter(self) -> AlterAddColumn:
+        self.expect("kw", "alter")
+        self.expect("kw", "table")
+        table = self.ident()
+        self.expect("kw", "add")
+        self.expect("kw", "column")
+        name = self.ident()
+        type_name = self.ident()
+        return AlterAddColumn(table, name, type_name.lower())
+
+    def parse_call(self) -> Call:
+        self.expect("kw", "call")
+        proc = self.ident()
+        args: list = []
+        if self.accept("op", "("):
+            if not self.accept("op", ")"):
+                while True:
+                    tok = self.peek()
+                    if tok is None:
+                        raise SqlError("unexpected end of statement in CALL arguments")
+                    if tok.kind in ("number", "string") or (
+                        tok.kind == "kw" and tok.value in ("true", "false", "null")
+                    ):
+                        args.append(self._value())
+                    else:
+                        args.append(self.ident())
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+        return Call(proc.lower(), args)
 
     def parse_show(self) -> ShowTables:
         self.expect("kw", "show")

@@ -63,9 +63,46 @@ class VectorShardIndexBuilder:
         self.id_column = id_column
         self.storage_options = storage_options or {}
 
-    def build(self, unit, schema: pa.Schema, *, keep_raw: bool = True) -> int:
+    def build(self, unit, schema: pa.Schema, *, keep_raw: bool = True,
+              incremental: bool = False) -> int:
         """Scan the unit's files (merged), train a shard index, persist it.
-        Returns the number of vectors indexed."""
+
+        ``incremental=True`` and an existing shard: only files not yet covered
+        by the manifest are read and inserted as delta segments (reference:
+        insert_batch → delta segments; note updated PKs keep their stale
+        entry too until a full rebuild — exact re-rank resolves ordering, the
+        same contract the reference has).  Returns vectors (newly) indexed."""
+        store = ManifestStore(
+            _shard_root(self.table_path, self.config.column, unit.partition_desc, unit.bucket_id),
+            self.storage_options,
+        )
+        if incremental and store.exists():
+            manifest = store.read_manifest()
+            # a compaction/rollback rewrote the file set: indexed files no
+            # longer exist, so the "new" files are rewrites of already-indexed
+            # rows — delta-inserting them would duplicate every id.  Rebuild.
+            current = set(unit.data_files)
+            already = set(manifest.get("indexed_files", []))
+            if manifest.get("config") == self.config.encode() and already <= current:
+                new_files = [f for f in unit.data_files if f not in already]
+                if not new_files:
+                    return 0
+                table = read_scan_unit(
+                    new_files,
+                    [],  # raw appended rows; dedup resolved at re-rank/rebuild
+                    schema=schema,
+                    partition_values=unit.partition_values,
+                    columns=[self.config.column, self.id_column],
+                )
+                if len(table) == 0:
+                    return 0
+                vectors, ids = extract_vectors(
+                    table, self.config.column, self.id_column, self.config.dim
+                )
+                index = store.read_latest()
+                index.insert_batch(vectors, ids)
+                store.write_index(index, indexed_files=sorted(already | set(new_files)))
+                return len(ids)
         table = read_scan_unit(
             unit.data_files,
             unit.primary_keys,
@@ -77,17 +114,16 @@ class VectorShardIndexBuilder:
             return 0
         vectors, ids = extract_vectors(table, self.config.column, self.id_column, self.config.dim)
         index = IvfRabitqIndex.train(vectors, ids, self.config, keep_raw=keep_raw)
-        store = ManifestStore(
-            _shard_root(self.table_path, self.config.column, unit.partition_desc, unit.bucket_id),
-            self.storage_options,
-        )
-        store.write_index(index)
+        store.write_index(index, indexed_files=unit.data_files)
         return len(ids)
 
 
-def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | None = None, **cfg_kw) -> int:
+def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | None = None,
+                             incremental: bool = False, **cfg_kw) -> int:
     """Build one shard per scan unit of the table (reference:
-    build_table_vector_index, vector_index.py:215).  Returns total vectors."""
+    build_table_vector_index, vector_index.py:215).  With ``incremental=True``
+    existing shards only ingest files committed since their last build.
+    Returns total (newly) indexed vectors."""
     info = table.info
     if not info.primary_keys:
         raise VectorIndexError("vector index requires a primary-key table")
@@ -112,7 +148,7 @@ def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | 
     )
     total = 0
     for unit in table.scan().scan_plan():
-        total += builder.build(unit, info.arrow_schema)
+        total += builder.build(unit, info.arrow_schema, incremental=incremental)
     # record the index config on the table for readers
     props = dict(info.properties)
     configs = [c for c in props.get("vector_index_columns", "").split(";") if c]

@@ -42,7 +42,10 @@ class ManifestStore:
         self.fs, self.root_path = filesystem_for(self.root, self.storage_options, write=True)
 
     # ------------------------------------------------------------------ write
-    def write_index(self, index: IvfRabitqIndex, *, generation: int | None = None) -> int:
+    def write_index(self, index: IvfRabitqIndex, *, generation: int | None = None,
+                    indexed_files: list[str] | None = None) -> int:
+        """``indexed_files`` records which table data files this shard covers,
+        enabling incremental refresh (only new files are inserted)."""
         ensure_dir(f"{self.root}/manifests", self.storage_options)
         ensure_dir(f"{self.root}/segments", self.storage_options)
         if generation is None:
@@ -68,6 +71,7 @@ class ManifestStore:
             "centroids": index.centroids.tolist() if index.centroids is not None else None,
             "base_segments": seg_names["base"],
             "delta_segments": delta_entries,
+            "indexed_files": sorted(indexed_files or []),
         }
         mpath = f"manifests/manifest-{generation}.json"
         self._write_blob(mpath, _crc_wrap(json.dumps(manifest).encode()))
@@ -110,9 +114,12 @@ class ManifestStore:
     def exists(self) -> bool:
         return self.fs.exists(f"{self.root_path}/{LATEST}")
 
-    def read_latest(self) -> IvfRabitqIndex:
+    def read_manifest(self) -> dict:
         mpath = _crc_unwrap(self._read_blob(LATEST), "LATEST").decode()
-        manifest = json.loads(_crc_unwrap(self._read_blob(mpath), mpath))
+        return json.loads(_crc_unwrap(self._read_blob(mpath), mpath))
+
+    def read_latest(self) -> IvfRabitqIndex:
+        manifest = self.read_manifest()
         config = VectorIndexConfig.parse(manifest["config"])
         index = IvfRabitqIndex(config)
         index.keep_raw = manifest["keep_raw"]

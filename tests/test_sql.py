@@ -139,3 +139,43 @@ class TestSqlConsole:
         out = c.execute("SELECT count(*) AS n FROM t")
         assert "2" in out
         assert "error" in c.execute("SELECT * FROM missing_table")
+
+
+class TestAlterAndCall:
+    def test_alter_add_column(self, session):
+        session.execute("ALTER TABLE users ADD COLUMN score double")
+        out = session.execute("SELECT id, score FROM users WHERE id = 1")
+        assert out.column("score").to_pylist() == [None]
+        session.execute("INSERT INTO users (id, name, score) VALUES (9, 'zed', 4.5)")
+        out = session.execute("SELECT score FROM users WHERE id = 9")
+        assert out.column("score").to_pylist() == [4.5]
+
+    def test_call_compact_and_rollback(self, session):
+        session.execute("INSERT INTO users VALUES (1, 'v2', 99, 'sf')")
+        out = session.execute("CALL compact('users')")
+        assert out.column("compacted_partitions").to_pylist() == [1]
+        out = session.execute("CALL rollback('users', 0)")
+        assert out.column("rolled_back_partitions").to_pylist() == [1]
+        got = session.execute("SELECT name FROM users WHERE id = 1")
+        assert got.column("name").to_pylist() == ["alice"]
+
+    def test_call_unknown(self, session):
+        with pytest.raises(Exception):
+            session.execute("CALL frobnicate('users')")
+
+
+class TestSchemaEvolutionFilters:
+    def test_filter_on_added_column_over_old_files(self, session):
+        # no-PK table: filter pushdown applies; old files lack the new column
+        session.execute("CREATE TABLE plainlogs (id bigint, msg string)")
+        session.execute("INSERT INTO plainlogs VALUES (1, 'a'), (2, 'b')")
+        session.execute("ALTER TABLE plainlogs ADD COLUMN sev int")
+        session.execute("INSERT INTO plainlogs (id, msg, sev) VALUES (3, 'c', 9)")
+        out = session.execute("SELECT id FROM plainlogs WHERE sev > 1")
+        assert out.column("id").to_pylist() == [3]
+        out2 = session.execute("SELECT id FROM plainlogs WHERE sev IS NULL ORDER BY id")
+        assert out2.column("id").to_pylist() == [1, 2]
+
+    def test_unterminated_call_args(self, session):
+        with pytest.raises(SqlError, match="end of statement"):
+            session.execute("CALL compact(")

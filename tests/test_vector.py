@@ -399,3 +399,57 @@ class TestExCodeGuards:
         assert loaded.config.total_bits == 1  # downgraded, searchable
         ids, _ = loaded.search(vecs[3], SearchParams(top_k=1, nprobe=4))
         assert int(ids[0]) == 3
+
+
+class TestIncrementalIndexRefresh:
+    def test_refresh_only_ingests_new_files(self, tmp_warehouse):
+        from lakesoul_tpu import LakeSoulCatalog
+
+        dim = 16
+        schema = pa.schema([("id", pa.int64()), ("emb", pa.list_(pa.float32(), dim))])
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        t = cat.create_table("v", schema, primary_keys=["id"], hash_bucket_num=1)
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(400, dim)).astype(np.float32)
+        t.write_arrow(pa.table({"id": np.arange(400),
+                                "emb": pa.FixedSizeListArray.from_arrays(vecs.reshape(-1), dim)},
+                               schema=schema))
+        assert t.build_vector_index("emb", nlist=8) == 400
+        # no new data → refresh is a no-op
+        assert t.build_vector_index("emb", nlist=8, incremental=True) == 0
+        # new commit → only the delta is indexed
+        new = rng.normal(size=(50, dim)).astype(np.float32)
+        t.write_arrow(pa.table({"id": np.arange(1000, 1050),
+                                "emb": pa.FixedSizeListArray.from_arrays(new.reshape(-1), dim)},
+                               schema=schema))
+        assert t.build_vector_index("emb", nlist=8, incremental=True) == 50
+        ids, _ = t.vector_search("emb", new[7], top_k=1, nprobe=8)
+        assert int(ids[0]) == 1007  # delta-inserted vector findable
+        ids2, _ = t.vector_search("emb", vecs[3], top_k=1, nprobe=8)
+        assert int(ids2[0]) == 3    # original base still findable
+
+
+class TestIncrementalAfterCompaction:
+    def test_refresh_after_compact_rebuilds(self, tmp_warehouse):
+        from lakesoul_tpu import LakeSoulCatalog
+
+        dim = 16
+        schema = pa.schema([("id", pa.int64()), ("emb", pa.list_(pa.float32(), dim))])
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        t = cat.create_table("v", schema, primary_keys=["id"], hash_bucket_num=1)
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(100, dim)).astype(np.float32)
+        t.write_arrow(pa.table({"id": np.arange(100),
+                                "emb": pa.FixedSizeListArray.from_arrays(vecs.reshape(-1), dim)},
+                               schema=schema))
+        t.build_vector_index("emb", nlist=4)
+        more = rng.normal(size=(50, dim)).astype(np.float32)
+        t.write_arrow(pa.table({"id": np.arange(500, 550),
+                                "emb": pa.FixedSizeListArray.from_arrays(more.reshape(-1), dim)},
+                               schema=schema))
+        t.compact()
+        # compaction rewrote the files: refresh must rebuild, not duplicate
+        t.build_vector_index("emb", nlist=4, incremental=True)
+        ids, _ = t.vector_search("emb", vecs[3], top_k=5, nprobe=4)
+        assert len(set(int(i) for i in ids)) == len(ids)  # no duplicate ids
+        assert int(ids[0]) == 3
