@@ -570,17 +570,57 @@ class LakeSoulScan:
             return schema.empty_table()
         return pa.concat_tables(tables, promote_options="default").combine_chunks()
 
-    def to_batches(self) -> Iterator[pa.RecordBatch]:
+    def to_batches(self, num_threads: int | None = None) -> Iterator[pa.RecordBatch]:
+        """Stream record batches.  ``num_threads > 1`` decodes scan units on a
+        thread pool (unit order preserved, bounded in-flight window) — parquet
+        decode and the numpy merge release the GIL, so multi-core hosts
+        overlap unit decodes like the reference's per-bucket tokio readers."""
         if self._vector_search is not None:
-            yield from self._resolve_vector_search().to_batches()
+            yield from self._resolve_vector_search().to_batches(num_threads)
             return
-        for unit in self.scan_plan():
-            yield from iter_scan_unit_batches(
-                unit.data_files,
-                unit.primary_keys,
-                batch_size=self._batch_size,
-                **self._unit_kwargs(unit),
-            )
+        units = self.scan_plan()
+        if not num_threads or num_threads <= 1 or len(units) <= 1:
+            for unit in units:
+                yield from iter_scan_unit_batches(
+                    unit.data_files,
+                    unit.primary_keys,
+                    batch_size=self._batch_size,
+                    **self._unit_kwargs(unit),
+                )
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        # work items: merge units stay whole (the merge needs all files), but
+        # plain units split per file so peak memory stays at file granularity
+        # like the sequential streaming path
+        items: list[tuple[ScanPlanPartition, list[str]]] = []
+        cfg = self._table.io_config()
+        for u in units:
+            if u.primary_keys or cfg.merge_operators:
+                items.append((u, u.data_files))
+            else:
+                items.extend((u, [f]) for f in u.data_files)
+
+        def read(item):
+            unit, files = item
+            return read_scan_unit(files, unit.primary_keys, **self._unit_kwargs(unit))
+
+        window = num_threads + 1
+        ex = ThreadPoolExecutor(max_workers=num_threads)
+        try:
+            futures = [ex.submit(read, it) for it in items[:window]]
+            next_item = window
+            for i in range(len(items)):
+                table = futures[i].result()
+                futures[i] = None  # release the decoded table once consumed
+                if next_item < len(items):
+                    futures.append(ex.submit(read, items[next_item]))
+                    next_item += 1
+                yield from table.to_batches(max_chunksize=self._batch_size)
+                del table
+        finally:
+            # abandoned generator: don't block on (or start) remaining decodes
+            ex.shutdown(wait=False, cancel_futures=True)
 
     def count_rows(self) -> int:
         return sum(len(b) for b in self.to_batches())

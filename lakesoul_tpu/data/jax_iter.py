@@ -92,6 +92,8 @@ class JaxBatchIterator:
         device_prefetch: how many batches to keep resident on device ahead of
             the consumer (double buffering = 2).
         drop_remainder: drop the final short batch (jit-friendly default True).
+        io_threads: decode scan units on this many threads (multi-core hosts;
+            see LakeSoulScan.to_batches).
     """
 
     def __init__(
@@ -105,6 +107,7 @@ class JaxBatchIterator:
         prefetch: int = 4,
         device_prefetch: int = 2,
         drop_remainder: bool = True,
+        io_threads: int | None = None,
     ):
         self._scan = scan
         self._collate = collate_fn or _default_collate
@@ -114,6 +117,7 @@ class JaxBatchIterator:
         self._prefetch = max(1, prefetch)
         self._device_prefetch = max(1, device_prefetch)
         self._drop_remainder = drop_remainder
+        self._io_threads = io_threads
 
     # ------------------------------------------------------------- pipeline
     def _producer(self, q: queue.Queue, stop: threading.Event) -> None:
@@ -130,7 +134,7 @@ class JaxBatchIterator:
 
         try:
             rb = _Rebatcher(self._scan._batch_size)
-            for arrow_batch in self._scan.to_batches():
+            for arrow_batch in self._scan.to_batches(num_threads=self._io_threads):
                 for window in rb.push(arrow_batch):
                     if not put(self._host_batch(window)):
                         return

@@ -92,3 +92,15 @@ class TestExamples:
         )
         assert out.returncode == 0, out.stderr[-2000:]
         assert "steps, loss" in out.stdout
+
+    def test_resnet_example(self):
+        import os
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        out = subprocess.run(
+            [sys.executable, "examples/resnet_from_table.py"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "steps, loss" in out.stdout
