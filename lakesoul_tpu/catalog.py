@@ -13,6 +13,7 @@ from __future__ import annotations
 import copy
 from typing import Any, Iterable, Iterator
 
+import numpy as np
 import pyarrow as pa
 
 from lakesoul_tpu.errors import CommitConflictError, ConfigError, MetadataError
@@ -234,6 +235,150 @@ class LakeSoulTable:
             CommitOp.DELETE,
         )
 
+    # ------------------------------------------------------------- row DML
+    def _commit_partition_rewrite(self, head, outputs, old_files, commit_op) -> None:
+        """Shared tail of every partition-rewrite operation (compaction and
+        row DML): build the file ops, commit against the read head, delete
+        staged files on a provably-invisible conflict, queue replaced files
+        for the cleaner."""
+        client = self.catalog.client
+        files_by_partition: dict[str, list[DataFileOp]] = {head.partition_desc: []}
+        for out in outputs:
+            files_by_partition.setdefault(out.partition_desc, []).append(
+                DataFileOp(path=out.path, file_op="add", size=out.size,
+                           file_exist_cols=out.file_exist_cols)
+            )
+        try:
+            client.commit_data_files(
+                self._info,
+                files_by_partition,
+                commit_op,
+                read_partition_info=[head],
+            )
+        except CommitConflictError:
+            from lakesoul_tpu.io.object_store import delete_file
+
+            for out in outputs:
+                delete_file(out.path, self.catalog.storage_options, missing_ok=True)
+            raise
+        for f in old_files:
+            client.store.insert_discard_file(f, self._info.table_path, head.partition_desc)
+
+    @staticmethod
+    def _partition_constraints(flt: Filter, range_cols: list[str]) -> dict[str, str]:
+        """AND-of-equality constraints on partition columns (conservative:
+        anything under OR/NOT is ignored) → prune partitions before reading."""
+        out: dict[str, str] = {}
+
+        def walk(f: Filter):
+            if f.op == "and":
+                for a in f.args:
+                    walk(a)
+            elif f.op == "eq" and f.col in range_cols:
+                out[f.col] = str(f.value)
+
+        walk(flt)
+        return out
+
+    def _match_mask(self, table: pa.Table, flt: Filter) -> np.ndarray:
+        """Boolean row mask for the predicate with SQL three-valued logic:
+        NULL-predicate rows are NOT matched (kept by DELETE, skipped by
+        UPDATE)."""
+        import pyarrow.dataset as pads
+
+        idx = pa.array(np.arange(len(table), dtype=np.int64))
+        with_idx = table.append_column("__idx", idx)
+        matched = np.asarray(
+            pads.dataset(with_idx).to_table(filter=flt.to_arrow()).column("__idx")
+        )
+        mask = np.zeros(len(table), dtype=bool)
+        mask[matched] = True
+        return mask
+
+    def _rewrite_where(self, flt: Filter, mutate) -> int:
+        """Shared engine for row-level UPDATE/DELETE (reference:
+        lakesoul-datafusion update/delete planning): per matching partition,
+        rewrite the merged data with ``mutate(table, mask)`` applied and
+        commit an UpdateCommit (snapshot replace, conflict checked against
+        the read head).  Returns affected row count."""
+        client = self.catalog.client
+        total_affected = 0
+        constraints = self._partition_constraints(flt, self._info.range_partition_columns)
+        heads = client._select_partitions(self._info, constraints or None)
+        for head in heads:
+            units = client.get_scan_plan_partitions(
+                self._info.table_name, namespace=self._info.table_namespace,
+                snapshot=[head],
+            )
+            tables = []
+            for unit in units:
+                t = read_scan_unit(
+                    unit.data_files,
+                    unit.primary_keys,
+                    schema=self.schema,
+                    partition_values=unit.partition_values,
+                    merge_operators=self.io_config().merge_operators,
+                    cdc_column=self._info.cdc_column,
+                    drop_cdc_deletes=True,
+                    storage_options=self.catalog.storage_options,
+                )
+                if len(t):
+                    tables.append(t)
+            if not tables:
+                continue
+            merged = pa.concat_tables(tables)
+            mask = self._match_mask(merged, flt)
+            affected = int(mask.sum())
+            if affected == 0:
+                continue
+            new_table = mutate(merged, mask)
+            writer = TableWriter(self.io_config(), self._info.table_path)
+            if len(new_table):
+                writer.write_batch(new_table)
+            outputs = writer.close()
+            old_files = [f for unit in units for f in unit.data_files]
+            self._commit_partition_rewrite(head, outputs, old_files, CommitOp.UPDATE)
+            total_affected += affected
+        return total_affected
+
+    def delete_where(self, flt: Filter) -> int:
+        """Row-level delete: rewrite matching partitions without the matching
+        rows.  Returns the number of rows deleted."""
+
+        def mutate(table, mask):
+            return table.filter(pa.array(~mask))
+
+        return self._rewrite_where(flt, mutate)
+
+    def update_where(self, flt: Filter, assignments: dict) -> int:
+        """Row-level update: SET column=value on rows matching the filter.
+        Returns the number of rows updated."""
+        import pyarrow.compute as pc
+
+        schema = self.schema
+        for col_name in assignments:
+            if col_name not in schema.names:
+                raise MetadataError(f"unknown column {col_name!r} in UPDATE")
+            if col_name in self._info.primary_keys:
+                raise MetadataError("cannot UPDATE a primary-key column")
+            if col_name in self._info.range_partition_columns:
+                # moving rows between partitions would replace the target
+                # partition's snapshot outside the conflict check
+                raise MetadataError("cannot UPDATE a range-partition column")
+
+        def mutate(table, mask):
+            mask_arr = pa.array(mask)
+            arrays = []
+            for fld in schema:
+                col = table.column(fld.name)
+                if fld.name in assignments:
+                    val = pa.scalar(assignments[fld.name], type=fld.type)
+                    col = pc.if_else(mask_arr, val, col)
+                arrays.append(col)
+            return pa.table(arrays, schema=schema)
+
+        return self._rewrite_where(flt, mutate)
+
     # ----------------------------------------------------------- maintenance
     def rollback(
         self,
@@ -337,30 +482,7 @@ class LakeSoulTable:
                     writer.write_batch(merged)
                 old_files.extend(unit.data_files)
             outputs = writer.close()
-            files_by_partition: dict[str, list[DataFileOp]] = {}
-            for out in outputs:
-                files_by_partition.setdefault(out.partition_desc, []).append(
-                    DataFileOp(path=out.path, file_op="add", size=out.size,
-                               file_exist_cols=out.file_exist_cols)
-                )
-            if not files_by_partition:
-                files_by_partition = {head.partition_desc: []}
-            try:
-                client.commit_data_files(
-                    self._info,
-                    files_by_partition,
-                    CommitOp.COMPACTION,
-                    read_partition_info=[head],
-                )
-            except CommitConflictError:
-                # compaction lost the race; staged files provably invisible
-                from lakesoul_tpu.io.object_store import delete_file
-
-                for out in outputs:
-                    delete_file(out.path, self.catalog.storage_options, missing_ok=True)
-                raise
-            for f in old_files:
-                client.store.insert_discard_file(f, self._info.table_path, head.partition_desc)
+            self._commit_partition_rewrite(head, outputs, old_files, CommitOp.COMPACTION)
             count += 1
         return count
 

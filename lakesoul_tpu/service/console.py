@@ -16,7 +16,10 @@ import pyarrow as pa
 
 
 class Console:
-    SQL_STARTS = ("select", "insert", "create", "drop", "show", "describe", "alter", "call")
+    SQL_STARTS = (
+        "select", "insert", "create", "drop", "show", "describe", "alter",
+        "call", "update", "delete",
+    )
 
     def __init__(self, catalog):
         self.catalog = catalog

@@ -78,6 +78,16 @@ class SqlSession:
             return pa.table({"status": ["ok"]})
         if isinstance(stmt, ast.Call):
             return self._call(stmt)
+        if isinstance(stmt, ast.Update):
+            n = self.catalog.table(stmt.table, self.namespace).update_where(
+                _where_to_filter(stmt.where), stmt.assignments
+            )
+            return pa.table({"updated": pa.array([n], pa.int64())})
+        if isinstance(stmt, ast.Delete):
+            n = self.catalog.table(stmt.table, self.namespace).delete_where(
+                _where_to_filter(stmt.where)
+            )
+            return pa.table({"deleted": pa.array([n], pa.int64())})
         if isinstance(stmt, ast.Describe):
             t = self.catalog.table(stmt.table, self.namespace)
             return pa.table(

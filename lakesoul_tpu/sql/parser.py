@@ -37,7 +37,7 @@ KEYWORDS = {
     "values", "create", "table", "drop", "show", "tables", "describe",
     "primary", "key", "partitioned", "with", "if", "exists", "distinct",
     "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
-    "column", "call",
+    "column", "call", "update", "set", "delete",
 }
 
 
@@ -183,6 +183,19 @@ class Call:
     args: list
 
 
+@dataclass
+class Update:
+    table: str
+    assignments: dict
+    where: Any
+
+
+@dataclass
+class Delete:
+    table: str
+    where: Any
+
+
 class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
@@ -233,6 +246,8 @@ class Parser:
             "describe": self.parse_describe,
             "alter": self.parse_alter,
             "call": self.parse_call,
+            "update": self.parse_update,
+            "delete": self.parse_delete,
         }
         if tok.kind != "kw" or tok.value not in dispatch:
             raise SqlError(f"unsupported statement start {tok.value!r}")
@@ -462,6 +477,27 @@ class Parser:
                         break
                 self.expect("op", ")")
         return Call(proc.lower(), args)
+
+    def parse_update(self) -> Update:
+        self.expect("kw", "update")
+        table = self.ident()
+        self.expect("kw", "set")
+        assignments = {}
+        while True:
+            col = self.ident()
+            self.expect("op", "=")
+            assignments[col] = self._value()
+            if not self.accept("op", ","):
+                break
+        self.expect("kw", "where")  # whole-table updates must be explicit
+        return Update(table, assignments, self._bool_expr())
+
+    def parse_delete(self) -> Delete:
+        self.expect("kw", "delete")
+        self.expect("kw", "from")
+        table = self.ident()
+        self.expect("kw", "where")  # whole-table deletes go through DROP/delete_partitions
+        return Delete(table, self._bool_expr())
 
     def parse_show(self) -> ShowTables:
         self.expect("kw", "show")

@@ -179,3 +179,75 @@ class TestSchemaEvolutionFilters:
     def test_unterminated_call_args(self, session):
         with pytest.raises(SqlError, match="end of statement"):
             session.execute("CALL compact(")
+
+
+class TestUpdateDelete:
+    def test_delete_where(self, session):
+        out = session.execute("DELETE FROM users WHERE city = 'nyc'")
+        assert out.column("deleted").to_pylist() == [2]
+        remaining = session.execute("SELECT id FROM users ORDER BY id")
+        assert remaining.column("id").to_pylist() == [1, 3]
+        # delete is a conflict-checked UpdateCommit: version advanced
+        t = session.catalog.table("users")
+        head = session.catalog.client.store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op.value == "UpdateCommit"
+
+    def test_update_where(self, session):
+        out = session.execute("UPDATE users SET age = 99, city = 'x' WHERE id IN (1, 2)")
+        assert out.column("updated").to_pylist() == [2]
+        got = session.execute("SELECT id, age, city FROM users ORDER BY id")
+        rows = got.to_pylist()
+        assert rows[0]["age"] == 99 and rows[0]["city"] == "x"
+        assert rows[1]["age"] == 99 and rows[1]["city"] == "x"
+        assert rows[2]["age"] == 35  # untouched
+
+    def test_update_pk_rejected(self, session):
+        with pytest.raises(Exception, match="primary-key"):
+            session.execute("UPDATE users SET id = 7 WHERE id = 1")
+
+    def test_no_match_is_noop(self, session):
+        out = session.execute("DELETE FROM users WHERE id = 12345")
+        assert out.column("deleted").to_pylist() == [0]
+        t = session.catalog.table("users")
+        head = session.catalog.client.store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op.value != "UpdateCommit"  # nothing rewritten
+
+    def test_where_required(self, session):
+        from lakesoul_tpu.sql.parser import SqlError
+
+        with pytest.raises(SqlError):
+            session.execute("DELETE FROM users")
+        with pytest.raises(SqlError):
+            session.execute("UPDATE users SET age = 1")
+
+
+class TestDmlSemantics:
+    def test_null_predicate_rows_survive_delete(self, session):
+        session.execute("INSERT INTO users (id, name) VALUES (50, 'nullcity')")
+        out = session.execute("DELETE FROM users WHERE city = 'nyc'")
+        assert out.column("deleted").to_pylist() == [2]
+        ids = session.execute("SELECT id FROM users ORDER BY id").column("id").to_pylist()
+        assert 50 in ids  # NULL-predicate row kept (three-valued logic)
+
+    def test_update_partition_column_rejected(self, session):
+        session.execute(
+            "CREATE TABLE pt (id bigint PRIMARY KEY, v double, day string)"
+            " PARTITIONED BY (day)"
+        )
+        session.execute("INSERT INTO pt VALUES (1, 1.0, 'd1')")
+        with pytest.raises(Exception, match="range-partition"):
+            session.execute("UPDATE pt SET day = 'd2' WHERE id = 1")
+
+    def test_partition_pruned_dml(self, session):
+        session.execute(
+            "CREATE TABLE pp2 (id bigint PRIMARY KEY, v double, day string)"
+            " PARTITIONED BY (day)"
+        )
+        session.execute("INSERT INTO pp2 VALUES (1, 1.0, 'd1'), (2, 2.0, 'd2')")
+        out = session.execute("DELETE FROM pp2 WHERE day = 'd2' AND v > 0")
+        assert out.column("deleted").to_pylist() == [1]
+        # d1 partition untouched (no new version)
+        t = session.catalog.table("pp2")
+        store = session.catalog.client.store
+        d1 = store.get_latest_partition_info(t.info.table_id, "day=d1")
+        assert d1.version == 0
