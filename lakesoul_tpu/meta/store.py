@@ -138,85 +138,69 @@ class MetadataStore:
     # Python needs no prepared-statement indirection.
 
 
-class SqliteMetadataStore(MetadataStore):
-    def __init__(self, db_path: str | os.PathLike = ":memory:"):
-        self.db_path = str(db_path)
-        self._local = threading.local()
-        self._lock = threading.Lock()
-        self._compaction_listeners: list[Callable[[CompactionEvent], None]] = []
-        conn = self._conn()
-        with conn:
-            conn.executescript(_SCHEMA)
-            conn.execute(
-                "INSERT OR IGNORE INTO namespace(namespace, properties, comment) VALUES ('default', '{}', '')"
-            )
+def translate_sql(sql: str, paramstyle: str) -> str:
+    """qmark → format placeholder translation plus the one dialect-specific
+    construct the store uses (INSERT OR IGNORE → ON CONFLICT DO NOTHING)."""
+    if paramstyle == "qmark":
+        return sql
+    stripped = sql.lstrip()
+    if stripped.upper().startswith("INSERT OR IGNORE"):
+        sql = "INSERT" + stripped[len("INSERT OR IGNORE"):] + " ON CONFLICT DO NOTHING"
+    return sql.replace("?", "%s")
 
-    # -- connection handling -------------------------------------------------
-    def _conn(self) -> sqlite3.Connection:
-        if self.db_path == ":memory:":
-            # a single shared connection for in-memory DBs
-            with self._lock:
-                if not hasattr(self, "_mem_conn"):
-                    self._mem_conn = sqlite3.connect(
-                        ":memory:", check_same_thread=False
-                    )
-                    self._mem_conn.execute("PRAGMA foreign_keys=ON")
-                return self._mem_conn
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self.db_path, timeout=30.0)
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA synchronous=NORMAL")
-            with conn:
-                conn.executescript(_SCHEMA)
-            self._local.conn = conn
-        return conn
+
+class SqlMetadataStore(MetadataStore):
+    """Generic DB-API 2.0 implementation of the metadata store.  Subclasses
+    provide connections (`_conn`), transactions (`_txn`), the paramstyle, and
+    the driver's integrity-error types; every DAO method below is shared."""
+
+    PARAMSTYLE = "qmark"
+    INTEGRITY_ERRORS: tuple = (sqlite3.IntegrityError,)
+
+    def _exec(self, conn, sql: str, params=()):
+        sql = translate_sql(sql, self.PARAMSTYLE)
+        if self.PARAMSTYLE == "qmark":
+            return conn.execute(sql, params)
+        cur = conn.cursor()
+        cur.execute(sql, params)
+        return cur
+
+    def __init__(self):
+        self._compaction_listeners: list[Callable[[CompactionEvent], None]] = []
+
+    def _conn(self):
+        raise NotImplementedError
 
     @contextlib.contextmanager
     def _txn(self):
-        """Write transaction.  In-memory stores share one connection across
-        threads, so multi-statement transactions must be serialized by a lock
-        to keep atomicity (file-backed stores get a connection per thread and
-        rely on SQLite's own locking)."""
         conn = self._conn()
-        if self.db_path == ":memory:":
-            with self._lock:
-                with conn:
-                    yield conn
-        else:
-            with conn:
-                yield conn
-
-    def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        with conn:  # DB-API context manager: commit on success, rollback on error
+            yield conn
 
     # -- namespaces ----------------------------------------------------------
     def insert_namespace(self, ns: Namespace) -> None:
         try:
             with self._txn() as conn:
-                conn.execute(
+                self._exec(conn, 
                     "INSERT INTO namespace(namespace, properties, comment, domain) VALUES (?,?,?,?)",
                     (ns.namespace, ns.properties, ns.comment, ns.domain),
                 )
-        except sqlite3.IntegrityError as e:
+        except self.INTEGRITY_ERRORS as e:
             raise MetadataError(f"namespace {ns.namespace} already exists") from e
 
     def get_namespace(self, name: str) -> Namespace | None:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             "SELECT namespace, properties, comment, domain FROM namespace WHERE namespace=?",
             (name,),
         ).fetchone()
         return Namespace(*row) if row else None
 
     def list_namespaces(self) -> list[str]:
-        return [r[0] for r in self._conn().execute("SELECT namespace FROM namespace")]
+        return [r[0] for r in self._exec(self._conn(), "SELECT namespace FROM namespace")]
 
     def delete_namespace(self, name: str) -> None:
         with self._txn() as conn:
-            conn.execute("DELETE FROM namespace WHERE namespace=?", (name,))
+            self._exec(conn, "DELETE FROM namespace WHERE namespace=?", (name,))
 
     # -- table info ----------------------------------------------------------
     def insert_table_info(self, info: TableInfo) -> None:
@@ -224,7 +208,7 @@ class SqliteMetadataStore(MetadataStore):
         (reference: create_table → TableInfo/TableNameId/TablePathId DAOs)."""
         try:
             with self._txn() as conn:
-                conn.execute(
+                self._exec(conn, 
                     "INSERT INTO table_info(table_id, table_namespace, table_name, table_path,"
                     " table_schema, table_schema_arrow_ipc, properties, partitions, domain)"
                     " VALUES (?,?,?,?,?,?,?,?,?)",
@@ -241,16 +225,16 @@ class SqliteMetadataStore(MetadataStore):
                     ),
                 )
                 if info.table_name:
-                    conn.execute(
+                    self._exec(conn, 
                         "INSERT INTO table_name_id(table_name, table_id, table_namespace, domain) VALUES (?,?,?,?)",
                         (info.table_name, info.table_id, info.table_namespace, info.domain),
                     )
                 if info.table_path:
-                    conn.execute(
+                    self._exec(conn, 
                         "INSERT INTO table_path_id(table_path, table_id, table_namespace, domain) VALUES (?,?,?,?)",
                         (info.table_path, info.table_id, info.table_namespace, info.domain),
                     )
-        except sqlite3.IntegrityError as e:
+        except self.INTEGRITY_ERRORS as e:
             raise MetadataError(
                 f"table {info.table_namespace}.{info.table_name} already exists"
             ) from e
@@ -274,20 +258,20 @@ class SqliteMetadataStore(MetadataStore):
     )
 
     def get_table_info_by_id(self, table_id: str) -> TableInfo | None:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             f"SELECT {self._TI_COLS} FROM table_info WHERE table_id=?", (table_id,)
         ).fetchone()
         return self._row_to_table_info(row) if row else None
 
     def get_table_info_by_name(self, name: str, namespace: str = "default") -> TableInfo | None:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             f"SELECT {self._TI_COLS} FROM table_info WHERE table_name=? AND table_namespace=?",
             (name, namespace),
         ).fetchone()
         return self._row_to_table_info(row) if row else None
 
     def get_table_info_by_path(self, path: str) -> TableInfo | None:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             f"SELECT {self._TI_COLS} FROM table_info WHERE table_path=?", (path,)
         ).fetchone()
         return self._row_to_table_info(row) if row else None
@@ -295,7 +279,7 @@ class SqliteMetadataStore(MetadataStore):
     def list_tables(self, namespace: str = "default") -> list[str]:
         return [
             r[0]
-            for r in self._conn().execute(
+            for r in self._exec(self._conn(), 
                 "SELECT table_name FROM table_info WHERE table_namespace=? AND table_name != ''",
                 (namespace,),
             )
@@ -303,31 +287,31 @@ class SqliteMetadataStore(MetadataStore):
 
     def update_table_properties(self, table_id: str, properties: dict) -> None:
         with self._txn() as conn:
-            conn.execute(
+            self._exec(conn, 
                 "UPDATE table_info SET properties=? WHERE table_id=?",
                 (json.dumps(properties), table_id),
             )
 
     def update_table_schema(self, table_id: str, schema_json: str, schema_ipc: bytes) -> None:
         with self._txn() as conn:
-            conn.execute(
+            self._exec(conn, 
                 "UPDATE table_info SET table_schema=?, table_schema_arrow_ipc=? WHERE table_id=?",
                 (schema_json, schema_ipc, table_id),
             )
 
     def delete_table(self, table_id: str) -> None:
         with self._txn() as conn:
-            conn.execute("DELETE FROM table_name_id WHERE table_id=?", (table_id,))
-            conn.execute("DELETE FROM table_path_id WHERE table_id=?", (table_id,))
-            conn.execute("DELETE FROM partition_info WHERE table_id=?", (table_id,))
-            conn.execute("DELETE FROM data_commit_info WHERE table_id=?", (table_id,))
-            conn.execute("DELETE FROM table_info WHERE table_id=?", (table_id,))
+            self._exec(conn, "DELETE FROM table_name_id WHERE table_id=?", (table_id,))
+            self._exec(conn, "DELETE FROM table_path_id WHERE table_id=?", (table_id,))
+            self._exec(conn, "DELETE FROM partition_info WHERE table_id=?", (table_id,))
+            self._exec(conn, "DELETE FROM data_commit_info WHERE table_id=?", (table_id,))
+            self._exec(conn, "DELETE FROM table_info WHERE table_id=?", (table_id,))
 
     # -- data commit info ----------------------------------------------------
     def insert_data_commit_info(self, commits: list[DataCommitInfo]) -> int:
         with self._txn() as conn:
             for c in commits:
-                conn.execute(
+                self._exec(conn, 
                     # OR IGNORE: concurrent replays of the same commit id are
                     # an idempotent no-op, not an IntegrityError crash
                     "INSERT OR IGNORE INTO data_commit_info(table_id, partition_desc, commit_id, file_ops,"
@@ -365,7 +349,7 @@ class SqliteMetadataStore(MetadataStore):
         if not commit_ids:
             return []
         qmarks = ",".join("?" for _ in commit_ids)
-        rows = self._conn().execute(
+        rows = self._exec(self._conn(), 
             "SELECT table_id, partition_desc, commit_id, file_ops, commit_op, committed,"
             f" timestamp, domain FROM data_commit_info WHERE table_id=? AND partition_desc=?"
             f" AND commit_id IN ({qmarks})",
@@ -384,14 +368,14 @@ class SqliteMetadataStore(MetadataStore):
             return
         qmarks = ",".join("?" for _ in commit_ids)
         with self._txn() as conn:
-            conn.execute(
+            self._exec(conn, 
                 f"UPDATE data_commit_info SET committed=1 WHERE table_id=? AND partition_desc=?"
                 f" AND commit_id IN ({qmarks})",
                 (table_id, partition_desc, *commit_ids),
             )
 
     def commit_exists(self, table_id: str, partition_desc: str, commit_id: str) -> bool:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             "SELECT 1 FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id=?",
             (table_id, partition_desc, commit_id),
         ).fetchone()
@@ -401,7 +385,7 @@ class SqliteMetadataStore(MetadataStore):
         """None if the commit row doesn't exist, else its ``committed`` flag.
         Distinguishes a fully-durable commit from one that crashed between
         phase 1 (data commit insert) and phase 2 (partition version bump)."""
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             "SELECT committed FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id=?",
             (table_id, partition_desc, commit_id),
         ).fetchone()
@@ -412,7 +396,7 @@ class SqliteMetadataStore(MetadataStore):
             return
         qmarks = ",".join("?" for _ in commit_ids)
         with self._txn() as conn:
-            conn.execute(
+            self._exec(conn, 
                 f"DELETE FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id IN ({qmarks})",
                 (table_id, partition_desc, *commit_ids),
             )
@@ -441,7 +425,7 @@ class SqliteMetadataStore(MetadataStore):
                 for p in partitions:
                     if p.version < 0:  # skip the sentinel Default row the protocol appends
                         continue
-                    conn.execute(
+                    self._exec(conn, 
                         "INSERT INTO partition_info(table_id, partition_desc, version, commit_op,"
                         " timestamp, snapshot, expression, domain) VALUES (?,?,?,?,?,?,?,?)",
                         (
@@ -455,7 +439,7 @@ class SqliteMetadataStore(MetadataStore):
                             p.domain,
                         ),
                     )
-        except sqlite3.IntegrityError as e:
+        except self.INTEGRITY_ERRORS as e:
             raise CommitConflictError(
                 f"concurrent commit conflict on {[(p.partition_desc, p.version) for p in partitions]}"
             ) from e
@@ -471,7 +455,7 @@ class SqliteMetadataStore(MetadataStore):
         for p in partitions:
             if p.version < 0 or p.commit_op == CommitOp.COMPACTION:
                 continue
-            row = conn.execute(
+            row = self._exec(conn, 
                 "SELECT MAX(version) FROM partition_info WHERE table_id=? AND partition_desc=?"
                 " AND commit_op=?",
                 (p.table_id, p.partition_desc, CommitOp.COMPACTION.value),
@@ -496,7 +480,7 @@ class SqliteMetadataStore(MetadataStore):
         self._compaction_listeners.remove(fn)
 
     def get_latest_partition_info(self, table_id: str, partition_desc: str) -> PartitionInfo | None:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
             " ORDER BY version DESC LIMIT 1",
             (table_id, partition_desc),
@@ -506,7 +490,7 @@ class SqliteMetadataStore(MetadataStore):
     def get_partition_info_at_version(
         self, table_id: str, partition_desc: str, version: int
     ) -> PartitionInfo | None:
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=? AND version=?",
             (table_id, partition_desc, version),
         ).fetchone()
@@ -514,7 +498,7 @@ class SqliteMetadataStore(MetadataStore):
 
     def get_all_latest_partition_info(self, table_id: str) -> list[PartitionInfo]:
         """Latest version per partition_desc."""
-        rows = self._conn().execute(
+        rows = self._exec(self._conn(), 
             f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND version ="
             " (SELECT MAX(version) FROM partition_info p2 WHERE p2.table_id=partition_info.table_id"
             "  AND p2.partition_desc=partition_info.partition_desc)",
@@ -526,13 +510,13 @@ class SqliteMetadataStore(MetadataStore):
         self, table_id: str, partition_desc: str, start_version: int = 0, end_version: int | None = None
     ) -> list[PartitionInfo]:
         if end_version is None:
-            rows = self._conn().execute(
+            rows = self._exec(self._conn(), 
                 f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
                 " AND version >= ? ORDER BY version",
                 (table_id, partition_desc, start_version),
             ).fetchall()
         else:
-            rows = self._conn().execute(
+            rows = self._exec(self._conn(), 
                 f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
                 " AND version >= ? AND version <= ? ORDER BY version",
                 (table_id, partition_desc, start_version, end_version),
@@ -544,7 +528,7 @@ class SqliteMetadataStore(MetadataStore):
     ) -> PartitionInfo | None:
         """Time travel: the newest version with timestamp ≤ the given instant
         (reference: SnapshotManagement / for_path_snapshot)."""
-        row = self._conn().execute(
+        row = self._exec(self._conn(), 
             f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=?"
             " AND timestamp <= ? ORDER BY version DESC LIMIT 1",
             (table_id, partition_desc, timestamp_ms),
@@ -560,11 +544,11 @@ class SqliteMetadataStore(MetadataStore):
             # SELECT and DELETE must share one transaction: a row inserted
             # between them would be deleted without being reported, orphaning
             # its data files forever
-            rows = conn.execute(
+            rows = self._exec(conn, 
                 f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND partition_desc=? AND version < ?",
                 (table_id, partition_desc, before_version),
             ).fetchall()
-            conn.execute(
+            self._exec(conn, 
                 "DELETE FROM partition_info WHERE table_id=? AND partition_desc=? AND version < ?",
                 (table_id, partition_desc, before_version),
             )
@@ -572,12 +556,12 @@ class SqliteMetadataStore(MetadataStore):
 
     # -- global config -------------------------------------------------------
     def get_global_config(self, key: str, default: str | None = None) -> str | None:
-        row = self._conn().execute("SELECT value FROM global_config WHERE key=?", (key,)).fetchone()
+        row = self._exec(self._conn(), "SELECT value FROM global_config WHERE key=?", (key,)).fetchone()
         return row[0] if row else default
 
     def set_global_config(self, key: str, value: str) -> None:
         with self._txn() as conn:
-            conn.execute(
+            self._exec(conn, 
                 "INSERT INTO global_config(key, value) VALUES (?,?)"
                 " ON CONFLICT(key) DO UPDATE SET value=excluded.value",
                 (key, value),
@@ -585,20 +569,28 @@ class SqliteMetadataStore(MetadataStore):
 
     # -- discard (compaction garbage) ---------------------------------------
     def insert_discard_file(self, file_path: str, table_path: str, partition_desc: str) -> None:
+        import datetime
+
+        today = datetime.date.today().isoformat()
         with self._txn() as conn:
-            conn.execute(
-                "INSERT OR REPLACE INTO discard_compressed_file_info(file_path, table_path,"
-                " partition_desc, timestamp, t_date) VALUES (?,?,?,?,date('now'))",
-                (file_path, table_path, partition_desc, now_millis()),
+            # portable upsert: delete+insert inside one transaction
+            self._exec(conn,
+                "DELETE FROM discard_compressed_file_info WHERE file_path=?",
+                (file_path,),
+            )
+            self._exec(conn,
+                "INSERT INTO discard_compressed_file_info(file_path, table_path,"
+                " partition_desc, timestamp, t_date) VALUES (?,?,?,?,?)",
+                (file_path, table_path, partition_desc, now_millis(), today),
             )
 
     def list_discard_files(self, older_than_ms: int | None = None) -> list[tuple[str, str, str]]:
         if older_than_ms is None:
-            rows = self._conn().execute(
+            rows = self._exec(self._conn(), 
                 "SELECT file_path, table_path, partition_desc FROM discard_compressed_file_info"
             ).fetchall()
         else:
-            rows = self._conn().execute(
+            rows = self._exec(self._conn(), 
                 "SELECT file_path, table_path, partition_desc FROM discard_compressed_file_info WHERE timestamp < ?",
                 (older_than_ms,),
             ).fetchall()
@@ -609,7 +601,7 @@ class SqliteMetadataStore(MetadataStore):
             return
         qmarks = ",".join("?" for _ in file_paths)
         with self._txn() as conn:
-            conn.execute(
+            self._exec(conn, 
                 f"DELETE FROM discard_compressed_file_info WHERE file_path IN ({qmarks})",
                 tuple(file_paths),
             )
@@ -625,4 +617,106 @@ class SqliteMetadataStore(MetadataStore):
                 "partition_info",
                 "discard_compressed_file_info",
             ):
-                conn.execute(f"DELETE FROM {t}")
+                self._exec(conn, f"DELETE FROM {t}")
+
+
+class SqliteMetadataStore(SqlMetadataStore):
+    def __init__(self, db_path: str | os.PathLike = ":memory:"):
+        super().__init__()
+        self.db_path = str(db_path)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        conn = self._conn()
+        with conn:
+            conn.executescript(_SCHEMA)
+            self._exec(conn, 
+                "INSERT OR IGNORE INTO namespace(namespace, properties, comment) VALUES ('default', '{}', '')"
+            )
+
+    # -- connection handling -------------------------------------------------
+    def _conn(self) -> sqlite3.Connection:
+        if self.db_path == ":memory:":
+            # a single shared connection for in-memory DBs
+            with self._lock:
+                if not hasattr(self, "_mem_conn"):
+                    self._mem_conn = sqlite3.connect(
+                        ":memory:", check_same_thread=False
+                    )
+                    self._mem_conn.execute("PRAGMA foreign_keys=ON")
+                return self._mem_conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.db_path, timeout=30.0)
+            self._exec(conn, "PRAGMA journal_mode=WAL")
+            self._exec(conn, "PRAGMA synchronous=NORMAL")
+            with conn:
+                conn.executescript(_SCHEMA)
+            self._local.conn = conn
+        return conn
+
+    @contextlib.contextmanager
+    def _txn(self):
+        """Write transaction.  In-memory stores share one connection across
+        threads, so multi-statement transactions must be serialized by a lock
+        to keep atomicity (file-backed stores get a connection per thread and
+        rely on SQLite's own locking)."""
+        conn = self._conn()
+        if self.db_path == ":memory:":
+            with self._lock:
+                with conn:
+                    yield conn
+        else:
+            with conn:
+                yield conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+
+class PostgresMetadataStore(SqlMetadataStore):
+    """PostgreSQL-backed store (the reference's deployment shape): same DAO
+    surface over psycopg2 with per-thread connections.  Requires the psycopg2
+    driver (not bundled in TPU images — import-gated)."""
+
+    PARAMSTYLE = "format"
+
+    _PG_SCHEMA = _SCHEMA.replace("BLOB", "BYTEA")
+
+    def __init__(self, dsn: str):
+        try:
+            import psycopg2
+        except ImportError as e:  # pragma: no cover - driver not in image
+            raise ImportError(
+                "PostgresMetadataStore requires psycopg2 (pip install psycopg2-binary)"
+            ) from e
+        super().__init__()
+        self._psycopg2 = psycopg2
+        self.INTEGRITY_ERRORS = (psycopg2.IntegrityError,)
+        self.dsn = dsn
+        self._local = threading.local()
+        conn = self._conn()
+        with conn:
+            cur = conn.cursor()
+            for stmt in self._PG_SCHEMA.split(";"):
+                if stmt.strip():
+                    cur.execute(stmt)
+            cur.execute(
+                "INSERT INTO namespace(namespace, properties, comment)"
+                " VALUES ('default', '{}', '') ON CONFLICT DO NOTHING"
+            )
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None or conn.closed:
+            conn = self._psycopg2.connect(self.dsn)
+            self._local.conn = conn
+        return conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None and not conn.closed:
+            conn.close()

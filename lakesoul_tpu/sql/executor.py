@@ -130,13 +130,57 @@ class SqlSession:
     # ------------------------------------------------------------------- DQL
     def _select(self, stmt: ast.Select) -> pa.Table:
         scan = self.catalog.table(stmt.table, self.namespace).scan()
-        if stmt.where is not None:
+        if stmt.where is not None and not stmt.joins:
             scan = scan.filter(_where_to_filter(stmt.where))
 
         aggs = [it for it in stmt.items if isinstance(it.expr, ast.Agg)]
         plain = [it for it in stmt.items if isinstance(it.expr, ast.Column)]
 
-        if aggs:
+        if stmt.joins:
+            # hash joins on Arrow compute (pyarrow Table.join).  Predicates
+            # that reference only the base table still push into its scan;
+            # the full WHERE re-applies after the join.
+            if stmt.where is not None:
+                flt = _where_to_filter(stmt.where)
+                from lakesoul_tpu.io.reader import _filter_column_names
+
+                base_cols = set(
+                    self.catalog.table(stmt.table, self.namespace).schema.names
+                )
+                if _filter_column_names(flt) <= base_cols:
+                    scan = scan.filter(flt)
+            table = scan.to_arrow()
+            for j in stmt.joins:
+                right = self.catalog.table(j.table, self.namespace).to_arrow()
+                join_type = "inner" if j.kind == "inner" else "left outer"
+                left_key, right_key = j.left_on, j.right_on
+                # bind keys by their written qualifier (ON b.x = a.y works in
+                # either order); bare names fall back to column membership
+                if j.left_qual == j.table or (
+                    j.left_qual is None
+                    and left_key not in table.column_names
+                    and left_key in right.column_names
+                ):
+                    left_key, right_key = right_key, left_key
+                table = table.join(
+                    right, keys=left_key, right_keys=right_key, join_type=join_type
+                )
+            if stmt.where is not None:
+                import pyarrow.dataset as pads
+
+                table = pads.dataset(table).to_table(
+                    filter=_where_to_filter(stmt.where).to_arrow()
+                )
+            if aggs:
+                out = self._aggregate(stmt, table)
+            elif stmt.star:
+                out = table
+            else:
+                out = table.select([it.expr.name for it in plain])
+                renames = {it.expr.name: it.alias for it in plain if it.alias}
+                if renames:
+                    out = out.rename_columns([renames.get(c, c) for c in out.column_names])
+        elif aggs:
             needed = list(stmt.group_by)
             for it in aggs:
                 if it.expr.arg and it.expr.arg not in needed:

@@ -37,7 +37,7 @@ KEYWORDS = {
     "values", "create", "table", "drop", "show", "tables", "describe",
     "primary", "key", "partitioned", "with", "if", "exists", "distinct",
     "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
-    "column", "call", "update", "set", "delete",
+    "column", "call", "update", "set", "delete", "join", "inner", "left", "on",
 }
 
 
@@ -121,10 +121,21 @@ class NotOp:
 
 
 @dataclass
+class Join:
+    table: str
+    kind: str  # inner | left
+    left_on: str
+    right_on: str
+    left_qual: str | None = None  # table qualifier as written (a.col)
+    right_qual: str | None = None
+
+
+@dataclass
 class Select:
     items: list[SelectItem]
     star: bool
     table: str
+    joins: list = field(default_factory=list)
     where: Any = None
     group_by: list[str] = field(default_factory=list)
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
@@ -272,6 +283,25 @@ class Parser:
         self.expect("kw", "from")
         table = self.ident()
         sel = Select(items=items, star=star, table=table)
+        while True:
+            kind = None
+            if self.accept("kw", "inner"):
+                kind = "inner"
+                self.expect("kw", "join")
+            elif self.accept("kw", "left"):
+                kind = "left"
+                self.expect("kw", "join")
+            elif self.accept("kw", "join"):
+                kind = "inner"
+            else:
+                break
+            jt = self.ident()
+            self.expect("kw", "on")
+            # ON a.col = b.col  (qualified or bare column names)
+            lq, left_on = self._qualified_ident()
+            self.expect("op", "=")
+            rq, right_on = self._qualified_ident()
+            sel.joins.append(Join(jt, kind, left_on, right_on, lq, rq))
         if self.accept("kw", "where"):
             sel.where = self._bool_expr()
         if self.accept("kw", "group"):
@@ -294,6 +324,13 @@ class Parser:
         if self.accept("kw", "limit"):
             sel.limit = int(self.expect("number").value)
         return sel
+
+    def _qualified_ident(self) -> tuple[str | None, str]:
+        """→ (qualifier or None, column)."""
+        name = self.ident()
+        if self.accept("op", "."):
+            return name, self.ident()
+        return None, name
 
     def _select_item(self) -> SelectItem:
         tok = self.peek()

@@ -308,3 +308,53 @@ class TestReplayIdempotence:
         info = make_table(client, name="w0")
         append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
         assert client.get_incremental_partitions("w0", 0, 0) == []
+
+
+class TestGenericStoreLayer:
+    def test_translate_sql_qmark_passthrough(self):
+        from lakesoul_tpu.meta.store import translate_sql
+
+        sql = "SELECT a FROM t WHERE x=? AND y=?"
+        assert translate_sql(sql, "qmark") == sql
+
+    def test_translate_sql_postgres_format(self):
+        from lakesoul_tpu.meta.store import translate_sql
+
+        assert translate_sql("SELECT a FROM t WHERE x=?", "format") == (
+            "SELECT a FROM t WHERE x=%s"
+        )
+        out = translate_sql(
+            "INSERT OR IGNORE INTO ns(namespace) VALUES (?)", "format"
+        )
+        assert out == "INSERT INTO ns(namespace) VALUES (%s) ON CONFLICT DO NOTHING"
+
+    def test_postgres_store_gated_without_driver(self):
+        from lakesoul_tpu.meta.store import PostgresMetadataStore
+
+        with pytest.raises(ImportError, match="psycopg2"):
+            PostgresMetadataStore("postgresql://localhost/lakesoul")
+
+    def test_format_paramstyle_dao_layer(self, tmp_path):
+        """Prove the generic DAO layer works with format paramstyle by driving
+        it through a DB-API shim that translates %s back to qmark (stands in
+        for psycopg2, which is not in the image)."""
+        import sqlite3
+
+        from lakesoul_tpu.meta.store import SqliteMetadataStore
+
+        class FormatShimStore(SqliteMetadataStore):
+            PARAMSTYLE = "format"
+
+            def _exec(self, conn, sql, params=()):
+                from lakesoul_tpu.meta.store import translate_sql
+
+                sql = translate_sql(sql, "format")
+                # shim: sqlite only understands qmark
+                return conn.execute(sql.replace("%s", "?"), params)
+
+        store = FormatShimStore(str(tmp_path / "fmt.db"))
+        client = MetaDataClient(store=store)
+        info = make_table(client, name="fmt_t")
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        plan = client.get_scan_plan_partitions("fmt_t")
+        assert plan[0].data_files == ["/f/part-a_0000.parquet"]

@@ -251,3 +251,73 @@ class TestDmlSemantics:
         store = session.catalog.client.store
         d1 = store.get_latest_partition_info(t.info.table_id, "day=d1")
         assert d1.version == 0
+
+
+class TestJoins:
+    @pytest.fixture()
+    def join_session(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse / "j"))
+        s = SqlSession(catalog)
+        s.execute("CREATE TABLE orders (oid bigint PRIMARY KEY, uid bigint, amount double)")
+        s.execute("CREATE TABLE customers (uid bigint PRIMARY KEY, region string)")
+        s.execute("INSERT INTO customers VALUES (1, 'eu'), (2, 'us'), (3, 'apac')")
+        s.execute(
+            "INSERT INTO orders VALUES (10, 1, 5.0), (11, 1, 7.0), (12, 2, 3.0), (13, 9, 1.0)"
+        )
+        return s
+
+    def test_inner_join(self, join_session):
+        out = join_session.execute(
+            "SELECT oid, region FROM orders JOIN customers ON orders.uid = customers.uid"
+            " ORDER BY oid"
+        )
+        assert out.column("oid").to_pylist() == [10, 11, 12]
+        assert out.column("region").to_pylist() == ["eu", "eu", "us"]
+
+    def test_left_join_and_where(self, join_session):
+        out = join_session.execute(
+            "SELECT oid, region FROM orders LEFT JOIN customers ON uid = uid ORDER BY oid"
+        )
+        assert out.num_rows == 4
+        assert out.column("region").to_pylist()[-1] is None  # unmatched uid 9
+        out2 = join_session.execute(
+            "SELECT oid FROM orders JOIN customers ON uid = uid WHERE region = 'eu' ORDER BY oid"
+        )
+        assert out2.column("oid").to_pylist() == [10, 11]
+
+    def test_join_with_aggregate(self, join_session):
+        out = join_session.execute(
+            "SELECT region, sum(amount) AS total FROM orders"
+            " JOIN customers ON uid = uid GROUP BY region ORDER BY region"
+        )
+        assert out.column("region").to_pylist() == ["eu", "us"]
+        assert out.column("total").to_pylist() == [12.0, 3.0]
+
+
+class TestJoinBinding2:
+    @pytest.fixture()
+    def js(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse / "jb"))
+        s = SqlSession(catalog)
+        s.execute("CREATE TABLE o2 (oid bigint PRIMARY KEY, customer_id bigint)")
+        s.execute("CREATE TABLE c2 (uid bigint PRIMARY KEY, region string)")
+        s.execute("INSERT INTO c2 VALUES (1, 'eu')")
+        s.execute("INSERT INTO o2 VALUES (10, 1)")
+        return s
+
+    def test_on_clause_order_independent(self, js):
+        a = js.execute("SELECT oid, region FROM o2 JOIN c2 ON o2.customer_id = c2.uid")
+        b = js.execute("SELECT oid, region FROM o2 JOIN c2 ON c2.uid = o2.customer_id")
+        assert a.to_pylist() == b.to_pylist() == [{"oid": 10, "region": "eu"}]
+
+    def test_bare_names_bound_by_membership(self, js):
+        out = js.execute("SELECT oid, region FROM o2 JOIN c2 ON customer_id = uid")
+        assert out.to_pylist() == [{"oid": 10, "region": "eu"}]
+        out2 = js.execute("SELECT oid, region FROM o2 JOIN c2 ON uid = customer_id")
+        assert out2.to_pylist() == [{"oid": 10, "region": "eu"}]
+
+    def test_base_filter_pushdown_with_join(self, js):
+        out = js.execute(
+            "SELECT oid FROM o2 JOIN c2 ON customer_id = uid WHERE oid = 10"
+        )
+        assert out.column("oid").to_pylist() == [10]
