@@ -747,6 +747,48 @@ class LakeSoulScan:
     def count_rows(self) -> int:
         return sum(len(b) for b in self.to_batches())
 
+    def follow(
+        self,
+        start_timestamp_ms: int | None = None,
+        *,
+        poll_interval: float = 1.0,
+        stop_event=None,
+        settle_ms: int = 250,
+    ) -> Iterator[pa.RecordBatch]:
+        """Unbounded incremental source: yield batches for every commit after
+        ``start_timestamp_ms`` (default: now), then keep polling for new
+        commits — the role of the reference's unbounded Flink source
+        (LakeSoulSource + dynamic split enumerator).  Stops when
+        ``stop_event`` (threading.Event) is set.
+
+        Scaling note: each poll diffs the partition version history from the
+        store; on very long version chains prefer periodic compaction (which
+        also truncates history via the cleaner) to keep polls cheap."""
+        from lakesoul_tpu.meta.entity import now_millis
+
+        import time as _time
+
+        cursor = start_timestamp_ms if start_timestamp_ms is not None else now_millis()
+        while stop_event is None or not stop_event.is_set():
+            # only scan settled time: commits are timestamped BEFORE their
+            # partition-version insert becomes visible, so a window edge too
+            # close to "now" could skip a commit that is stamped but not yet
+            # inserted.  settle_ms bounds that stamp→visible gap (commits
+            # slower than this, e.g. mid-retry, should be rare; raise it for
+            # heavily contended stores).  The cursor never moves backwards.
+            upper = now_millis() - settle_ms
+            emitted = False
+            if upper > cursor:
+                inc = self._replace(_incremental=(cursor, upper), _snapshot_ts=None)
+                for batch in inc.to_batches():
+                    emitted = True
+                    yield batch
+                cursor = upper
+            if stop_event is not None and stop_event.is_set():
+                return
+            if not emitted:
+                _time.sleep(poll_interval)
+
     # jax / torch / huggingface delivery
     def to_jax_iter(self, **kwargs):
         """Double-buffered iterator of device-resident batches — see

@@ -27,6 +27,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
 import sqlite3
 import threading
 from dataclasses import dataclass
@@ -684,7 +685,10 @@ class PostgresMetadataStore(SqlMetadataStore):
 
     PARAMSTYLE = "format"
 
-    _PG_SCHEMA = _SCHEMA.replace("BLOB", "BYTEA")
+    _PG_SCHEMA = re.sub(
+        r"timestamp(\s+)INTEGER", r"timestamp\1BIGINT",
+        _SCHEMA.replace("BLOB", "BYTEA"),
+    )
 
     def __init__(self, dsn: str):
         try:
@@ -713,8 +717,21 @@ class PostgresMetadataStore(SqlMetadataStore):
         conn = getattr(self._local, "conn", None)
         if conn is None or conn.closed:
             conn = self._psycopg2.connect(self.dsn)
+            # reads autocommit: otherwise every reader connection sits
+            # "idle in transaction" forever, pinning xmin and blocking vacuum
+            conn.autocommit = True
             self._local.conn = conn
         return conn
+
+    @contextlib.contextmanager
+    def _txn(self):
+        conn = self._conn()
+        conn.autocommit = False
+        try:
+            with conn:  # commit on success, rollback on error
+                yield conn
+        finally:
+            conn.autocommit = True
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)

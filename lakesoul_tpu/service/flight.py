@@ -47,15 +47,25 @@ class StreamMetrics:
             for k, v in kw.items():
                 setattr(self, k, getattr(self, k) + v)
 
+    _FIELDS = (
+        "active_get_streams", "active_put_streams", "total_get_streams",
+        "total_put_streams", "rows_out", "rows_in", "bytes_in",
+    )
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                k: getattr(self, k)
-                for k in (
-                    "active_get_streams", "active_put_streams", "total_get_streams",
-                    "total_put_streams", "rows_out", "rows_in", "bytes_in",
-                )
-            }
+            return {k: getattr(self, k) for k in self._FIELDS}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (parity with the reference's
+        PrometheusBuilder exporter, bin/flight_sql_server.rs:21-70)."""
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap.items():
+            kind = "gauge" if k.startswith("active") else "counter"
+            lines.append(f"# TYPE lakesoul_flight_{k} {kind}")
+            lines.append(f"lakesoul_flight_{k} {v}")
+        return "\n".join(lines) + "\n"
 
 
 class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
@@ -251,6 +261,8 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             return [flight.Result(json.dumps({"compacted": n}).encode())]
         if action.type == "metrics":
             return [flight.Result(json.dumps(self.metrics.snapshot()).encode())]
+        if action.type == "metrics_prometheus":
+            return [flight.Result(self.metrics.prometheus_text().encode())]
         if action.type == "sql":
             # statement execution, Flight-SQL style: result as Arrow IPC bytes
             from lakesoul_tpu.sql import SqlSession
@@ -285,6 +297,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             ("compact", "compact a table; body: {table, namespace?, partitions?}"),
             ("metrics", "server stream metrics snapshot"),
             ("sql", "execute a SQL statement; body: {statement, namespace?}"),
+            ("metrics_prometheus", "metrics in Prometheus exposition format"),
         ]
 
 

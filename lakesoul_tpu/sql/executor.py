@@ -35,6 +35,18 @@ _TYPE_MAP = {
 }
 
 
+def _rename_filter_cols(flt: Filter, mapping: dict[str, str]) -> Filter:
+    """Rewrite column references (joins drop the right-side key column — the
+    surviving left key carries the same values)."""
+    col = mapping.get(flt.col, flt.col) if flt.col else flt.col
+    return Filter(
+        op=flt.op,
+        col=col,
+        value=flt.value,
+        args=tuple(_rename_filter_cols(a, mapping) for a in flt.args),
+    )
+
+
 def _where_to_filter(node) -> Filter:
     if isinstance(node, ast.Compare):
         return Filter(op=node.op, col=node.col, value=node.value)
@@ -150,6 +162,7 @@ class SqlSession:
                 if _filter_column_names(flt) <= base_cols:
                     scan = scan.filter(flt)
             table = scan.to_arrow()
+            key_renames: dict[str, str] = {}
             for j in stmt.joins:
                 right = self.catalog.table(j.table, self.namespace).to_arrow()
                 join_type = "inner" if j.kind == "inner" else "left outer"
@@ -162,15 +175,23 @@ class SqlSession:
                     and left_key in right.column_names
                 ):
                     left_key, right_key = right_key, left_key
+                # non-key name collisions: suffix the right side (documented,
+                # deterministic; a bare reference resolves to the left table)
+                clashes = (set(table.column_names) & set(right.column_names)) - {right_key}
+                suffix = f"_{j.table}" if clashes else None
                 table = table.join(
-                    right, keys=left_key, right_keys=right_key, join_type=join_type
+                    right, keys=left_key, right_keys=right_key, join_type=join_type,
+                    right_suffix=suffix,
                 )
+                if right_key != left_key:
+                    # the right key column is dropped by the join; predicates
+                    # on it rewrite to the surviving left key
+                    key_renames[right_key] = left_key
             if stmt.where is not None:
                 import pyarrow.dataset as pads
 
-                table = pads.dataset(table).to_table(
-                    filter=_where_to_filter(stmt.where).to_arrow()
-                )
+                flt = _rename_filter_cols(_where_to_filter(stmt.where), key_renames)
+                table = pads.dataset(table).to_table(filter=flt.to_arrow())
             if aggs:
                 out = self._aggregate(stmt, table)
             elif stmt.star:

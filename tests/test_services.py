@@ -181,3 +181,91 @@ class TestAutoFlushCheckpointInteraction:
         w.write(pa.table({"id": [2], "v": [2.0]}))
         w.abort()  # must only discard the uncommitted epoch
         assert t.to_arrow().column("id").to_pylist() == [1]
+
+
+class TestFollowSource:
+    def test_follow_yields_new_commits(self, catalog):
+        import threading
+        import time as _t
+
+        t = catalog.create_table("fw", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))  # before follow start
+        stop = threading.Event()
+        seen: list[int] = []
+        start_ts = catalog.client.store.get_latest_partition_info(
+            t.info.table_id, "-5"
+        ).timestamp
+
+        def consume():
+            for batch in t.scan().follow(start_ts, poll_interval=0.05, stop_event=stop):
+                seen.extend(batch.column("id").to_pylist())
+                if len(seen) >= 3:
+                    stop.set()
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        _t.sleep(0.05)
+        t.write_arrow(pa.table({"id": [2, 3], "v": [2.0, 3.0]}))
+        _t.sleep(0.1)
+        t.write_arrow(pa.table({"id": [4], "v": [4.0]}))
+        th.join(timeout=10)
+        stop.set()
+        assert sorted(seen)[:3] == [2, 3, 4][:3]
+        assert 1 not in seen  # pre-start data excluded
+
+    def test_follow_stops_on_event(self, catalog):
+        import threading
+
+        t = catalog.create_table("fw2", SCHEMA)
+        stop = threading.Event()
+        stop.set()
+        assert list(t.scan().follow(stop_event=stop, poll_interval=0.01)) == []
+
+
+class TestPrometheusMetrics:
+    def test_exposition_format(self, catalog):
+        from lakesoul_tpu.service.flight import LakeSoulFlightClient, LakeSoulFlightServer
+
+        t = catalog.create_table("pm", SCHEMA)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0")
+        try:
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            client.scan("pm")
+            text = client.action("metrics_prometheus")[0].decode()
+            assert "# TYPE lakesoul_flight_rows_out counter" in text
+            assert "lakesoul_flight_rows_out 1" in text
+            assert "# TYPE lakesoul_flight_active_get_streams gauge" in text
+        finally:
+            server.shutdown()
+
+    def test_follow_cursor_never_moves_backwards(self, catalog):
+        # first poll right after start: upper = now-1 < cursor must not
+        # rewind the window onto pre-start commits
+        import threading
+        import time as _t
+
+        t = catalog.create_table("fw3", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        start_ts = catalog.client.store.get_latest_partition_info(
+            t.info.table_id, "-5"
+        ).timestamp
+        stop = threading.Event()
+        seen = []
+
+        def consume():
+            # poll aggressively so the first window lands in the same ms
+            for batch in t.scan().follow(start_ts, poll_interval=0.001, stop_event=stop):
+                seen.extend(batch.column("id").to_pylist())
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        _t.sleep(0.3)  # many empty polls before any new commit
+        t.write_arrow(pa.table({"id": [2], "v": [2.0]}))
+        deadline = _t.time() + 5
+        while 2 not in seen and _t.time() < deadline:
+            _t.sleep(0.02)
+        stop.set()
+        th.join(timeout=5)
+        assert 1 not in seen  # pre-start commit never leaked
+        assert 2 in seen

@@ -321,3 +321,25 @@ class TestJoinBinding2:
             "SELECT oid FROM o2 JOIN c2 ON customer_id = uid WHERE oid = 10"
         )
         assert out.column("oid").to_pylist() == [10]
+
+
+class TestJoinEdgeCases:
+    @pytest.fixture()
+    def js2(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse / "je"))
+        s = SqlSession(catalog)
+        s.execute("CREATE TABLE o3 (oid bigint PRIMARY KEY, uid bigint, region string)")
+        s.execute("CREATE TABLE c3 (uid bigint PRIMARY KEY, region string)")
+        s.execute("INSERT INTO c3 VALUES (1, 'eu')")
+        s.execute("INSERT INTO o3 VALUES (10, 1, 'order-region')")
+        return s
+
+    def test_where_on_right_key_column(self, js2):
+        out = js2.execute("SELECT oid FROM o3 JOIN c3 ON o3.uid = c3.uid WHERE uid = 1")
+        assert out.column("oid").to_pylist() == [10]
+
+    def test_colliding_non_key_columns_suffixed(self, js2):
+        out = js2.execute("SELECT oid, region FROM o3 JOIN c3 ON o3.uid = c3.uid")
+        assert out.column("region").to_pylist() == ["order-region"]  # left wins
+        full = js2.execute("SELECT * FROM o3 JOIN c3 ON o3.uid = c3.uid")
+        assert "region_c3" in full.column_names  # right side suffixed
