@@ -246,6 +246,13 @@ class IvfRabitqIndex:
                     (0, pad),
                 )
             ),
+            "scales": (
+                jnp.asarray(
+                    padded(np.concatenate([np.asarray(s.scales) for _, s in segs]), 1.0)
+                )
+                if all(s.scales is not None for _, s in segs)
+                else None
+            ),
             "raw": (
                 jnp.asarray(
                     np.pad(
@@ -423,8 +430,7 @@ class IvfRabitqIndex:
         """Search many queries; with the device cache enabled, all queries run
         in ONE device call (amortizing dispatch/readback latency)."""
         queries = np.asarray(queries, np.float32)
-        if getattr(self, "_device_cache_enabled", False) and not self._ex_bits:
-            # (ex-code int8 shards have no resident kernel yet — PARITY.md)
+        if getattr(self, "_device_cache_enabled", False):
             out = self._batch_search_device_resident(queries, params)
             if out is not None:
                 return out
@@ -439,6 +445,8 @@ class IvfRabitqIndex:
         bundle = self._get_device_bundle()
         if bundle is None:
             return None
+        if self._ex_bits and bundle["scales"] is None:
+            return None  # legacy segments without scales: non-resident path
         nq = len(queries)
         # chunk oversized batches: the kernel holds the (Q, 8*d8) query block
         # and (tile, Q) output tile in VMEM, so Q is capped per call
@@ -484,15 +492,27 @@ class IvfRabitqIndex:
         n_pad = int(bundle["codes"].shape[0])
         s = min(max(params.top_k * 4, params.top_k), n_pad)
         k = min(params.top_k, n_pad)
-        dists, idx = _fused_search_resident_batch(
-            bundle["codes"], bundle["norms"], bundle["factors"], bundle["cdc"],
-            bundle["cluster_id"], jnp.asarray(probe_mask),
-            jnp.asarray(csq_c), jnp.asarray(csum_c), jnp.asarray(q_glob),
-            bundle["raw"] if do_rerank else jnp.zeros((1, 1), jnp.float32),
-            jnp.asarray(queries),
-            d=self.quantizer.padded_dim, s=s, k=k,
-            use_pallas=_on_tpu(), do_rerank=do_rerank,
-        )
+        if self._ex_bits:
+            from lakesoul_tpu.vector.kernels import _fused_search_resident_ex_batch
+
+            dists, idx = _fused_search_resident_ex_batch(
+                bundle["codes"], bundle["scales"], bundle["norms"], bundle["factors"],
+                bundle["cdc"], bundle["cluster_id"], jnp.asarray(probe_mask),
+                jnp.asarray(csq_c), jnp.asarray(q_glob),
+                bundle["raw"] if do_rerank else jnp.zeros((1, 1), jnp.float32),
+                jnp.asarray(queries),
+                s=s, k=k, do_rerank=do_rerank,
+            )
+        else:
+            dists, idx = _fused_search_resident_batch(
+                bundle["codes"], bundle["norms"], bundle["factors"], bundle["cdc"],
+                bundle["cluster_id"], jnp.asarray(probe_mask),
+                jnp.asarray(csq_c), jnp.asarray(csum_c), jnp.asarray(q_glob),
+                bundle["raw"] if do_rerank else jnp.zeros((1, 1), jnp.float32),
+                jnp.asarray(queries),
+                d=self.quantizer.padded_dim, s=s, k=k,
+                use_pallas=_on_tpu(), do_rerank=do_rerank,
+            )
         dists, idx = np.asarray(dists), np.asarray(idx)
         ids_out, d_out = [], []
         for qi in range(nq):

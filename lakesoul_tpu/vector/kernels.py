@@ -273,6 +273,26 @@ def _fused_search_resident(codes, norms, factors, code_dot_c, cluster_id, probe_
     return -neg, idx_s[order]
 
 
+def _batched_rerank_topk(est, raw, queries, *, s: int, k: int, do_rerank: bool):
+    """Shared tail of the batched resident kernels: [N, Q] estimates →
+    (dists [Q, k], indices [Q, k]), with optional on-device exact re-rank."""
+    est_t = est.T
+    if not do_rerank:
+        neg, idx = jax.lax.top_k(-est_t, k)
+        return -neg, idx
+    neg_s, idx_s = jax.lax.top_k(-est_t, s)
+    sub = raw[idx_s]
+    q32 = queries.astype(jnp.float32)
+    exact = (
+        jnp.sum(sub * sub, axis=-1)
+        - 2.0 * jnp.einsum("qsd,qd->qs", sub, q32)
+        + jnp.sum(q32 * q32, axis=-1)[:, None]
+    )
+    exact = jnp.where(jnp.isfinite(-neg_s), exact, jnp.inf)
+    neg, order = jax.lax.top_k(-exact, k)
+    return -neg, jnp.take_along_axis(idx_s, order, axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("d", "s", "k", "use_pallas", "do_rerank"))
 def _fused_search_resident_batch(codes, norms, factors, code_dot_c, cluster_id,
                                  probe_mask, csq_c, csum_c, q_glob, raw, queries,
@@ -295,21 +315,7 @@ def _fused_search_resident_batch(codes, norms, factors, code_dot_c, cluster_id,
     )
     est = norms[:, None] ** 2 + csq + 2.0 * norms[:, None] * dot_obar_xc / factors[:, None]
     est = jnp.where(probe_mask[cluster_id], est, jnp.inf)  # [N, Q]
-    est_t = est.T                                          # [Q, N]
-    if not do_rerank:
-        neg, idx = jax.lax.top_k(-est_t, k)
-        return -neg, idx
-    neg_s, idx_s = jax.lax.top_k(-est_t, s)                # [Q, s]
-    sub = raw[idx_s]                                       # [Q, s, dim]
-    q32 = queries.astype(jnp.float32)
-    exact = (
-        jnp.sum(sub * sub, axis=-1)
-        - 2.0 * jnp.einsum("qsd,qd->qs", sub, q32)
-        + jnp.sum(q32 * q32, axis=-1)[:, None]
-    )
-    exact = jnp.where(jnp.isfinite(-neg_s), exact, jnp.inf)
-    neg, order = jax.lax.top_k(-exact, k)                  # [Q, k]
-    return -neg, jnp.take_along_axis(idx_s, order, axis=1)
+    return _batched_rerank_topk(est, raw, queries, s=s, k=k, do_rerank=do_rerank)
 
 
 @functools.partial(jax.jit, static_argnames=("s", "k", "do_rerank"))
@@ -372,6 +378,24 @@ def fused_search_ex(codes, scales, norms, factors, code_dot_c, csq, q_glob, raw,
         s=s, k=k, do_rerank=do_rerank,
     )
     return np.asarray(dists), np.asarray(idx)
+
+
+@functools.partial(jax.jit, static_argnames=("s", "k", "do_rerank"))
+def _fused_search_resident_ex_batch(codes, scales, norms, factors, code_dot_c,
+                                    cluster_id, probe_mask, csq_c, q_glob, raw,
+                                    queries, *, s, k, do_rerank):
+    """Device-resident batched search over int8 ex-codes: codes are already
+    MXU-native, so u_hat·Q is one (N, d) x (d, Q) int8×f32 matmul — no unpack
+    stage at all."""
+    g = (codes.astype(jnp.int32) @ q_glob.T.astype(jnp.float32)) * scales[:, None]  # [N, Q]
+    csq = csq_c[cluster_id]  # [N, Q]
+    est = (
+        norms[:, None] ** 2
+        + csq
+        + 2.0 * norms[:, None] * (code_dot_c[:, None] - g) / factors[:, None]
+    )
+    est = jnp.where(probe_mask[cluster_id], est, jnp.inf)
+    return _batched_rerank_topk(est, raw, queries, s=s, k=k, do_rerank=do_rerank)
 
 
 def fused_search(codes, norms, factors, code_dot_c, csq, csum, q_glob, raw, query,

@@ -372,12 +372,12 @@ class TestExCodes:
 
 
 class TestExCodeGuards:
-    def test_batch_search_ex_bits_skips_resident_path(self):
+    def test_batch_search_ex_bits_uses_ex_resident_kernel(self):
         rng = np.random.default_rng(4)
         vecs = rng.normal(size=(500, 16)).astype(np.float32)
         cfg = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=8)
         idx = IvfRabitqIndex.train(vecs, np.arange(500, dtype=np.uint64), cfg)
-        idx.enable_device_cache()  # must NOT misinterpret int8 codes as bits
+        idx.enable_device_cache()  # int8 codes must hit the ex kernel, not the bit unpack
         ids, _ = idx.batch_search(vecs[:5], SearchParams(top_k=1, nprobe=4))
         assert [int(ids[i][0]) for i in range(5)] == [0, 1, 2, 3, 4]
 
@@ -453,3 +453,29 @@ class TestIncrementalAfterCompaction:
         ids, _ = t.vector_search("emb", vecs[3], top_k=5, nprobe=4)
         assert len(set(int(i) for i in ids)) == len(ids)  # no duplicate ids
         assert int(ids[0]) == 3
+
+
+class TestExResidentBatch:
+    def test_ex_resident_batch_matches_default(self):
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(1200, 24)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=24, nlist=8, total_bits=8)
+        idx = IvfRabitqIndex.train(vecs, np.arange(1200, dtype=np.uint64), cfg)
+        queries = vecs[:16]
+        base_ids, base_d = idx.batch_search(queries, SearchParams(top_k=5, nprobe=8))
+        idx.enable_device_cache()
+        res_ids, res_d = idx.batch_search(queries, SearchParams(top_k=5, nprobe=8))
+        for a, b, da, db in zip(base_ids, res_ids, base_d, res_d):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_allclose(da, db, rtol=1e-4, atol=1e-4)
+
+    def test_ex_single_query_still_uses_nonresident(self):
+        # single-query ex search keeps the per-query path (no resident single
+        # kernel for ex yet); results must be correct either way
+        rng = np.random.default_rng(1)
+        vecs = rng.normal(size=(400, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=4)
+        idx = IvfRabitqIndex.train(vecs, np.arange(400, dtype=np.uint64), cfg)
+        idx.enable_device_cache()
+        ids, _ = idx.search(vecs[9], SearchParams(top_k=1, nprobe=4))
+        assert int(ids[0]) == 9
