@@ -47,6 +47,48 @@ def _rename_filter_cols(flt: Filter, mapping: dict[str, str]) -> Filter:
     )
 
 
+def _expr_columns(expr) -> set[str]:
+    if isinstance(expr, ast.Column):
+        return {expr.name}
+    if isinstance(expr, ast.Arith):
+        return _expr_columns(expr.left) | _expr_columns(expr.right)
+    return set()
+
+
+def _eval_expr(expr, table: pa.Table):
+    """Evaluate a value expression against a table → Arrow array/scalar."""
+    if isinstance(expr, ast.Column):
+        return table.column(expr.name)
+    if isinstance(expr, ast.Literal):
+        return pa.scalar(expr.value)
+    if isinstance(expr, ast.Arith):
+        left = _eval_expr(expr.left, table)
+        right = _eval_expr(expr.right, table)
+        fn = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide}[expr.op]
+        return fn(left, right)
+    raise SqlError(f"unsupported expression {expr!r}")
+
+
+def _broadcast(val, n: int):
+    """Expression results may be scalars (column-free expressions); broadcast
+    them to the table's row count."""
+    if isinstance(val, pa.Scalar):
+        return pa.chunked_array([pa.array([val.as_py()] * n)])
+    if isinstance(val, pa.Array):
+        return pa.chunked_array([val])
+    return val
+
+
+def _expr_label(expr) -> str:
+    if isinstance(expr, ast.Column):
+        return expr.name
+    if isinstance(expr, ast.Literal):
+        return str(expr.value)
+    if isinstance(expr, ast.Arith):
+        return f"{_expr_label(expr.left)}{expr.op}{_expr_label(expr.right)}"
+    return "expr"
+
+
 def _where_to_filter(node) -> Filter:
     if isinstance(node, ast.Compare):
         return Filter(op=node.op, col=node.col, value=node.value)
@@ -146,7 +188,17 @@ class SqlSession:
             scan = scan.filter(_where_to_filter(stmt.where))
 
         aggs = [it for it in stmt.items if isinstance(it.expr, ast.Agg)]
-        plain = [it for it in stmt.items if isinstance(it.expr, ast.Column)]
+
+        # columns any select expression references (for projection pushdown)
+        def item_columns(items):
+            cols: set[str] = set()
+            for it in items:
+                if isinstance(it.expr, ast.Agg):
+                    if it.expr.arg is not None:
+                        cols |= _expr_columns(it.expr.arg)
+                else:
+                    cols |= _expr_columns(it.expr)
+            return cols
 
         if stmt.joins:
             # hash joins on Arrow compute (pyarrow Table.join).  Predicates
@@ -197,27 +249,22 @@ class SqlSession:
             elif stmt.star:
                 out = table
             else:
-                out = table.select([it.expr.name for it in plain])
-                renames = {it.expr.name: it.alias for it in plain if it.alias}
-                if renames:
-                    out = out.rename_columns([renames.get(c, c) for c in out.column_names])
+                out = self._project(stmt.items, table)
         elif aggs:
-            needed = list(stmt.group_by)
-            for it in aggs:
-                if it.expr.arg and it.expr.arg not in needed:
-                    needed.append(it.expr.arg)
-            table = (scan.select(needed) if needed else scan).to_arrow()
+            needed = set(stmt.group_by) | item_columns(stmt.items)
+            table = (scan.select(sorted(needed)) if needed else scan).to_arrow()
             out = self._aggregate(stmt, table)
         else:
             if not stmt.star:
-                cols = [it.expr.name for it in plain]
-                scan = scan.select(cols)
-            out = scan.to_arrow()
-            renames = {
-                it.expr.name: it.alias for it in plain if it.alias
-            }
-            if renames:
-                out = out.rename_columns([renames.get(c, c) for c in out.column_names])
+                refs = sorted(item_columns(stmt.items))
+                if refs:
+                    scan = scan.select(refs)
+                # no refs → full scan keeps the row count for literal selects
+            table = scan.to_arrow()
+            if stmt.star:
+                out = table
+            else:
+                out = self._project(stmt.items, table)
 
         for col_name, desc in reversed(stmt.order_by):
             out = out.sort_by([(col_name, "descending" if desc else "ascending")])
@@ -225,25 +272,46 @@ class SqlSession:
             out = out.slice(0, stmt.limit)
         return out
 
+    def _project(self, items, table: pa.Table) -> pa.Table:
+        """Evaluate non-aggregate select items (columns + expressions)."""
+        cols, labels = [], []
+        for it in items:
+            cols.append(_broadcast(_eval_expr(it.expr, table), len(table)))
+            labels.append(it.alias or _expr_label(it.expr))
+        return pa.table(cols, names=labels)  # list form keeps duplicate labels
+
     def _aggregate(self, stmt: ast.Select, table: pa.Table) -> pa.Table:
         fn_map = {"count": "count", "sum": "sum", "min": "min", "max": "max", "avg": "mean"}
         if stmt.group_by:
             specs = []
             names = []
-            for it in stmt.items:
+            work = table
+            for i, it in enumerate(stmt.items):
                 if isinstance(it.expr, ast.Agg):
                     agg = it.expr
-                    target = agg.arg or stmt.group_by[0]
-                    pa_fn = "count" if agg.arg is None else fn_map[agg.fn]
+                    if agg.arg is None:
+                        target = stmt.group_by[0]
+                        pa_fn = "count"
+                        label = it.alias or "count(*)"
+                    else:
+                        # aggregate over a computed expression: materialize a
+                        # temp column, then aggregate it
+                        if isinstance(agg.arg, ast.Column):
+                            target = agg.arg.name
+                        else:
+                            target = f"__agg_expr_{i}"
+                            arr = _broadcast(_eval_expr(agg.arg, work), len(work))
+                            work = work.append_column(target, arr)
+                        pa_fn = fn_map[agg.fn]
+                        label = it.alias or f"{agg.fn}({_expr_label(agg.arg)})"
                     specs.append((target, pa_fn))
-                    names.append(it.alias or f"{agg.fn}({agg.arg or '*'})")
-                elif it.expr.name not in stmt.group_by:
-                    raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
-            grouped = table.group_by(stmt.group_by).aggregate(specs)
-            # pyarrow names results "<col>_<fn>"; rename to requested labels
-            rename = {}
-            for (target, pa_fn), label in zip(specs, names):
-                rename[f"{target}_{pa_fn}"] = label
+                    names.append(label)
+                elif isinstance(it.expr, ast.Column):
+                    if it.expr.name not in stmt.group_by:
+                        raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
+                else:
+                    raise SqlError("non-aggregate expressions in GROUP BY selects not supported")
+            grouped = work.group_by(stmt.group_by).aggregate(specs)
             cols, labels = [], []
             for it in stmt.items:
                 if isinstance(it.expr, ast.Column):
@@ -261,12 +329,14 @@ class SqlSession:
                 raise SqlError("mixing plain columns with global aggregates needs GROUP BY")
             if agg.arg is None:
                 value = pa.array([table.num_rows], type=pa.int64())
+                label = it.alias or "count(*)"
             else:
-                col = table.column(agg.arg)
+                arr = _broadcast(_eval_expr(agg.arg, table), table.num_rows)
                 fn = fn_map[agg.fn]
-                value = pa.array([getattr(pc, fn)(col).as_py()])
+                value = pa.array([getattr(pc, fn)(arr).as_py()])
+                label = it.alias or f"{agg.fn}({_expr_label(agg.arg)})"
             cols.append(value)
-            labels.append(it.alias or f"{agg.fn}({agg.arg or '*'})")
+            labels.append(label)
         return pa.table(dict(zip(labels, cols)))
 
     # ------------------------------------------------------------------- DML

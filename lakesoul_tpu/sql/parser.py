@@ -23,9 +23,9 @@ class SqlError(LakeSoulError):
 _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
-  | (?P<number>-?\d+\.\d+|-?\d+)
+  | (?P<number>\d+\.\d+|\d+)
   | (?P<string>'(?:[^']|'')*')
-  | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\.)
+  | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\.|\+|-|/)
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
     """,
     re.VERBOSE,
@@ -80,8 +80,15 @@ class Literal:
 @dataclass
 class Agg:
     fn: str  # count | sum | min | max | avg
-    arg: str | None  # None = count(*)
+    arg: object | None  # Column/Literal/Arith expression; None = count(*)
     alias: str | None = None
+
+
+@dataclass
+class Arith:
+    op: str  # + - * /
+    left: object
+    right: object
 
 
 @dataclass
@@ -342,13 +349,51 @@ class Parser:
                 if fn != "count":
                     raise SqlError(f"{fn}(*) not supported")
             else:
-                arg = self.ident()
+                arg = self._arith_expr()
             self.expect("op", ")")
             alias = self.ident() if self.accept("kw", "as") else None
             return SelectItem(Agg(fn, arg), alias)
-        name = self.ident()
+        expr = self._arith_expr()
         alias = self.ident() if self.accept("kw", "as") else None
-        return SelectItem(Column(name), alias)
+        return SelectItem(expr, alias)
+
+    # arithmetic value expressions: expr := term (±term)*; term := factor (*/factor)*
+    def _arith_expr(self):
+        left = self._arith_term()
+        while True:
+            if self.accept("op", "+"):
+                left = Arith("+", left, self._arith_term())
+            elif self.accept("op", "-"):
+                left = Arith("-", left, self._arith_term())
+            else:
+                return left
+
+    def _arith_term(self):
+        left = self._arith_factor()
+        while True:
+            if self.accept("op", "*"):
+                left = Arith("*", left, self._arith_factor())
+            elif self.accept("op", "/"):
+                left = Arith("/", left, self._arith_factor())
+            else:
+                return left
+
+    def _arith_factor(self):
+        if self.accept("op", "("):
+            e = self._arith_expr()
+            self.expect("op", ")")
+            return e
+        if self.accept("op", "-"):
+            return Arith("-", Literal(0), self._arith_factor())
+        tok = self.peek()
+        if tok is None:
+            raise SqlError("unexpected end of statement in expression")
+        if tok.kind == "number" or tok.kind == "string" or (
+            tok.kind == "kw" and tok.value in ("true", "false", "null")
+        ):
+            return Literal(self._value())
+        _, name = self._qualified_ident()
+        return Column(name)
 
     # ------------------------------------------------------------- where expr
     def _bool_expr(self):
@@ -406,6 +451,11 @@ class Parser:
         return vals
 
     def _value(self):
+        if self.accept("op", "-"):
+            v = self._value()
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise SqlError("unary minus requires a numeric literal")
+            return -v
         tok = self.next()
         if tok.kind == "number":
             return float(tok.value) if "." in tok.value else int(tok.value)

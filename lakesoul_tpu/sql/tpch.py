@@ -1,0 +1,129 @@
+"""TPC-H-lite harness.
+
+The reference ships a TPC-H module as a harness (schemas + queries, no
+committed numbers — rust/lakesoul-datafusion/src/tpch/).  This is the same
+idea sized to this framework's SQL subset: a scaled generator for the
+lineitem/orders/customer core, and adapted queries exercising expression
+aggregates, joins, group-by and DML — runnable as a correctness harness or a
+timing loop.
+
+    from lakesoul_tpu.sql.tpch import TpchLite
+    t = TpchLite(catalog, scale_rows=100_000)
+    t.generate()
+    results = t.run_all()      # {name: (seconds, arrow table)}
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from lakesoul_tpu.sql import SqlSession
+
+QUERIES = {
+    # Q1-style pricing summary: expression aggregates + group by
+    "q1_pricing_summary": (
+        "SELECT returnflag, count(*) AS cnt,"
+        " sum(extendedprice) AS sum_base,"
+        " sum(extendedprice * (1 - discount)) AS sum_disc,"
+        " avg(quantity) AS avg_qty"
+        " FROM lineitem WHERE shipdate <= '1998-09-02'"
+        " GROUP BY returnflag ORDER BY returnflag"
+    ),
+    # Q3-style shipping priority: join + filter + grouped revenue
+    "q3_shipping_priority": (
+        "SELECT orderkey, sum(extendedprice * (1 - discount)) AS revenue"
+        " FROM lineitem JOIN orders ON lineitem.orderkey = orders.orderkey"
+        " WHERE orderdate < '1995-03-15'"
+        " GROUP BY orderkey ORDER BY revenue DESC LIMIT 10"
+    ),
+    # Q6-style forecast revenue change: pure expression aggregate
+    "q6_forecast_revenue": (
+        "SELECT sum(extendedprice * discount) AS revenue FROM lineitem"
+        " WHERE shipdate >= '1994-01-01' AND shipdate < '1995-01-01'"
+        " AND discount >= 0.05 AND discount <= 0.07 AND quantity < 24"
+    ),
+    # customer rollup across a join
+    "q_customer_revenue": (
+        "SELECT mktsegment, count(*) AS orders, sum(totalprice) AS total"
+        " FROM orders JOIN customer ON orders.custkey = customer.custkey"
+        " GROUP BY mktsegment ORDER BY total DESC"
+    ),
+}
+
+
+class TpchLite:
+    def __init__(self, catalog, *, scale_rows: int = 100_000, seed: int = 0):
+        self.catalog = catalog
+        self.sql = SqlSession(catalog)
+        self.scale_rows = scale_rows
+        self.seed = seed
+
+    # --------------------------------------------------------------- schema
+    def generate(self) -> None:
+        rng = np.random.default_rng(self.seed)
+        n_li = self.scale_rows
+        n_ord = max(1, n_li // 4)
+        n_cust = max(1, n_ord // 10)
+
+        self.sql.execute(
+            "CREATE TABLE IF NOT EXISTS lineitem (linekey bigint PRIMARY KEY,"
+            " orderkey bigint, quantity double, extendedprice double,"
+            " discount double, returnflag string, shipdate string)"
+            " WITH (hashBucketNum = '4')"
+        )
+        self.sql.execute(
+            "CREATE TABLE IF NOT EXISTS orders (orderkey bigint PRIMARY KEY,"
+            " custkey bigint, totalprice double, orderdate string)"
+            " WITH (hashBucketNum = '4')"
+        )
+        self.sql.execute(
+            "CREATE TABLE IF NOT EXISTS customer (custkey bigint PRIMARY KEY,"
+            " mktsegment string)"
+        )
+
+        days = np.datetime64("1992-01-01") + rng.integers(0, 2500, n_li)
+        lineitem = pa.table(
+            {
+                "linekey": np.arange(n_li, dtype=np.int64),
+                "orderkey": rng.integers(0, n_ord, n_li).astype(np.int64),
+                "quantity": rng.integers(1, 51, n_li).astype(np.float64),
+                "extendedprice": (rng.random(n_li) * 10_000).round(2),
+                "discount": rng.integers(0, 11, n_li).astype(np.float64) / 100.0,
+                "returnflag": rng.choice(["A", "N", "R"], n_li),
+                "shipdate": days.astype(str),
+            }
+        )
+        odays = np.datetime64("1992-01-01") + rng.integers(0, 2500, n_ord)
+        orders = pa.table(
+            {
+                "orderkey": np.arange(n_ord, dtype=np.int64),
+                "custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
+                "totalprice": (rng.random(n_ord) * 100_000).round(2),
+                "orderdate": odays.astype(str),
+            }
+        )
+        customer = pa.table(
+            {
+                "custkey": np.arange(n_cust, dtype=np.int64),
+                "mktsegment": rng.choice(
+                    ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"],
+                    n_cust,
+                ),
+            }
+        )
+        self.catalog.table("lineitem").write_arrow(lineitem)
+        self.catalog.table("orders").write_arrow(orders)
+        self.catalog.table("customer").write_arrow(customer)
+
+    # ---------------------------------------------------------------- runs
+    def run(self, name: str) -> tuple[float, pa.Table]:
+        sql = QUERIES[name]
+        start = time.perf_counter()
+        out = self.sql.execute(sql)
+        return time.perf_counter() - start, out
+
+    def run_all(self) -> dict[str, tuple[float, pa.Table]]:
+        return {name: self.run(name) for name in QUERIES}

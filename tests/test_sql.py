@@ -343,3 +343,85 @@ class TestJoinEdgeCases:
         assert out.column("region").to_pylist() == ["order-region"]  # left wins
         full = js2.execute("SELECT * FROM o3 JOIN c3 ON o3.uid = c3.uid")
         assert "region_c3" in full.column_names  # right side suffixed
+
+
+class TestExpressions:
+    def test_select_arithmetic(self, session):
+        out = session.execute("SELECT id, age * 2 AS dbl, age + id FROM users WHERE id = 1")
+        assert out.column("dbl").to_pylist() == [60]
+        assert out.column("age+id").to_pylist() == [31]
+
+    def test_aggregate_over_expression(self, session):
+        out = session.execute("SELECT sum(age * 2) AS s, avg(age + 0) AS a FROM users")
+        assert out.column("s").to_pylist() == [236]
+        assert out.column("a").to_pylist() == [29.5]
+
+    def test_grouped_expression_aggregate(self, session):
+        out = session.execute(
+            "SELECT city, sum(age * (1 + 0)) AS s FROM users GROUP BY city ORDER BY city"
+        )
+        assert out.column("s").to_pylist() == [53, 65]
+
+    def test_unary_minus_and_parens(self, session):
+        out = session.execute("SELECT (age - 30) * -1 AS neg FROM users WHERE id = 3")
+        assert out.column("neg").to_pylist() == [-5]
+        out2 = session.execute("SELECT id FROM users WHERE age > -100 AND id = 1")
+        assert out2.column("id").to_pylist() == [1]
+
+
+class TestTpchLite:
+    def test_harness_runs_and_is_consistent(self, tmp_warehouse):
+        from lakesoul_tpu.sql.tpch import TpchLite
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse / "tpch"))
+        h = TpchLite(catalog, scale_rows=5000, seed=1)
+        h.generate()
+        results = h.run_all()
+        assert set(results) == {
+            "q1_pricing_summary", "q3_shipping_priority",
+            "q6_forecast_revenue", "q_customer_revenue",
+        }
+        q1 = results["q1_pricing_summary"][1]
+        assert q1.column("returnflag").to_pylist() == ["A", "N", "R"]
+        # cross-check q6 against direct arrow compute
+        li = catalog.table("lineitem").to_arrow()
+        import pyarrow.compute as pc
+
+        mask = (
+            (pc.greater_equal(li["shipdate"], pa.scalar("1994-01-01")))
+            .to_pandas()
+            & (pc.less(li["shipdate"], pa.scalar("1995-01-01"))).to_pandas()
+            & (pc.greater_equal(li["discount"], pa.scalar(0.05))).to_pandas()
+            & (pc.less_equal(li["discount"], pa.scalar(0.07))).to_pandas()
+            & (pc.less(li["quantity"], pa.scalar(24.0))).to_pandas()
+        )
+        sub = li.to_pandas()[mask.values]
+        expected = float((sub["extendedprice"] * sub["discount"]).sum())
+        got = results["q6_forecast_revenue"][1].column("revenue").to_pylist()[0]
+        assert abs(got - expected) < 1e-6
+        q3 = results["q3_shipping_priority"][1]
+        assert q3.num_rows == 10
+        rev = q3.column("revenue").to_pylist()
+        assert rev == sorted(rev, reverse=True)
+
+
+class TestExpressionEdgeCases:
+    def test_literal_only_select(self, session):
+        out = session.execute("SELECT 1 AS one FROM users")
+        assert out.column("one").to_pylist() == [1, 1, 1, 1]
+
+    def test_aggregate_of_literal(self, session):
+        out = session.execute("SELECT sum(2) AS s FROM users")
+        assert out.column("s").to_pylist() == [8]  # 4 rows * 2
+        g = session.execute("SELECT city, sum(1) AS n FROM users GROUP BY city ORDER BY city")
+        assert g.column("n").to_pylist() == [2, 2]
+
+    def test_duplicate_labels_preserved(self, session):
+        out = session.execute("SELECT age, age FROM users WHERE id = 1")
+        assert out.num_columns == 2
+
+    def test_unary_minus_on_string_rejected(self, session):
+        from lakesoul_tpu.sql.parser import SqlError
+
+        with pytest.raises(SqlError, match="numeric"):
+            session.execute("SELECT id FROM users WHERE name = -'x'")
