@@ -107,8 +107,6 @@ def transform(b):
 
 
 def bench_lakesoul(t) -> float:
-    import functools
-
     import jax
     import jax.numpy as jnp
     import optax

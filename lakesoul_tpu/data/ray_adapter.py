@@ -47,8 +47,6 @@ def write_lakesoul(dataset, table) -> None:
         w.write_batch(batch)
         return {"outputs": [w.close()]}
 
-    import pyarrow as pa
-
     from lakesoul_tpu.meta import CommitOp, DataFileOp
 
     staged = dataset.map_batches(stage, batch_format="pyarrow").take_all()

@@ -12,8 +12,6 @@ import argparse
 import shlex
 import sys
 
-import pyarrow as pa
-
 
 class Console:
     SQL_STARTS = (

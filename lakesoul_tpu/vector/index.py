@@ -11,13 +11,12 @@ reference's base + delta segments; ``merge_deltas()`` folds them in."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
 from lakesoul_tpu.errors import VectorIndexError
 from lakesoul_tpu.vector.config import VectorIndexConfig
-from lakesoul_tpu.vector.kernels import bruteforce_topk, packed_scan
 from lakesoul_tpu.vector.kmeans import kmeans
 from lakesoul_tpu.vector.rabitq import RabitqQuantizer
 
