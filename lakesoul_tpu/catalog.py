@@ -57,6 +57,21 @@ class LakeSoulCatalog:
             client = MetaDataClient(db_path=db_path)
         self.client = client
         self.storage_options = storage_options or {}
+        # scan.cache() storage: small LRU of decoded tables, keyed by scan
+        # parameters + partition-version digest (commits invalidate naturally)
+        self._scan_cache: dict = {}
+        self._scan_cache_cap = 4
+
+    def _scan_cache_get(self, key):
+        hit = self._scan_cache.pop(key, None)
+        if hit is not None:
+            self._scan_cache[key] = hit  # LRU refresh
+        return hit
+
+    def _scan_cache_put(self, key, table) -> None:
+        self._scan_cache[key] = table
+        while len(self._scan_cache) > self._scan_cache_cap:
+            self._scan_cache.pop(next(iter(self._scan_cache)))
 
     # ------------------------------------------------------------------- DDL
     def create_table(
@@ -537,6 +552,7 @@ class LakeSoulScan:
         self._incremental: tuple[int, int | None] | None = None
         self._keep_cdc_deletes = False
         self._vector_search: tuple | None = None
+        self._cache = False
 
     def _replace(self, **kw) -> "LakeSoulScan":
         s = copy.copy(self)
@@ -583,6 +599,36 @@ class LakeSoulScan:
     def with_cdc_deletes(self) -> "LakeSoulScan":
         """Keep CDC delete rows (needed by incremental CDC consumers)."""
         return self._replace(_keep_cdc_deletes=True)
+
+    def cache(self) -> "LakeSoulScan":
+        """Cache this scan's decoded Arrow table in memory (tf.data
+        ``cache()`` role): epochs 2+ of a training loop skip decode+merge
+        entirely.  The cache key includes the partition version digest, so
+        any commit to the table invalidates it automatically."""
+        return self._replace(_cache=True)
+
+    def _cache_key(self) -> tuple:
+        info = self._table.info
+        heads = self._table.catalog.client.store.get_all_latest_partition_info(
+            info.table_id
+        )
+        version_digest = tuple(sorted((h.partition_desc, h.version) for h in heads))
+        import hashlib
+
+        schema_digest = hashlib.md5(info.table_schema_arrow_ipc).hexdigest()
+        return (
+            info.table_id,
+            schema_digest,  # add_columns invalidates even without a commit
+            version_digest,
+            tuple(self._columns) if self._columns is not None else None,
+            self._filter.to_json() if self._filter is not None else None,
+            tuple(sorted(self._partitions.items())),
+            self._rank,
+            self._world,
+            self._snapshot_ts,
+            self._incremental,
+            self._keep_cdc_deletes,
+        )
 
     def vector_search(self, column: str, query, *, top_k: int = 10, nprobe: int = 8) -> "LakeSoulScan":
         """ANN-filtered scan: search the table's index shards and inject a
@@ -680,6 +726,14 @@ class LakeSoulScan:
     def to_arrow(self) -> pa.Table:
         if self._vector_search is not None:
             return self._resolve_vector_search().to_arrow()
+        if self._cache:
+            key = self._cache_key()
+            hit = self._table.catalog._scan_cache_get(key)
+            if hit is not None:
+                return hit
+            result = self._replace(_cache=False).to_arrow()
+            self._table.catalog._scan_cache_put(key, result)
+            return result
         tables = []
         for unit in self.scan_plan():
             t = read_scan_unit(unit.data_files, unit.primary_keys, **self._unit_kwargs(unit))
@@ -699,6 +753,20 @@ class LakeSoulScan:
         overlap unit decodes like the reference's per-bucket tokio readers."""
         if self._vector_search is not None:
             yield from self._resolve_vector_search().to_batches(num_threads)
+            return
+        if self._cache:
+            key = self._cache_key()
+            hit = self._table.catalog._scan_cache_get(key)
+            if hit is None:
+                uncached = self._replace(_cache=False)
+                batches = list(uncached.to_batches(num_threads))
+                hit = (
+                    pa.Table.from_batches(batches)
+                    if batches
+                    else uncached.to_arrow()
+                )
+                self._table.catalog._scan_cache_put(key, hit)
+            yield from hit.to_batches(max_chunksize=self._batch_size)
             return
         units = self.scan_plan()
         if not num_threads or num_threads <= 1 or len(units) <= 1:

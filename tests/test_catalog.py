@@ -275,3 +275,56 @@ class TestReviewRegressions:
         t.upsert(pa.table({"id": [1], "v": [10.0], "name": ["A"]}))
         assert t.compact() == 1
         assert t.compact() == 0
+
+
+class TestScanCache:
+    def test_cached_epochs_skip_decode(self, catalog, monkeypatch):
+        t = seed_pk_table(catalog, name="cch")
+        calls = {"n": 0}
+        import lakesoul_tpu.catalog as cat_mod
+
+        orig = cat_mod.read_scan_unit
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(cat_mod, "read_scan_unit", counting)
+        scan = t.scan().cache()
+        first = scan.to_arrow()
+        after_first = calls["n"]
+        assert after_first > 0
+        second = scan.to_arrow()
+        assert calls["n"] == after_first  # cache hit: no re-decode
+        assert first.equals(second)
+        # batches + jax iter also served from cache
+        rows = sum(len(b) for b in t.scan().cache().batch_size(2).to_batches())
+        assert rows == 4
+        assert calls["n"] == after_first
+
+    def test_commit_invalidates_cache(self, catalog):
+        t = seed_pk_table(catalog, name="cch2")
+        scan = t.scan().cache()
+        assert scan.to_arrow().num_rows == 4
+        t.upsert(pa.table({"id": [9], "v": [9.0], "name": ["z"]}))
+        assert t.scan().cache().to_arrow().num_rows == 5  # new version, new key
+
+    def test_cache_capacity_bounded(self, catalog):
+        t = seed_pk_table(catalog, name="cch3")
+        for i in range(8):  # 8 distinct keys > cap=4 → eviction must run
+            t.scan().cache().select(["id"]).filter(col("id") > i).to_arrow()
+        assert len(catalog._scan_cache) == catalog._scan_cache_cap
+
+    def test_schema_evolution_invalidates_cache(self, catalog):
+        t = seed_pk_table(catalog, name="cch4")
+        assert "extra" not in t.scan().cache().to_arrow().column_names
+        t.add_columns(pa.field("extra", pa.string()))
+        got = t.scan().cache().to_arrow()
+        assert "extra" in got.column_names  # schema digest changed the key
+
+    def test_cache_miss_through_threaded_batches(self, catalog):
+        t = seed_pk_table(catalog, name="cch5", buckets=4)
+        rows = sum(len(b) for b in t.scan().cache().batch_size(2).to_batches(num_threads=3))
+        assert rows == 4
+        rows2 = sum(len(b) for b in t.scan().cache().batch_size(2).to_batches())
+        assert rows2 == 4  # second epoch from cache
