@@ -211,6 +211,7 @@ class LakeSoulTable:
                 files_by_partition,
                 op,
                 commit_id_by_partition=commit_id_by_partition,
+                storage_options=self.catalog.storage_options,
             )
         except CommitConflictError:
             # conflict = the partition-version insert never landed, so the

@@ -278,12 +278,15 @@ class MetaDataClient:
         *,
         commit_id_by_partition: dict[str, str] | None = None,
         read_partition_info: list[PartitionInfo] | None = None,
+        storage_options: dict | None = None,
     ) -> list[DataCommitInfo]:
         """Convenience used by writers: phase 1 (insert data commits) + phase 2
         (advance partition versions) in one call.  ``commit_id_by_partition``
         makes streaming ingest idempotent: a commit id that is already present
         and committed is skipped (the Flink exactly-once pattern,
-        LakeSoulSinkGlobalCommitter.java:95)."""
+        LakeSoulSinkGlobalCommitter.java:95).  A skipped replay deletes the
+        freshly re-staged duplicate files (they are unknown to the durable
+        commit and would otherwise orphan on the object store forever)."""
         new_commits: list[DataCommitInfo] = []
         partitions: list[PartitionInfo] = []
         done_ids: list[tuple[str, str]] = []  # (partition_desc, commit_id) to flag committed
@@ -291,7 +294,13 @@ class MetaDataClient:
             cid = (commit_id_by_partition or {}).get(desc) or DataCommitInfo.new_commit_id()
             state = self.store.commit_state(table_info.table_id, desc, cid)
             if state is True:
-                continue  # fully durable already: idempotent replay is a no-op
+                # fully durable already: idempotent replay is a no-op — but the
+                # replay re-staged fresh files under new names; drop any that
+                # the durable commit does not reference
+                self._delete_replay_orphans(
+                    table_info.table_id, desc, cid, file_ops, storage_options
+                )
+                continue
             if state is None:
                 new_commits.append(
                     DataCommitInfo(
@@ -305,8 +314,14 @@ class MetaDataClient:
                         domain=table_info.domain,
                     )
                 )
-            # state is False → the writer crashed between phase 1 and phase 2:
-            # skip the insert but re-run phase 2 so the files become visible
+            else:
+                # state is False → the writer crashed between phase 1 and
+                # phase 2: re-run phase 2 so the durable commit's files become
+                # visible.  The replay's re-staged files are not the ones the
+                # durable commit references — drop them like the state-True path
+                self._delete_replay_orphans(
+                    table_info.table_id, desc, cid, file_ops, storage_options
+                )
             partitions.append(
                 PartitionInfo(
                     table_id=table_info.table_id,
@@ -328,6 +343,28 @@ class MetaDataClient:
         for desc, cid in done_ids:
             self.store.mark_committed(table_info.table_id, desc, [cid])
         return new_commits
+
+    def _delete_replay_orphans(
+        self,
+        table_id: str,
+        partition_desc: str,
+        commit_id: str,
+        file_ops: list[DataFileOp],
+        storage_options: dict | None,
+    ) -> None:
+        """Best-effort removal of files staged by an idempotent replay whose
+        commit id was already durable (ADVICE r1: they were invisible to both
+        abort() and the cleaner)."""
+        from lakesoul_tpu.io.object_store import delete_file
+
+        durable = self.store.get_data_commit_info(table_id, partition_desc, [commit_id])
+        known = {op.path for c in durable for op in c.file_ops}
+        for op in file_ops:
+            if op.path not in known:
+                try:
+                    delete_file(op.path, storage_options)
+                except Exception:
+                    pass  # cleanup is advisory; never fail a successful replay
 
     # ------------------------------------------------------------ scan plans
     def _select_partitions(

@@ -667,7 +667,15 @@ class SqliteMetadataStore(SqlMetadataStore):
                 with conn:
                     yield conn
         else:
+            # explicit BEGIN IMMEDIATE: legacy sqlite3 transaction control does
+            # not open the implicit transaction for SELECTs, so a read+write
+            # pair (e.g. delete_partition_versions_before) would not actually
+            # share one transaction across processes without it
             with conn:
+                if not conn.in_transaction:
+                    # a "database is locked" timeout must propagate, not fall
+                    # through to a transaction-less body
+                    conn.execute("BEGIN IMMEDIATE")
                 yield conn
 
     def close(self) -> None:

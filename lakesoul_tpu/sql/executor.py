@@ -266,8 +266,12 @@ class SqlSession:
             else:
                 out = self._project(stmt.items, table)
 
-        for col_name, desc in reversed(stmt.order_by):
-            out = out.sort_by([(col_name, "descending" if desc else "ascending")])
+        if stmt.order_by:
+            # one multi-key sort: successive single-key sorts would need a
+            # documented-stable sort, which pyarrow does not guarantee
+            out = out.sort_by(
+                [(c, "descending" if d else "ascending") for c, d in stmt.order_by]
+            )
         if stmt.limit is not None:
             out = out.slice(0, stmt.limit)
         return out
@@ -290,8 +294,10 @@ class SqlSession:
                 if isinstance(it.expr, ast.Agg):
                     agg = it.expr
                     if agg.arg is None:
-                        target = stmt.group_by[0]
-                        pa_fn = "count"
+                        # COUNT(*) counts rows, not non-null values of some
+                        # column (a NULL group key must still count its rows)
+                        target = []
+                        pa_fn = "count_all"
                         label = it.alias or "count(*)"
                     else:
                         # aggregate over a computed expression: materialize a
@@ -318,7 +324,8 @@ class SqlSession:
                     cols.append(grouped.column(it.expr.name))
                     labels.append(it.alias or it.expr.name)
             for (target, pa_fn), label in zip(specs, names):
-                cols.append(grouped.column(f"{target}_{pa_fn}"))
+                col = "count_all" if pa_fn == "count_all" else f"{target}_{pa_fn}"
+                cols.append(grouped.column(col))
                 labels.append(label)
             return pa.table(dict(zip(labels, cols)))
         # global aggregates

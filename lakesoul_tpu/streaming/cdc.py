@@ -84,6 +84,7 @@ class CheckpointedWriter:
             files_by_partition,
             self.commit_op,
             commit_id_by_partition=commit_ids,
+            storage_options=self.table.io_config().object_store_options,
         )
         return len(committed)
 

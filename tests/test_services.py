@@ -91,6 +91,22 @@ class TestCheckpointedWriter:
         assert head.version == 0  # only one commit landed
         assert t.to_arrow().num_rows == 2
 
+    def test_replay_deletes_restaged_orphans(self, catalog, tmp_path):
+        # ADVICE r1: a replayed checkpoint re-stages fresh parquet files under
+        # new names; since the commit id is already durable they are skipped —
+        # they must be deleted, not silently orphaned on the object store
+        import glob
+
+        t = catalog.create_table("cko", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        w = CheckpointedWriter(t)
+        w.write(pa.table({"id": [1, 2], "v": [1.0, 2.0]}))
+        assert w.checkpoint(1) == 1
+        files_after_commit = set(glob.glob(f"{t.info.table_path}/**/*.parquet", recursive=True))
+        w.write(pa.table({"id": [1, 2], "v": [1.0, 2.0]}))
+        assert w.checkpoint(1) == 0  # replay
+        files_after_replay = set(glob.glob(f"{t.info.table_path}/**/*.parquet", recursive=True))
+        assert files_after_replay == files_after_commit
+
     def test_multiple_epochs_accumulate(self, catalog):
         t = catalog.create_table("ck2", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
         w = CheckpointedWriter(t)

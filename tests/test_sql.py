@@ -87,6 +87,21 @@ class TestExecute:
         assert out.column("n").to_pylist() == [2, 2]
         assert out.column("mean_age").to_pylist() == [26.5, 32.5]
 
+    def test_group_by_null_key_counts_rows(self, session):
+        # COUNT(*) over a group whose key is NULL must count rows, not
+        # non-null key values (ADVICE r1)
+        session.execute("INSERT INTO users (id, name, age) VALUES (6, 'f', 1), (7, 'g', 2)")
+        out = session.execute(
+            "SELECT city, count(*) AS n FROM users GROUP BY city ORDER BY n"
+        )
+        assert dict(zip(out.column("city").to_pylist(), out.column("n").to_pylist()))[None] == 2
+
+    def test_multi_key_order_by(self, session):
+        session.execute("INSERT INTO users VALUES (8, 'hank', 30, 'nyc')")
+        out = session.execute("SELECT age, id FROM users ORDER BY age DESC, id DESC")
+        pairs = list(zip(out.column("age").to_pylist(), out.column("id").to_pylist()))
+        assert pairs == sorted(pairs, key=lambda p: (-p[0], -p[1]))
+
     def test_upsert_semantics_via_insert(self, session):
         session.execute("INSERT INTO users VALUES (1, 'ALICE', 31, 'sf')")
         out = session.execute("SELECT name, age FROM users WHERE id = 1")
