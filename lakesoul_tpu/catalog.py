@@ -11,6 +11,7 @@ adapters).
 from __future__ import annotations
 
 import copy
+import threading
 from typing import Any, Iterable, Iterator
 
 import numpy as np
@@ -486,16 +487,22 @@ class LakeSoulTable:
             writer = TableWriter(cfg, self._info.table_path)
             old_files = []
             for unit in units:
-                merged = read_scan_unit(
+                # streamed merge: a bucket deeper than the byte budget compacts
+                # with flat memory (merged windows feed the writer, whose own
+                # budget rolls oversized cells into several sorted files)
+                for batch in iter_scan_unit_batches(
                     unit.data_files,
                     unit.primary_keys,
+                    batch_size=cfg.batch_size,
+                    memory_budget_bytes=cfg.memory_budget_bytes,
+                    file_sizes=unit.file_sizes,
                     schema=self.schema,
                     partition_values=unit.partition_values,
                     merge_operators=cfg.merge_operators,
                     cdc_column=None,  # keep CDC rows through compaction
-                )
-                if len(merged):
-                    writer.write_batch(merged)
+                ):
+                    if len(batch):
+                        writer.write_batch(batch)
                 old_files.extend(unit.data_files)
             outputs = writer.close()
             self._commit_partition_rewrite(head, outputs, old_files, CommitOp.COMPACTION)
@@ -771,46 +778,87 @@ class LakeSoulScan:
             return
         units = self.scan_plan()
         if not num_threads or num_threads <= 1 or len(units) <= 1:
+            budget = self._table.io_config().memory_budget_bytes
             for unit in units:
                 yield from iter_scan_unit_batches(
                     unit.data_files,
                     unit.primary_keys,
                     batch_size=self._batch_size,
+                    memory_budget_bytes=budget,
+                    file_sizes=unit.file_sizes,
                     **self._unit_kwargs(unit),
                 )
             return
+        import queue as _queue
         from concurrent.futures import ThreadPoolExecutor
 
-        # work items: merge units stay whole (the merge needs all files), but
-        # plain units split per file so peak memory stays at file granularity
-        # like the sequential streaming path
-        items: list[tuple[ScanPlanPartition, list[str]]] = []
+        # work items: merge units stay whole (the merge needs all streams of
+        # a bucket), plain units split per file; every item STREAMS its
+        # batches into a small bounded queue, so the in-flight window holds
+        # a few batches per unit — never a materialized unit.  The byte
+        # budget splits across the concurrent units.
+        items: list[tuple[ScanPlanPartition, list[str], list[int] | None]] = []
         cfg = self._table.io_config()
         for u in units:
             if u.primary_keys or cfg.merge_operators:
-                items.append((u, u.data_files))
+                items.append((u, u.data_files, u.file_sizes))
+            elif u.file_sizes and len(u.file_sizes) == len(u.data_files):
+                items.extend(
+                    (u, [f], [s]) for f, s in zip(u.data_files, u.file_sizes)
+                )
             else:
-                items.extend((u, [f]) for f in u.data_files)
-
-        def read(item):
-            unit, files = item
-            return read_scan_unit(files, unit.primary_keys, **self._unit_kwargs(unit))
+                items.extend((u, [f], None) for f in u.data_files)
 
         window = num_threads + 1
+        unit_budget = max(8 << 20, cfg.memory_budget_bytes // window)
+        _DONE = object()
+
+        def stream(item, q: _queue.Queue, stop: threading.Event):
+            unit, files, sizes = item
+            try:
+                for batch in iter_scan_unit_batches(
+                    files,
+                    unit.primary_keys,
+                    batch_size=self._batch_size,
+                    memory_budget_bytes=unit_budget,
+                    file_sizes=sizes,
+                    **self._unit_kwargs(unit),
+                ):
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except _queue.Full:
+                            continue
+                    else:
+                        return
+                q.put(_DONE)
+            except BaseException as e:  # surface errors to the consumer
+                q.put(e)
+
+        stop = threading.Event()
+        queues: list[_queue.Queue] = [_queue.Queue(maxsize=4) for _ in items]
         ex = ThreadPoolExecutor(max_workers=num_threads)
         try:
-            futures = [ex.submit(read, it) for it in items[:window]]
+            for it, q in zip(items[:window], queues[:window]):
+                ex.submit(stream, it, q, stop)
             next_item = window
             for i in range(len(items)):
-                table = futures[i].result()
-                futures[i] = None  # release the decoded table once consumed
+                q = queues[i]
+                while True:
+                    got = q.get()
+                    if got is _DONE:
+                        break
+                    if isinstance(got, BaseException):
+                        raise got
+                    yield got
+                queues[i] = None  # release
                 if next_item < len(items):
-                    futures.append(ex.submit(read, items[next_item]))
+                    ex.submit(stream, items[next_item], queues[next_item], stop)
                     next_item += 1
-                yield from table.to_batches(max_chunksize=self._batch_size)
-                del table
         finally:
-            # abandoned generator: don't block on (or start) remaining decodes
+            # abandoned generator: unblock and stop producers
+            stop.set()
             ex.shutdown(wait=False, cancel_futures=True)
 
     def count_rows(self) -> int:

@@ -25,6 +25,7 @@ OPTION_VECTOR_SEARCH_NPROBE = "vector_search_nprobe"
 
 DEFAULT_BATCH_SIZE = 8192
 DEFAULT_MAX_ROW_GROUP_SIZE = 250_000
+DEFAULT_MEMORY_BUDGET = 256 << 20  # single source for IOConfig + direct readers
 
 
 @dataclass
@@ -56,6 +57,14 @@ class IOConfig:
     max_row_group_size: int = DEFAULT_MAX_ROW_GROUP_SIZE
     # target max rows per staged file before rolling to a new one
     max_file_rows: int = 5_000_000
+    # physical file format for new files ("parquet" | "arrow"); readers
+    # dispatch per file extension, so mixed-format tables read fine
+    # (reference: file_format.rs:46-150 format registry)
+    file_format: str = "parquet"
+    # byte budget for buffered/streamed data: the writer auto-flushes sorted
+    # runs past it (role of mem/pool.rs + sort spill, physical_plan/spill.rs)
+    # and the streaming MOR reader sizes its merge windows from it
+    memory_budget_bytes: int = DEFAULT_MEMORY_BUDGET
     # free-form option map + object-store options (endpoint, keys, ...)
     options: dict[str, str] = field(default_factory=dict)
     object_store_options: dict[str, str] = field(default_factory=dict)

@@ -6,19 +6,28 @@ CDC delete filtering, schema evolution fill, and partition-column
 reconstruction.  Capability parity with LakeSoulReader::start →
 build_physical_plan (reader.rs:148-246, session.rs:794-1036), minus the
 DataFusion plumbing: the plan here *is* the code path.
+
+Two execution modes share one plan:
+
+- ``read_scan_unit`` materializes the unit (to_arrow, threaded decode).
+- ``iter_scan_unit_batches`` **streams** it with bounded memory: PK units go
+  through the watermark-window merger (io/streaming_merge.py — the role of
+  the reference's sorted_stream_merger.rs:317), non-PK units stream file by
+  file; neither ever holds a whole bucket.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Iterator
 
 import pyarrow as pa
 import pyarrow.dataset as pads
-import pyarrow.parquet as pq
 
+from lakesoul_tpu.io.config import DEFAULT_MEMORY_BUDGET
 from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.io.formats import format_for
 from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
-from lakesoul_tpu.io.object_store import filesystem_for
 
 
 def _read_one_file(
@@ -28,58 +37,31 @@ def _read_one_file(
     arrow_filter,
     storage_options: dict | None,
 ) -> pa.Table:
-    fs, p = filesystem_for(path, storage_options)
-    import fsspec.implementations.local
-
-    local = isinstance(fs, fsspec.implementations.local.LocalFileSystem)
-    if arrow_filter is not None:
-        ds = pads.dataset(p, format="parquet", filesystem=fs)
-        try:
-            return ds.to_table(columns=columns, filter=arrow_filter)
-        except (pa.lib.ArrowInvalid, KeyError):
-            # schema evolution: the file predates add_columns.  Drop missing
-            # projected columns (uniform_table fills them) and skip pushdown
-            # when the filter references a missing column — the caller's
-            # post-merge filter applies exact semantics over the null fill.
-            avail = set(ds.schema.names)
-            cols = [c for c in columns if c in avail] if columns is not None else None
-            try:
-                return ds.to_table(columns=cols, filter=arrow_filter)
-            except (pa.lib.ArrowInvalid, KeyError):
-                return ds.to_table(columns=cols)
-    try:
-        if local:
-            # local files: memory-map instead of read-into-buffer (~1.5x decode)
-            return pq.read_table(p, columns=columns, memory_map=True)
-        return pq.read_table(p, columns=columns, filesystem=fs)
-    except (pa.lib.ArrowInvalid, KeyError):
-        avail = set(pq.read_schema(p, filesystem=None if local else fs, memory_map=local).names)
-        cols = [c for c in columns if c in avail] if columns is not None else None
-        if local:
-            return pq.read_table(p, columns=cols, memory_map=True)
-        return pq.read_table(p, columns=cols, filesystem=fs)
+    return format_for(path).read_table(
+        path, columns=columns, arrow_filter=arrow_filter, storage_options=storage_options
+    )
 
 
-def read_scan_unit(
-    files: list[str],
+@dataclass
+class _UnitPlan:
+    """Resolved read plan for one scan unit (projection closure, file schema,
+    pushdown-safe file filter, exact post-merge filter)."""
+
+    read_columns: list[str] | None
+    file_schema: pa.Schema | None
+    file_filter: object | None
+    post_filter: object | None
+
+
+def _plan_unit(
     primary_keys: list[str],
     *,
-    schema: pa.Schema | None = None,
-    partition_values: dict[str, str] | None = None,
-    filter: Filter | None = None,
-    merge_operators: dict[str, str] | None = None,
-    cdc_column: str | None = None,
-    drop_cdc_deletes: bool = True,
-    columns: list[str] | None = None,
-    defaults: dict | None = None,
-    storage_options: dict | None = None,
-) -> pa.Table:
-    """Read + merge one scan unit into a single Arrow table.
-
-    ``schema`` is the full table schema (incl. range-partition columns);
-    ``partition_values`` fills the directory-encoded columns back in
-    (reference: stream/default_column.rs)."""
-    partition_values = partition_values or {}
+    schema: pa.Schema | None,
+    partition_values: dict[str, str],
+    filter: Filter | None,
+    cdc_column: str | None,
+    columns: list[str] | None,
+) -> _UnitPlan:
     arrow_filter = filter.to_arrow() if filter is not None else None
 
     # columns that must be read even if projected away later: PKs for the
@@ -101,9 +83,7 @@ def read_scan_unit(
     # file-level schema: table schema minus directory-encoded partition cols
     file_schema = None
     if schema is not None:
-        file_schema = pa.schema(
-            [f for f in schema if f.name not in partition_values]
-        )
+        file_schema = pa.schema([f for f in schema if f.name not in partition_values])
         if read_columns is not None:
             file_schema = pa.schema([f for f in file_schema if f.name in read_columns])
 
@@ -126,30 +106,21 @@ def read_scan_unit(
             # file to skip it), so the exact filter is always re-applied
             # post-merge
             file_filter = arrow_filter
+    return _UnitPlan(read_columns, file_schema, file_filter, post_filter)
 
-    tables = []
-    for path in files:
-        t = _read_one_file(
-            path,
-            columns=read_columns,
-            arrow_filter=file_filter,
-            storage_options=storage_options,
-        )
-        if file_schema is not None:
-            t = uniform_table(t, file_schema, defaults)
-        tables.append(t)
 
-    if primary_keys and len(tables) >= 1:
-        merged = merge_sorted_tables(
-            tables,
-            primary_keys,
-            merge_operators=merge_operators,
-            target_schema=file_schema,
-            defaults=defaults,
-        )
-    else:
-        merged = pa.concat_tables(tables) if tables else pa.table({})
-
+def _postprocess(
+    merged: pa.Table,
+    *,
+    schema: pa.Schema | None,
+    partition_values: dict[str, str],
+    cdc_column: str | None,
+    drop_cdc_deletes: bool,
+    post_filter,
+    columns: list[str] | None,
+) -> pa.Table:
+    """Post-merge tail shared by both execution modes: partition-column fill,
+    CDC delete filter, exact filter re-application, final projection."""
     # fill directory-encoded partition columns back in (all of them — the
     # post-merge filter may reference partition columns that the final
     # projection drops)
@@ -181,25 +152,204 @@ def read_scan_unit(
     return merged
 
 
+def read_scan_unit(
+    files: list[str],
+    primary_keys: list[str],
+    *,
+    schema: pa.Schema | None = None,
+    partition_values: dict[str, str] | None = None,
+    filter: Filter | None = None,
+    merge_operators: dict[str, str] | None = None,
+    cdc_column: str | None = None,
+    drop_cdc_deletes: bool = True,
+    columns: list[str] | None = None,
+    defaults: dict | None = None,
+    storage_options: dict | None = None,
+) -> pa.Table:
+    """Read + merge one scan unit into a single Arrow table.
+
+    ``schema`` is the full table schema (incl. range-partition columns);
+    ``partition_values`` fills the directory-encoded columns back in
+    (reference: stream/default_column.rs)."""
+    partition_values = partition_values or {}
+    plan = _plan_unit(
+        primary_keys,
+        schema=schema,
+        partition_values=partition_values,
+        filter=filter,
+        cdc_column=cdc_column,
+        columns=columns,
+    )
+
+    tables = []
+    for path in files:
+        t = _read_one_file(
+            path,
+            columns=plan.read_columns,
+            arrow_filter=plan.file_filter,
+            storage_options=storage_options,
+        )
+        if plan.file_schema is not None:
+            t = uniform_table(t, plan.file_schema, defaults)
+        tables.append(t)
+
+    if primary_keys and len(tables) >= 1:
+        merged = merge_sorted_tables(
+            tables,
+            primary_keys,
+            merge_operators=merge_operators,
+            target_schema=plan.file_schema,
+            defaults=defaults,
+        )
+    else:
+        merged = pa.concat_tables(tables) if tables else pa.table({})
+
+    return _postprocess(
+        merged,
+        schema=schema,
+        partition_values=partition_values,
+        cdc_column=cdc_column,
+        drop_cdc_deletes=drop_cdc_deletes,
+        post_filter=plan.post_filter,
+        columns=columns,
+    )
+
+
+def _stream_batch_rows(
+    file_schema: pa.Schema | None, n_files: int, memory_budget_bytes: int
+) -> int:
+    """Per-stream load size so that n_files buffered stream batches plus one
+    merge window stay within the budget."""
+    from lakesoul_tpu.io.streaming_merge import (
+        DEFAULT_STREAM_BATCH_ROWS,
+        MIN_STREAM_BATCH_ROWS,
+    )
+
+    width = 64  # fallback row-width guess
+    if file_schema is not None:
+        width = 0
+        for f in file_schema:
+            try:
+                width += (f.type.bit_width + 7) // 8
+            except ValueError:
+                width += 32  # var-width (string/binary) estimate
+        width = max(width, 8)
+    # budget splits across: per-stream buffers (n_files), the concat window
+    # (~n_files worth) and the merge's sort scratch (~2x window)
+    rows = memory_budget_bytes // max(1, 4 * n_files * width)
+    return max(MIN_STREAM_BATCH_ROWS, min(DEFAULT_STREAM_BATCH_ROWS, int(rows)))
+
+
+# decoded-size multiplier over on-disk bytes when deciding whether a unit
+# fits the budget (lz4 numeric data ≈ 1-1.5x; strings compress harder)
+_DECODE_EXPANSION = 3
+
+
 def iter_scan_unit_batches(
     files: list[str],
     primary_keys: list[str],
     *,
     batch_size: int = 8192,
-    **kwargs,
+    memory_budget_bytes: int = DEFAULT_MEMORY_BUDGET,
+    file_sizes: list[int] | None = None,
+    schema: pa.Schema | None = None,
+    partition_values: dict[str, str] | None = None,
+    filter: Filter | None = None,
+    merge_operators: dict[str, str] | None = None,
+    cdc_column: str | None = None,
+    drop_cdc_deletes: bool = True,
+    columns: list[str] | None = None,
+    defaults: dict | None = None,
+    storage_options: dict | None = None,
 ) -> Iterator[pa.RecordBatch]:
-    """Stream one scan unit as RecordBatches.
+    """Stream one scan unit as RecordBatches with bounded memory.
 
-    Non-PK units stream file-by-file without materializing the whole unit;
-    PK units must merge the unit first (bounded by bucket size — the
-    reference has the same property per bucket)."""
-    if not primary_keys and kwargs.get("merge_operators") is None:
+    Hybrid execution: when ``file_sizes`` (known from commit metadata) prove
+    the whole unit fits comfortably inside ``memory_budget_bytes``, the unit
+    is materialized — pyarrow's multi-threaded decode is much faster than a
+    synchronous stream and the budget holds by construction.  Otherwise PK
+    units merge incrementally through watermark windows
+    (io/streaming_merge.py) and non-PK units stream file by file, so peak
+    memory is governed by the budget, not bucket size — the property the
+    reference gets from its loser-tree stream merger
+    (sorted_stream_merger.rs:317) and memory pool (mem/pool.rs)."""
+    partition_values = partition_values or {}
+    if file_sizes and len(file_sizes) == len(files):
+        est = sum(file_sizes) * _DECODE_EXPANSION
+        if est <= memory_budget_bytes:
+            table = read_scan_unit(
+                files,
+                primary_keys,
+                schema=schema,
+                partition_values=partition_values,
+                filter=filter,
+                merge_operators=merge_operators,
+                cdc_column=cdc_column,
+                drop_cdc_deletes=drop_cdc_deletes,
+                columns=columns,
+                defaults=defaults,
+                storage_options=storage_options,
+            )
+            yield from table.to_batches(max_chunksize=batch_size)
+            return
+    plan = _plan_unit(
+        primary_keys,
+        schema=schema,
+        partition_values=partition_values,
+        filter=filter,
+        cdc_column=cdc_column,
+        columns=columns,
+    )
+
+    def post(t: pa.Table) -> pa.Table:
+        return _postprocess(
+            t,
+            schema=schema,
+            partition_values=partition_values,
+            cdc_column=cdc_column,
+            drop_cdc_deletes=drop_cdc_deletes,
+            post_filter=plan.post_filter,
+            columns=columns,
+        )
+
+    if not primary_keys:
+        # merge operators are PK-group reductions; without PKs they are a
+        # no-op and files simply concatenate
+        rows = _stream_batch_rows(plan.file_schema, 1, memory_budget_bytes)
         for path in files:
-            t = read_scan_unit([path], [], **kwargs)
-            yield from t.to_batches(max_chunksize=batch_size)
+            fmt = format_for(path)
+            for batch in fmt.iter_batches(
+                path,
+                columns=plan.read_columns,
+                arrow_filter=plan.file_filter,
+                batch_size=rows,
+                storage_options=storage_options,
+            ):
+                t = pa.Table.from_batches([batch])
+                if plan.file_schema is not None:
+                    t = uniform_table(t, plan.file_schema, defaults)
+                t = post(t)
+                if len(t):
+                    yield from t.to_batches(max_chunksize=batch_size)
         return
-    table = read_scan_unit(files, primary_keys, **kwargs)
-    yield from table.to_batches(max_chunksize=batch_size)
+
+    from lakesoul_tpu.io.streaming_merge import iter_merged_windows
+
+    rows = _stream_batch_rows(plan.file_schema, len(files), memory_budget_bytes)
+    for window in iter_merged_windows(
+        files,
+        primary_keys,
+        file_schema=plan.file_schema,
+        columns=plan.read_columns,
+        arrow_filter=plan.file_filter,
+        merge_operators=merge_operators,
+        defaults=defaults,
+        storage_options=storage_options,
+        stream_batch_rows=rows,
+    ):
+        t = post(window)
+        if len(t):
+            yield from t.to_batches(max_chunksize=batch_size)
 
 
 def _filter_column_names(flt: Filter) -> set[str]:

@@ -23,11 +23,11 @@ from dataclasses import dataclass
 import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
-import pyarrow.parquet as pq
 
 from lakesoul_tpu.errors import IOError_
 from lakesoul_tpu.io.config import IOConfig
-from lakesoul_tpu.io.object_store import delete_file, ensure_dir, filesystem_for
+from lakesoul_tpu.io.formats import format_by_name
+from lakesoul_tpu.io.object_store import delete_file, ensure_dir
 from lakesoul_tpu.meta.entity import NO_PARTITION_DESC
 from lakesoul_tpu.utils import spark_hash
 
@@ -64,6 +64,7 @@ class TableWriter:
         self._cells: dict[tuple[str, int], list[pa.Table]] = {}
         self._staged: list[FlushOutput] = []
         self._buffered_rows = 0
+        self._buffered_bytes = 0
         self._closed = False
 
     # ------------------------------------------------------------------ write
@@ -80,11 +81,17 @@ class TableWriter:
         for (desc, bucket), piece in self._split(table).items():
             self._cells.setdefault((desc, bucket), []).append(piece)
         self._buffered_rows += len(table)
-        # bounded memory: spill buffered cells to staged parquet files once
-        # the row budget is hit (role of the reference's memory pool + sort
-        # spill, mem/pool.rs + physical_plan/spill.rs — extra files per cell
-        # simply deepen the merge stack until compaction)
-        if self._buffered_rows >= self.config.max_file_rows:
+        self._buffered_bytes += table.nbytes
+        # bounded memory: spill buffered cells to staged sorted files once the
+        # row or byte budget is hit (role of the reference's memory pool +
+        # sort spill, mem/pool.rs + physical_plan/spill.rs — the staged files
+        # ARE the sorted spill runs; the streaming merger re-combines them at
+        # read/compaction time, and extra files per cell simply deepen the
+        # merge stack until compaction)
+        if (
+            self._buffered_rows >= self.config.max_file_rows
+            or self._buffered_bytes >= self.config.memory_budget_bytes
+        ):
             self.flush()
 
     def _split(self, table: pa.Table) -> dict[tuple[str, int], pa.Table]:
@@ -152,26 +159,13 @@ class TableWriter:
             file_table = cell.select(
                 [f.name for f in cfg.schema if f.name not in cfg.range_partitions]
             )
-            path = self._target_path(desc, bucket)
-            fs, p = filesystem_for(path, cfg.object_store_options, write=True)
-            pq.write_table(
-                file_table,
-                p,
-                filesystem=fs,
-                compression=cfg.compression,
-                # level only applies to leveled codecs (zstd/gzip/brotli)
-                compression_level=(
-                    cfg.compression_level
-                    if cfg.compression in ("zstd", "gzip", "brotli")
-                    else None
-                ),
-                use_dictionary=False,
-                row_group_size=cfg.max_row_group_size,
-            )
+            fmt = format_by_name(cfg.file_format)
+            path = self._target_path(desc, bucket, fmt)
+            size = fmt.write_table(file_table, path, config=cfg)
             out = FlushOutput(
                 partition_desc=desc,
                 path=path,
-                size=fs.size(p),
+                size=size,
                 row_count=len(file_table),
                 file_exist_cols=",".join(file_table.column_names),
                 bucket_id=bucket,
@@ -180,15 +174,16 @@ class TableWriter:
             self._staged.append(out)
         self._cells.clear()
         self._buffered_rows = 0
+        self._buffered_bytes = 0
         return outputs
 
-    def _target_path(self, desc: str, bucket: int) -> str:
+    def _target_path(self, desc: str, bucket: int, fmt) -> str:
         dir_path = self.table_path
         if desc != NO_PARTITION_DESC:
             dir_path = f"{dir_path}/{desc.replace(',', '/')}"
         ensure_dir(dir_path, self.config.object_store_options)
         suffix = max(bucket, 0)
-        return f"{dir_path}/part-{_file_token()}_{suffix:04d}.parquet"
+        return f"{dir_path}/part-{_file_token()}_{suffix:04d}{fmt.extensions[0]}"
 
     # ------------------------------------------------------------------ take
     def take_staged(self) -> list[FlushOutput]:

@@ -79,6 +79,9 @@ class ScanPlanPartition:
     bucket_id: int = -1
     partition_desc: str = NO_PARTITION_DESC
     partition_values: dict[str, str] = field(default_factory=dict)
+    # on-disk bytes per data file (from DataFileOp.size); lets readers choose
+    # materialize-vs-stream without extra object-store HEAD requests
+    file_sizes: list[int] = field(default_factory=list)
 
     @property
     def needs_merge(self) -> bool:
@@ -427,26 +430,28 @@ class MetaDataClient:
                         primary_keys=[],
                         partition_desc=partition.partition_desc,
                         partition_values=values,
+                        file_sizes=[f.size for f in file_ops],
                     )
                 )
                 continue
-            by_bucket: dict[int, list[str]] = {}
+            by_bucket: dict[int, list[tuple[str, int]]] = {}
             for f in file_ops:
                 bucket = extract_hash_bucket_id(f.path)
                 if bucket is None:
                     raise MetadataError(
                         f"cannot determine bucket id from file name {f.path}"
                     )
-                by_bucket.setdefault(bucket, []).append(f.path)
+                by_bucket.setdefault(bucket, []).append((f.path, f.size))
             merge_pks = [] if partition.commit_op == CommitOp.COMPACTION else pk_cols
             for bucket_id, bucket_files in sorted(by_bucket.items()):
                 plan.append(
                     ScanPlanPartition(
-                        data_files=bucket_files,
+                        data_files=[p for p, _ in bucket_files],
                         primary_keys=merge_pks,
                         bucket_id=bucket_id,
                         partition_desc=partition.partition_desc,
                         partition_values=values,
+                        file_sizes=[s for _, s in bucket_files],
                     )
                 )
         return plan
@@ -531,25 +536,27 @@ class MetaDataClient:
                             primary_keys=[],
                             partition_desc=head.partition_desc,
                             partition_values=values,
+                            file_sizes=[f.size for f in files],
                         )
                     )
                 continue
-            by_bucket: dict[int, list[str]] = {}
+            by_bucket: dict[int, list[tuple[str, int]]] = {}
             for f in files:
                 bucket = extract_hash_bucket_id(f.path)
                 if bucket is None:
                     raise MetadataError(
                         f"cannot determine bucket id from file name {f.path}"
                     )
-                by_bucket.setdefault(bucket, []).append(f.path)
+                by_bucket.setdefault(bucket, []).append((f.path, f.size))
             for bucket_id, bucket_files in sorted(by_bucket.items()):
                 plan.append(
                     ScanPlanPartition(
-                        data_files=bucket_files,
+                        data_files=[p for p, _ in bucket_files],
                         primary_keys=pk_cols,
                         bucket_id=bucket_id,
                         partition_desc=head.partition_desc,
                         partition_values=values,
+                        file_sizes=[s for _, s in bucket_files],
                     )
                 )
         return plan

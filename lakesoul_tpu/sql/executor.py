@@ -317,7 +317,16 @@ class SqlSession:
                         raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
                 else:
                     raise SqlError("non-aggregate expressions in GROUP BY selects not supported")
-            grouped = work.group_by(stmt.group_by).aggregate(specs)
+            # dedup count_all: several COUNT(*) items share one aggregate
+            # column (duplicate specs would collide in the grouped schema)
+            call_specs, seen_count_all = [], False
+            for spec in specs:
+                if spec[1] == "count_all":
+                    if seen_count_all:
+                        continue
+                    seen_count_all = True
+                call_specs.append(spec)
+            grouped = work.group_by(stmt.group_by).aggregate(call_specs)
             cols, labels = [], []
             for it in stmt.items:
                 if isinstance(it.expr, ast.Column):

@@ -1,0 +1,362 @@
+"""Streaming merge-on-read: equivalence with the materialized merge and the
+bounded-memory property (VERDICT r1 #1).
+
+The watermark-window merger (io/streaming_merge.py) must produce byte-
+identical results to merge_sorted_tables over fully materialized files, for
+every PK shape / merge operator / CDC case, while holding peak Arrow
+allocation far below the materialized table size."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.io.merge import merge_sorted_tables
+from lakesoul_tpu.io.reader import iter_scan_unit_batches, read_scan_unit
+from lakesoul_tpu.io.streaming_merge import iter_merged_windows
+
+
+def _write_sorted_run(path, table, pks):
+    """Write one file the way the writer does: sorted by PK, stable."""
+    import pyarrow.compute as pc
+
+    order = pa.array(np.arange(len(table), dtype=np.int64))
+    idx = pc.sort_indices(
+        table.append_column("__row_order", order),
+        sort_keys=[(k, "ascending") for k in pks] + [("__row_order", "ascending")],
+    )
+    pq.write_table(table.take(idx), path, row_group_size=64)
+
+
+def _merged_equal(a: pa.Table, b: pa.Table):
+    assert a.schema.names == b.schema.names
+    assert a.num_rows == b.num_rows
+    for name in a.schema.names:
+        assert a.column(name).to_pylist() == b.column(name).to_pylist(), name
+
+
+class TestWindowedMergeEquivalence:
+    """iter_merged_windows vs merge_sorted_tables on the same runs, with tiny
+    stream batches to force many windows and stalls."""
+
+    @pytest.mark.parametrize("batch_rows", [3, 7, 64])
+    def test_int_pk_upserts(self, tmp_path, batch_rows):
+        rng = np.random.default_rng(0)
+        pks = ["id"]
+        files = []
+        tables = []
+        for i in range(4):
+            n = 200
+            ids = rng.choice(300, n, replace=False).astype(np.int64)
+            t = pa.table({"id": ids, "v": rng.normal(size=n), "tag": [f"f{i}"] * n})
+            p = str(tmp_path / f"run_{i}_0000.parquet")
+            _write_sorted_run(p, t, pks)
+            files.append(p)
+            tables.append(pq.read_table(p))
+        expected = merge_sorted_tables(tables, pks)
+        got = pa.concat_tables(
+            list(iter_merged_windows(files, pks, stream_batch_rows=batch_rows))
+        )
+        _merged_equal(expected, got)
+
+    def test_string_pk_with_duplicate_runs(self, tmp_path):
+        # heavy duplication: single-key groups span whole stream batches,
+        # exercising the stall-resolution path
+        pks = ["k"]
+        keys = [f"key_{i % 5}" for i in range(150)]
+        files, tables = [], []
+        for i in range(3):
+            t = pa.table({"k": keys, "v": list(range(i * 1000, i * 1000 + 150))})
+            p = str(tmp_path / f"dup_{i}_0000.parquet")
+            _write_sorted_run(p, t, pks)
+            files.append(p)
+            tables.append(pq.read_table(p))
+        expected = merge_sorted_tables(tables, pks)
+        got = pa.concat_tables(
+            list(iter_merged_windows(files, pks, stream_batch_rows=4))
+        )
+        _merged_equal(expected, got)
+
+    @pytest.mark.parametrize("batch_rows", [5, 32])
+    def test_composite_pk_and_merge_operators(self, tmp_path, batch_rows):
+        rng = np.random.default_rng(1)
+        pks = ["a", "b"]
+        ops = {"s": "SumAll", "last": "UseLastNotNull", "j": "JoinedAllByComma"}
+        files, tables = [], []
+        for i in range(3):
+            n = 120
+            t = pa.table(
+                {
+                    "a": rng.integers(0, 10, n).astype(np.int64),
+                    "b": pa.array([f"b{x}" for x in rng.integers(0, 6, n)]),
+                    "s": rng.integers(0, 100, n).astype(np.int64),
+                    "last": pa.array(
+                        [None if x % 3 == 0 else float(x) for x in range(n)]
+                    ),
+                    "j": pa.array([f"v{i}_{x % 4}" for x in range(n)]),
+                }
+            )
+            p = str(tmp_path / f"comp_{i}_0000.parquet")
+            _write_sorted_run(p, t, pks)
+            files.append(p)
+            tables.append(pq.read_table(p))
+        expected = merge_sorted_tables(tables, pks, merge_operators=ops)
+        got = pa.concat_tables(
+            list(
+                iter_merged_windows(
+                    files, pks, merge_operators=ops, stream_batch_rows=batch_rows
+                )
+            )
+        )
+        _merged_equal(expected, got)
+
+    def test_pushed_filter_empty_batches_keep_stream_in_watermark(self, tmp_path):
+        # regression (r2 review): a pushed-down PK filter can make a stream's
+        # early batches empty; the stream must keep fencing the watermark or
+        # stale versions of its later keys leak through as duplicates
+        import pyarrow.compute as pc
+
+        pks = ["id"]
+        n = 10_000
+        old = pa.table(
+            {"id": np.arange(n, dtype=np.int64), "v": np.zeros(n)}
+        )
+        new = pa.table(
+            {
+                "id": np.arange(n - 10, n, dtype=np.int64),
+                "v": np.ones(10),
+            }
+        )
+        p0, p1 = str(tmp_path / "old_0000.parquet"), str(tmp_path / "new_0000.parquet")
+        _write_sorted_run(p0, old, pks)
+        _write_sorted_run(p1, new, pks)
+        flt = pc.field("id") >= n - 10
+        got = pa.concat_tables(
+            list(
+                iter_merged_windows(
+                    [p0, p1], pks, arrow_filter=flt, stream_batch_rows=64
+                )
+            )
+        ).sort_by("id")
+        assert got.column("id").to_pylist() == list(range(n - 10, n))
+        assert got.column("v").to_pylist() == [1.0] * 10  # new version won
+
+    def test_null_pk_values_sort_last(self, tmp_path):
+        pks = ["id"]
+        files, tables = [], []
+        for i in range(2):
+            t = pa.table(
+                {
+                    "id": pa.array([1, 2, None, 3, None], type=pa.int64()),
+                    "v": [float(i * 10 + j) for j in range(5)],
+                }
+            )
+            p = str(tmp_path / f"null_{i}_0000.parquet")
+            _write_sorted_run(p, t, pks)
+            files.append(p)
+            tables.append(pq.read_table(p))
+        expected = merge_sorted_tables(tables, pks)
+        got = pa.concat_tables(
+            list(iter_merged_windows(files, pks, stream_batch_rows=2))
+        )
+        _merged_equal(expected, got)
+
+    def test_schema_evolution_missing_column(self, tmp_path):
+        pks = ["id"]
+        schema = pa.schema(
+            [("id", pa.int64()), ("v", pa.float64()), ("extra", pa.string())]
+        )
+        t0 = pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0]})  # predates 'extra'
+        t1 = pa.table(
+            {"id": [2, 4], "v": [20.0, 40.0], "extra": ["x", "y"]},
+            schema=schema.remove(0).insert(0, schema.field(0)),
+        )
+        p0, p1 = str(tmp_path / "a_0000.parquet"), str(tmp_path / "b_0000.parquet")
+        _write_sorted_run(p0, t0, pks)
+        _write_sorted_run(p1, t1, pks)
+        expected = read_scan_unit([p0, p1], pks, schema=schema)
+        got = pa.Table.from_batches(
+            list(
+                iter_scan_unit_batches(
+                    [p0, p1], pks, schema=schema, batch_size=2,
+                )
+            )
+        )
+        _merged_equal(expected, got)
+
+
+class TestStreamedScanEquivalence:
+    """Whole-table equivalence through the public scan API."""
+
+    def _make_table(self, wh, rows=6000, buckets=2, cdc=False):
+        catalog = LakeSoulCatalog(str(wh))
+        schema = pa.schema(
+            [("id", pa.int64()), ("v", pa.float64()), ("s", pa.string())]
+        )
+        t = catalog.create_table(
+            "st", schema, primary_keys=["id"], hash_bucket_num=buckets, cdc=cdc
+        )
+        rng = np.random.default_rng(2)
+        for wave in range(3):
+            ids = rng.choice(rows, rows // 2, replace=False).astype(np.int64)
+            data = {
+                "id": ids,
+                "v": rng.normal(size=len(ids)),
+                "s": [f"w{wave}_{i % 17}" for i in range(len(ids))],
+            }
+            if cdc:
+                kinds = ["delete" if i % 11 == 0 else "insert" for i in range(len(ids))]
+                data[t.info.cdc_column] = kinds
+                t.upsert(pa.table(data, schema=t.schema))
+            else:
+                t.upsert(pa.table(data, schema=schema))
+        return t
+
+    def test_to_batches_matches_to_arrow(self, tmp_warehouse):
+        t = self._make_table(tmp_warehouse)
+        expected = t.to_arrow().sort_by("id")
+        got = pa.Table.from_batches(list(t.scan().batch_size(512).to_batches()))
+        _merged_equal(expected, got.sort_by("id"))
+
+    def test_cdc_deletes_dropped_in_stream(self, tmp_warehouse):
+        t = self._make_table(tmp_warehouse, cdc=True)
+        expected = t.to_arrow().sort_by("id")
+        got = pa.Table.from_batches(list(t.scan().to_batches())).sort_by("id")
+        _merged_equal(expected, got)
+
+    def test_filter_and_projection_in_stream(self, tmp_warehouse):
+        from lakesoul_tpu.io.filters import col
+
+        t = self._make_table(tmp_warehouse)
+        scan = t.scan().filter(col("v") > 0).select(["id", "s"])
+        expected = scan.to_arrow().sort_by("id")
+        got = pa.Table.from_batches(list(scan.to_batches())).sort_by("id")
+        _merged_equal(expected, got)
+
+
+class TestBoundedMemory:
+    """VERDICT r1 'done' criterion: reading a bucket whose size exceeds the
+    byte budget keeps RSS flat — peak allocation is O(files × stream window),
+    independent of bucket row count."""
+
+    def _build(self, catalog, name, n, waves=3):
+        schema = pa.schema(
+            [("id", pa.int64())] + [(f"f{i}", pa.float64()) for i in range(8)]
+        )
+        t = catalog.create_table(name, schema, primary_keys=["id"], hash_bucket_num=1)
+        rng = np.random.default_rng(3)
+        orig_io_config = t.io_config
+
+        def small_rg_config(**overrides):
+            cfg = orig_io_config(**overrides)
+            cfg.max_row_group_size = 8_192
+            return cfg
+
+        t.io_config = small_rg_config
+        for _ in range(waves):
+            ids = rng.permutation(n).astype(np.int64)
+            cols = {"id": ids}
+            for i in range(8):
+                cols[f"f{i}"] = rng.normal(size=n)
+            t.write_arrow(pa.table(cols, schema=schema))
+        return t
+
+    def _streamed_peak(self, t, budget) -> tuple[int, int]:
+        import gc
+
+        gc.collect()
+        base = pa.total_allocated_bytes()
+        peak = rows = 0
+        for unit in t.scan().scan_plan():
+            for b in iter_scan_unit_batches(
+                unit.data_files,
+                unit.primary_keys,
+                batch_size=4096,
+                memory_budget_bytes=budget,
+                schema=t.schema,
+                partition_values=unit.partition_values,
+            ):
+                rows += len(b)
+                peak = max(peak, pa.total_allocated_bytes() - base)
+        return peak, rows
+
+    def test_stream_peak_is_flat_in_bucket_size(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        budget = 2 << 20
+        small = self._build(catalog, "small", 30_000)
+        big = self._build(catalog, "big", 240_000)
+        total_input_bytes = 3 * 240_000 * 9 * 8  # 3 runs × 9 float64/int64 cols
+        peak_small, rows_small = self._streamed_peak(small, budget)
+        peak_big, rows_big = self._streamed_peak(big, budget)
+        assert rows_small == 30_000 and rows_big == 240_000
+        # 8x the data must NOT mean 8x the peak: the stream window, not the
+        # bucket, bounds memory (observed ~2.6x from pool/row-group noise;
+        # materializing would scale linearly)
+        assert peak_big < peak_small * 4, (peak_small, peak_big)
+        # and the peak stays far below even one decoded copy of the inputs
+        # (the materialized path holds all runs + merge copies ≈ 2x inputs)
+        assert peak_big < total_input_bytes // 2, (peak_big, total_input_bytes)
+
+
+class TestMixedFormats:
+    def test_parquet_and_arrow_ipc_in_one_partition(self, tmp_warehouse):
+        """Format registry (VERDICT r1 #4): a partition holding a parquet file
+        and an arrow-ipc file reads/merges transparently."""
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table("mix", schema, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0]}))
+
+        orig_io_config = t.io_config
+
+        def ipc_config(**overrides):
+            cfg = orig_io_config(**overrides)
+            cfg.file_format = "arrow"
+            return cfg
+
+        t.io_config = ipc_config
+        t.upsert(pa.table({"id": [2, 4], "v": [20.0, 40.0]}))
+        t.io_config = orig_io_config
+
+        files = [f for u in t.scan().scan_plan() for f in u.data_files]
+        exts = {f.rsplit(".", 1)[-1] for f in files}
+        assert exts == {"parquet", "arrow"}
+
+        got = t.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2, 3, 4]
+        assert got.column("v").to_pylist() == [1.0, 20.0, 3.0, 40.0]
+
+        streamed = pa.Table.from_batches(list(t.scan().to_batches())).sort_by("id")
+        _merged_equal(got, streamed)
+
+    def test_arrow_format_roundtrip_and_cdc(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table(
+            "ipc", schema, primary_keys=["id"], hash_bucket_num=2, cdc=True
+        )
+        orig_io_config = t.io_config
+
+        def ipc_config(**overrides):
+            cfg = orig_io_config(**overrides)
+            cfg.file_format = "arrow"
+            return cfg
+
+        t.io_config = ipc_config
+        from lakesoul_tpu.streaming import CdcIngestor
+
+        ing = CdcIngestor(t)
+        ing.apply_many(
+            [
+                ("insert", {"id": 1, "v": 1.0}),
+                ("insert", {"id": 2, "v": 2.0}),
+                ("update", {"id": 1, "v": 10.0}),
+            ]
+        )
+        ing.checkpoint(1)
+        ing.apply("delete", {"id": 2})
+        ing.checkpoint(2)
+        got = t.to_arrow()
+        assert got.column("id").to_pylist() == [1]
+        assert got.column("v").to_pylist() == [10.0]
