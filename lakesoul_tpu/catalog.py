@@ -813,6 +813,18 @@ class LakeSoulScan:
         unit_budget = max(8 << 20, cfg.memory_budget_bytes // window)
         _DONE = object()
 
+        def put(q: _queue.Queue, stop: threading.Event, item) -> bool:
+            # every put must honor stop: an abandoned generator would leave a
+            # producer blocked forever on a full queue (pool threads are
+            # non-daemon — the interpreter would hang at exit)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def stream(item, q: _queue.Queue, stop: threading.Event):
             unit, files, sizes = item
             try:
@@ -824,17 +836,11 @@ class LakeSoulScan:
                     file_sizes=sizes,
                     **self._unit_kwargs(unit),
                 ):
-                    while not stop.is_set():
-                        try:
-                            q.put(batch, timeout=0.1)
-                            break
-                        except _queue.Full:
-                            continue
-                    else:
+                    if not put(q, stop, batch):
                         return
-                q.put(_DONE)
+                put(q, stop, _DONE)
             except BaseException as e:  # surface errors to the consumer
-                q.put(e)
+                put(q, stop, e)
 
         stop = threading.Event()
         queues: list[_queue.Queue] = [_queue.Queue(maxsize=4) for _ in items]

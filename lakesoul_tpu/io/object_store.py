@@ -4,7 +4,11 @@ The reference registers per-table object stores (S3/HDFS/local) behind the
 ``object_store`` crate (rust/lakesoul-io/src/object_store.rs:185).  Here the
 same role is played by fsspec: local paths, ``gs://`` (gcsfs), ``s3://``,
 ``memory://`` — whatever fsspec resolves — handed directly to
-pyarrow.parquet, which understands fsspec filesystems natively.
+pyarrow, which understands fsspec filesystems natively.
+
+Remote READS go through the framework's own bounded disk page cache
+(io/page_cache.py, the role of rust/lakesoul-io/src/cache/disk_cache.rs)
+when ``lakesoul.cache_dir`` is set; writes always bypass it.
 """
 
 from __future__ import annotations
@@ -15,55 +19,69 @@ import fsspec
 
 # storage_options keys consumed by the framework itself (not passed to fsspec)
 OPTION_CACHE_DIR = "lakesoul.cache_dir"
-OPTION_CACHE_DISABLED_PROTOCOLS = ("file", "local", "memory")
+OPTION_CACHE_MAX_BYTES = "lakesoul.cache_max_bytes"
+OPTION_CACHE_PAGE_BYTES = "lakesoul.cache_page_bytes"
+OPTION_CACHE_DISABLED_PROTOCOLS = ("file", "local")
+
+_OWN_OPTIONS = (OPTION_CACHE_DIR, OPTION_CACHE_MAX_BYTES, OPTION_CACHE_PAGE_BYTES)
+
+
+def _split_options(storage_options: dict | None) -> tuple[dict, dict]:
+    opts = dict(storage_options or {})
+    own = {k: opts.pop(k) for k in _OWN_OPTIONS if k in opts}
+    return own, opts
 
 
 def filesystem_for(path: str, storage_options: dict | None = None, *, write: bool = False):
     """Resolve (fs, normalized_path) for a file or directory path.
 
     When ``storage_options['lakesoul.cache_dir']`` is set and the path is
-    remote, READS go through fsspec's *blockcache* — block-ranged read-through
-    caching, the role of the reference's 16 KiB-page disk cache
-    (rust/lakesoul-io/src/cache/disk_cache.rs): remote ranged GETs land on
-    local disk once and later scans hit the cached blocks without pulling
-    whole objects.  Writes (``write=True``) always bypass the cache."""
-    opts = dict(storage_options or {})
-    cache_dir = opts.pop(OPTION_CACHE_DIR, None)
+    remote, reads are served through the bounded read-through page cache
+    (hit/miss/eviction counters via :func:`cache_stats`).  Optional knobs:
+    ``lakesoul.cache_max_bytes`` (default 10 GiB) and
+    ``lakesoul.cache_page_bytes`` (default 4 MiB)."""
+    own, opts = _split_options(storage_options)
+    cache_dir = own.get(OPTION_CACHE_DIR)
     protocol = fsspec.core.split_protocol(path)[0] or "file"
-    if (
-        cache_dir
-        and not write
-        and protocol not in OPTION_CACHE_DISABLED_PROTOCOLS
-    ):
-        fs = fsspec.filesystem(
-            "blockcache",
-            target_protocol=protocol,
-            target_options=opts,
-            cache_storage=str(cache_dir),
-            check_files=False,
-        )
-        _, p = fsspec.core.url_to_fs(path, **opts)
-        return fs, p
     fs, p = fsspec.core.url_to_fs(path, **opts)
+    if cache_dir and not write and protocol not in OPTION_CACHE_DISABLED_PROTOCOLS:
+        from lakesoul_tpu.io.page_cache import CachedReadFileSystem, get_cache
+
+        cache = get_cache(
+            cache_dir,
+            own.get(OPTION_CACHE_MAX_BYTES),
+            own.get(OPTION_CACHE_PAGE_BYTES),
+        )
+        return CachedReadFileSystem(fs, cache), p
     return fs, p
 
 
 def cache_stats(storage_options: dict | None = None) -> dict:
-    """Best-effort page-cache statistics (reference: cache/stats.rs)."""
-    opts = dict(storage_options or {})
-    cache_dir = opts.get(OPTION_CACHE_DIR)
-    if not cache_dir or not os.path.isdir(cache_dir):
-        return {"files": 0, "bytes": 0}
-    files = 0
-    total = 0
-    for root, _dirs, names in os.walk(cache_dir):
-        for n in names:
-            files += 1
-            try:
-                total += os.path.getsize(os.path.join(root, n))
-            except OSError:
-                pass
-    return {"files": files, "bytes": total}
+    """Page-cache statistics: hits/misses/bytes/evictions/hit_rate plus the
+    current footprint (reference: cache/stats.rs)."""
+    own, _ = _split_options(storage_options)
+    cache_dir = own.get(OPTION_CACHE_DIR)
+    if not cache_dir:
+        # same shape as an enabled cache so monitoring code never branches
+        return {
+            "hits": 0,
+            "misses": 0,
+            "hit_bytes": 0,
+            "miss_bytes": 0,
+            "evictions": 0,
+            "hit_rate": 0.0,
+            "pages": 0,
+            "bytes": 0,
+            "max_bytes": 0,
+        }
+    from lakesoul_tpu.io.page_cache import get_cache
+
+    cache = get_cache(
+        cache_dir,
+        own.get(OPTION_CACHE_MAX_BYTES),
+        own.get(OPTION_CACHE_PAGE_BYTES),
+    )
+    return cache.snapshot()
 
 
 def ensure_dir(path: str, storage_options: dict | None = None) -> None:

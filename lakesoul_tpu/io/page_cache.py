@@ -1,0 +1,298 @@
+"""Owned disk page cache: bounded, read-through, instrumented.
+
+The reference caches remote objects on local disk in 16 KiB pages behind a
+moka-managed weight/eviction policy with hit/miss statistics
+(rust/lakesoul-io/src/cache/disk_cache.rs:92, cache/read_through.rs:23,
+cache/stats.rs).  This is the same design owned end-to-end in the framework
+(replacing round 1's fsspec blockcache pass-through): ranged reads are served
+page-by-page from a local directory, misses fetch coalesced page runs from
+the backing store with ONE ranged GET, and an LRU index bounded by
+``max_bytes`` evicts page files.  Lakehouse data files are immutable (every
+commit writes new names), so pages never need invalidation.
+
+Pages default to 4 MiB — object-store GET latency dominates at 16 KiB; the
+reference's page size tunes for local SSD pread, ours for GCS/S3 range
+requests feeding parquet column chunks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from fsspec.spec import AbstractBufferedFile, AbstractFileSystem
+
+DEFAULT_PAGE_BYTES = 4 << 20
+DEFAULT_MAX_BYTES = 10 << 30
+
+
+@dataclass
+class CacheStats:
+    """Counters surfaced via cache_stats() (reference: cache/stats.rs)."""
+
+    hits: int = 0
+    misses: int = 0
+    hit_bytes: int = 0
+    miss_bytes: int = 0
+    evictions: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_hit(self, nbytes: int) -> None:
+        with self._lock:
+            self.hits += 1
+            self.hit_bytes += nbytes
+
+    def record_miss(self, nbytes: int) -> None:
+        with self._lock:
+            self.misses += 1
+            self.miss_bytes += nbytes
+
+    def record_eviction(self, n: int = 1) -> None:
+        with self._lock:
+            self.evictions += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_bytes": self.hit_bytes,
+                "miss_bytes": self.miss_bytes,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }
+
+
+class DiskPageCache:
+    """Page-granular LRU cache of remote object ranges on local disk.
+
+    One file per page under ``cache_dir/<sha1(path)>/<page_index>``; an
+    in-memory LRU index enforces ``max_bytes`` (rebuilt from disk mtimes on
+    restart, so a long-lived cache survives process churn)."""
+
+    def __init__(
+        self,
+        cache_dir: str,
+        *,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        page_bytes: int = DEFAULT_PAGE_BYTES,
+    ):
+        self.cache_dir = str(cache_dir)
+        self.max_bytes = int(max_bytes)
+        self.page_bytes = int(page_bytes)
+        self.stats = CacheStats()
+        self._lock = threading.Lock()
+        self._index: OrderedDict[tuple[str, int], int] = OrderedDict()
+        self._bytes = 0
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self._rebuild_index()
+
+    # ------------------------------------------------------------------ index
+    def _rebuild_index(self) -> None:
+        entries = []
+        for key_dir in os.listdir(self.cache_dir):
+            d = os.path.join(self.cache_dir, key_dir)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                try:
+                    idx = int(name)
+                except ValueError:
+                    continue
+                p = os.path.join(d, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, key_dir, idx, st.st_size))
+        entries.sort()  # oldest first → least recently used at the front
+        for _, key, idx, size in entries:
+            self._index[(key, idx)] = size
+            self._bytes += size
+
+    @staticmethod
+    def _key(path: str) -> str:
+        return hashlib.sha1(path.encode()).hexdigest()
+
+    def _page_path(self, key: str, idx: int) -> str:
+        return os.path.join(self.cache_dir, key, str(idx))
+
+    # ------------------------------------------------------------------- read
+    def read_range(self, target_fs, path: str, start: int, end: int) -> bytes:
+        """Bytes [start, end) of ``path``, read through the cache.  Misses on
+        consecutive pages coalesce into one ranged GET against the target."""
+        if end <= start:
+            return b""
+        pb = self.page_bytes
+        key = self._key(path)
+        first, last = start // pb, (end - 1) // pb
+        pages: dict[int, bytes] = {}
+        missing: list[int] = []
+        for idx in range(first, last + 1):
+            data = self._load_page(key, idx)
+            if data is None:
+                missing.append(idx)
+            else:
+                pages[idx] = data
+                self.stats.record_hit(len(data))
+        # coalesce runs of consecutive missing pages → one GET each
+        run: list[int] = []
+        for idx in missing + [None]:  # type: ignore[list-item]
+            if run and (idx is None or idx != run[-1] + 1):
+                blob = target_fs.cat_file(path, start=run[0] * pb, end=(run[-1] + 1) * pb)
+                self.stats.record_miss(len(blob))
+                for j, pidx in enumerate(run):
+                    page = blob[j * pb : (j + 1) * pb]
+                    pages[pidx] = page
+                    self._store_page(key, pidx, page)
+                run = []
+            if idx is not None:
+                run.append(idx)
+        blob = b"".join(pages[i] for i in range(first, last + 1))
+        lo = start - first * pb
+        return blob[lo : lo + (end - start)]
+
+    def _load_page(self, key: str, idx: int) -> bytes | None:
+        with self._lock:
+            known = (key, idx) in self._index
+            if known:
+                self._index.move_to_end((key, idx))
+        if not known:
+            return None
+        try:
+            with open(self._page_path(key, idx), "rb") as f:
+                return f.read()
+        except OSError:
+            with self._lock:
+                size = self._index.pop((key, idx), 0)
+                self._bytes -= size
+            return None
+
+    def _store_page(self, key: str, idx: int, data: bytes) -> None:
+        d = os.path.join(self.cache_dir, key)
+        os.makedirs(d, exist_ok=True)
+        tmp = self._page_path(key, idx) + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self._page_path(key, idx))
+        except OSError:
+            return  # cache write failure must never fail the read
+        with self._lock:
+            prev = self._index.pop((key, idx), 0)
+            self._bytes -= prev
+            self._index[(key, idx)] = len(data)
+            self._bytes += len(data)
+            evict = []
+            while self._bytes > self.max_bytes and self._index:
+                k, size = self._index.popitem(last=False)
+                self._bytes -= size
+                evict.append(k)
+        for k in evict:
+            try:
+                os.remove(self._page_path(*k))
+            except OSError:
+                pass
+        if evict:
+            self.stats.record_eviction(len(evict))
+
+    # ------------------------------------------------------------------ admin
+    def current_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def snapshot(self) -> dict:
+        out = self.stats.snapshot()
+        with self._lock:
+            out["pages"] = len(self._index)
+            out["bytes"] = self._bytes
+            out["max_bytes"] = self.max_bytes
+        return out
+
+
+# ONE cache instance per directory: two instances over the same pages would
+# run independent LRU accounting (evicting files the other still counts) and
+# split the stats.  First caller's knobs win; later different knobs only
+# retune max_bytes (page size must match the files already on disk).
+_CACHES: dict[str, DiskPageCache] = {}
+_CACHES_LOCK = threading.Lock()
+
+
+def get_cache(
+    cache_dir: str, max_bytes: int | None = None, page_bytes: int | None = None
+) -> DiskPageCache:
+    """max_bytes/page_bytes apply on first construction; an explicit
+    max_bytes on a later call retunes the bound (None leaves it alone)."""
+    key = str(cache_dir)
+    with _CACHES_LOCK:
+        cache = _CACHES.get(key)
+        if cache is None:
+            cache = DiskPageCache(
+                key,
+                max_bytes=int(max_bytes) if max_bytes is not None else DEFAULT_MAX_BYTES,
+                page_bytes=int(page_bytes) if page_bytes is not None else DEFAULT_PAGE_BYTES,
+            )
+            _CACHES[key] = cache
+        elif max_bytes is not None:
+            cache.max_bytes = int(max_bytes)
+        return cache
+
+
+class _CachedFile(AbstractBufferedFile):
+    def _fetch_range(self, start: int, end: int) -> bytes:
+        fs: CachedReadFileSystem = self.fs
+        return fs.cache.read_range(fs.target, self.path, start, min(end, self.size))
+
+
+class CachedReadFileSystem(AbstractFileSystem):
+    """Read-only fsspec filesystem routing ranged reads of an inner
+    filesystem through a DiskPageCache (reference: ReadThroughCache,
+    cache/read_through.rs:23).  Metadata ops delegate to the target."""
+
+    protocol = "lscache"
+
+    def __init__(self, target_fs, cache: DiskPageCache, **kwargs):
+        super().__init__(**kwargs)
+        self.target = target_fs
+        self.cache = cache
+
+    # ---------------------------------------------------------- delegation
+    def info(self, path, **kwargs):
+        return self.target.info(path, **kwargs)
+
+    def ls(self, path, detail=True, **kwargs):
+        return self.target.ls(path, detail=detail, **kwargs)
+
+    def exists(self, path, **kwargs):
+        return self.target.exists(path, **kwargs)
+
+    def size(self, path):
+        return self.target.size(path)
+
+    def isfile(self, path):
+        return self.target.isfile(path)
+
+    def isdir(self, path):
+        return self.target.isdir(path)
+
+    def glob(self, path, **kwargs):
+        return self.target.glob(path, **kwargs)
+
+    def _open(self, path, mode="rb", block_size=None, **kwargs):
+        if mode != "rb":
+            raise NotImplementedError("CachedReadFileSystem is read-only")
+        # cache_type="none": AbstractBufferedFile's own readahead cache would
+        # double-buffer what the page cache already holds
+        return _CachedFile(
+            self,
+            path,
+            mode=mode,
+            block_size=self.cache.page_bytes,
+            cache_type="none",
+            size=self.target.size(path),
+            **kwargs,
+        )

@@ -54,8 +54,7 @@ def _prefix_below(table: pa.Table, primary_keys: list[str], watermark: tuple) ->
     n = len(table)
     if n == 0:
         return 0
-    lt = pa.array([False] * n)
-    eq = pa.array([True] * n)
+    lt = eq = None
     for k, (w_null, w_val) in zip(primary_keys, watermark):
         col = table.column(k)
         if w_null:
@@ -65,8 +64,11 @@ def _prefix_below(table: pa.Table, primary_keys: list[str], watermark: tuple) ->
         else:
             c_lt = pc.fill_null(pc.less(col, pa.scalar(w_val, type=col.type)), False)
             c_eq = pc.fill_null(pc.equal(col, pa.scalar(w_val, type=col.type)), False)
-        lt = pc.or_(lt, pc.and_(eq, c_lt))
-        eq = pc.and_(eq, c_eq)
+        if lt is None:
+            lt, eq = c_lt, c_eq  # first key seeds the lexicographic fold
+        else:
+            lt = pc.or_(lt, pc.and_(eq, c_lt))
+            eq = pc.and_(eq, c_eq)
     count = pc.sum(lt).as_py() or 0
     return int(count)
 

@@ -317,15 +317,15 @@ class SqlSession:
                         raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
                 else:
                     raise SqlError("non-aggregate expressions in GROUP BY selects not supported")
-            # dedup count_all: several COUNT(*) items share one aggregate
-            # column (duplicate specs would collide in the grouped schema)
-            call_specs, seen_count_all = [], False
-            for spec in specs:
-                if spec[1] == "count_all":
-                    if seen_count_all:
-                        continue
-                    seen_count_all = True
-                call_specs.append(spec)
+            # dedup identical aggregates: repeating e.g. COUNT(*) or sum(v)
+            # in one select must not produce colliding grouped-schema columns
+            call_specs, seen = [], set()
+            for target, pa_fn in specs:
+                k = (tuple(target) if isinstance(target, list) else target, pa_fn)
+                if k in seen:
+                    continue
+                seen.add(k)
+                call_specs.append((target, pa_fn))
             grouped = work.group_by(stmt.group_by).aggregate(call_specs)
             cols, labels = [], []
             for it in stmt.items:

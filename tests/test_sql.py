@@ -102,6 +102,12 @@ class TestExecute:
         )
         assert out.column("a").to_pylist() == out.column("b").to_pylist() == [2, 2]
 
+    def test_duplicate_aggregates_in_one_group_by(self, session):
+        out = session.execute(
+            "SELECT city, sum(age) AS a, sum(age) AS b FROM users GROUP BY city ORDER BY city"
+        )
+        assert out.column("a").to_pylist() == out.column("b").to_pylist() == [53, 65]
+
     def test_multi_key_order_by(self, session):
         session.execute("INSERT INTO users VALUES (8, 'hank', 30, 'nyc')")
         out = session.execute("SELECT age, id FROM users ORDER BY age DESC, id DESC")

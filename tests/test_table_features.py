@@ -96,26 +96,18 @@ class TestWriterSpill:
         assert t.to_arrow().num_rows == 300
 
 
-class TestPageCache:
-    def test_filecache_wraps_remote_fs(self, tmp_path):
-        import fsspec
+class TestPageCacheWiring:
+    def test_local_paths_bypass_cache(self, tmp_path):
+        from lakesoul_tpu.io.object_store import filesystem_for
 
-        from lakesoul_tpu.io.object_store import cache_stats, filesystem_for
-
-        # memory:// stands in for a remote store but is on the disabled list;
-        # use a custom check on a local file through the 'filecache' chain by
-        # testing the wiring logic with an artificial remote protocol
         opts = {"lakesoul.cache_dir": str(tmp_path / "cache")}
         fs, p = filesystem_for(str(tmp_path / "x.bin"), opts)
         # local paths bypass the cache (no double-copy of local reads)
-        assert "Cach" not in type(fs).__name__
-        assert cache_stats(opts) == {"files": 0, "bytes": 0}
+        assert "Cached" not in type(fs).__name__
 
-    def test_cache_stats_counts(self, tmp_path):
-        from lakesoul_tpu.io.object_store import cache_stats
+    def test_remote_paths_get_cached_fs(self, tmp_path):
+        from lakesoul_tpu.io.object_store import filesystem_for
 
-        cache = tmp_path / "cache"
-        cache.mkdir()
-        (cache / "blob").write_bytes(b"x" * 1000)
-        stats = cache_stats({"lakesoul.cache_dir": str(cache)})
-        assert stats["files"] == 1 and stats["bytes"] == 1000
+        opts = {"lakesoul.cache_dir": str(tmp_path / "cache")}
+        fs, p = filesystem_for("memory://bucket/x.bin", opts)
+        assert type(fs).__name__ == "CachedReadFileSystem"
