@@ -677,12 +677,7 @@ class LakeSoulScan:
                 info.table_name, self._incremental[0], self._incremental[1],
                 namespace=info.table_namespace,
             )
-            if self._partitions:
-                units = [
-                    u
-                    for u in units
-                    if all(u.partition_values.get(k) == v for k, v in self._partitions.items())
-                ]
+            units = self._filter_partitions(units)
         elif self._snapshot_ts is not None:
             snapshot = client.get_snapshot_at_timestamp(
                 info.table_name, self._snapshot_ts, namespace=info.table_namespace
@@ -695,10 +690,42 @@ class LakeSoulScan:
             units = client.get_scan_plan_partitions(
                 info.table_name, self._partitions, namespace=info.table_namespace
             )
+        return self._restrict_units(units)
+
+    def _filter_partitions(self, units: list[ScanPlanPartition]) -> list[ScanPlanPartition]:
+        if not self._partitions:
+            return units
+        return [
+            u
+            for u in units
+            if all(u.partition_values.get(k) == v for k, v in self._partitions.items())
+        ]
+
+    def _restrict_units(
+        self, units: list[ScanPlanPartition], *, stable_shard: bool = False
+    ) -> list[ScanPlanPartition]:
+        """Shared unit restriction: bucket pruning + DP rank sharding.
+
+        Batch scans shard round-robin by plan index (every rank computes the
+        same full plan, so indices agree).  Streaming follow() must use
+        ``stable_shard``: each rank polls with independent cursors and
+        timing, so assignment has to key on stable unit identity, not
+        enumeration order — otherwise a commit can be skipped by every rank
+        or delivered twice."""
         units = self._prune_buckets(units)
-        if self._rank is not None:
-            units = [u for i, u in enumerate(units) if i % self._world == self._rank]
-        return units
+        if self._rank is None:
+            return units
+        if not stable_shard:
+            return [u for i, u in enumerate(units) if i % self._world == self._rank]
+        import zlib
+
+        def owner(u: ScanPlanPartition) -> int:
+            ident = f"{u.partition_desc}/{u.bucket_id}"
+            if u.bucket_id < 0 and u.data_files:
+                ident += "/" + u.data_files[0].rsplit("/", 1)[-1]
+            return zlib.crc32(ident.encode()) % self._world
+
+        return [u for u in units if owner(u) == self._rank]
 
     def _prune_buckets(self, units: list[ScanPlanPartition]) -> list[ScanPlanPartition]:
         """Hash-bucket pruning: a PK-equality filter can only match rows in
@@ -876,7 +903,7 @@ class LakeSoulScan:
         *,
         poll_interval: float = 1.0,
         stop_event=None,
-        settle_ms: int = 250,
+        settle_ms: int = 250,  # retained for API compat; unused (see below)
     ) -> Iterator[pa.RecordBatch]:
         """Unbounded incremental source: yield batches for every commit after
         ``start_timestamp_ms`` (default: now), then keep polling for new
@@ -884,29 +911,41 @@ class LakeSoulScan:
         (LakeSoulSource + dynamic split enumerator).  Stops when
         ``stop_event`` (threading.Event) is set.
 
-        Scaling note: each poll diffs the partition version history from the
-        store; on very long version chains prefer periodic compaction (which
-        also truncates history via the cleaner) to keep polls cheap."""
+        Planning is driven by per-partition VERSION cursors
+        (MetaDataClient.poll_scan_plan): each poll costs one head query plus
+        O(new commits) — unchanged partitions are skipped without touching
+        version history.  Version cursors are exact, so the old timestamp
+        settle window (``settle_ms``) is no longer needed: a commit is either
+        visible with a new version number or it is not."""
         from lakesoul_tpu.meta.entity import now_millis
 
         import time as _time
 
-        cursor = start_timestamp_ms if start_timestamp_ms is not None else now_millis()
+        info = self._table.info
+        client = self._table.catalog.client
+        budget = self._table.io_config().memory_budget_bytes
+        start = start_timestamp_ms if start_timestamp_ms is not None else now_millis()
+        cursors = client.init_follow_cursors(
+            info.table_name, start, info.table_namespace
+        )
         while stop_event is None or not stop_event.is_set():
-            # only scan settled time: commits are timestamped BEFORE their
-            # partition-version insert becomes visible, so a window edge too
-            # close to "now" could skip a commit that is stamped but not yet
-            # inserted.  settle_ms bounds that stamp→visible gap (commits
-            # slower than this, e.g. mid-retry, should be rare; raise it for
-            # heavily contended stores).  The cursor never moves backwards.
-            upper = now_millis() - settle_ms
+            units = client.poll_scan_plan(
+                info.table_name, cursors, info.table_namespace
+            )
+            units = self._filter_partitions(units)
+            units = self._restrict_units(units, stable_shard=True)
             emitted = False
-            if upper > cursor:
-                inc = self._replace(_incremental=(cursor, upper), _snapshot_ts=None)
-                for batch in inc.to_batches():
+            for unit in units:
+                for batch in iter_scan_unit_batches(
+                    unit.data_files,
+                    unit.primary_keys,
+                    batch_size=self._batch_size,
+                    memory_budget_bytes=budget,
+                    file_sizes=unit.file_sizes,
+                    **self._unit_kwargs(unit),
+                ):
                     emitted = True
                     yield batch
-                cursor = upper
             if stop_event is not None and stop_event.is_set():
                 return
             if not emitted:

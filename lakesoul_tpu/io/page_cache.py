@@ -18,12 +18,15 @@ requests feeding parquet column chunks.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from fsspec.spec import AbstractBufferedFile, AbstractFileSystem
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_PAGE_BYTES = 4 << 20
 DEFAULT_MAX_BYTES = 10 << 30
@@ -72,7 +75,15 @@ class DiskPageCache:
 
     One file per page under ``cache_dir/<sha1(path)>/<page_index>``; an
     in-memory LRU index enforces ``max_bytes`` (rebuilt from disk mtimes on
-    restart, so a long-lived cache survives process churn)."""
+    restart, so a long-lived cache survives process churn).  The directory
+    records its page size in a ``.page_bytes`` marker: reopening with a
+    different configured page size adopts the on-disk value — page indices
+    are only meaningful at the size the pages were written with.
+
+    Sharing one directory across processes is safe for correctness (pages
+    are immutable, written atomically, and a file deleted under us is a
+    clean miss) but the byte bound is accounted per process — prefer a
+    per-process cache_dir when several loaders run on one host."""
 
     def __init__(
         self,
@@ -83,13 +94,34 @@ class DiskPageCache:
     ):
         self.cache_dir = str(cache_dir)
         self.max_bytes = int(max_bytes)
-        self.page_bytes = int(page_bytes)
         self.stats = CacheStats()
         self._lock = threading.Lock()
         self._index: OrderedDict[tuple[str, int], int] = OrderedDict()
         self._bytes = 0
         os.makedirs(self.cache_dir, exist_ok=True)
+        self.page_bytes = self._pin_page_bytes(int(page_bytes))
         self._rebuild_index()
+
+    def _pin_page_bytes(self, requested: int) -> int:
+        """First opener writes the marker; later openers must use the on-disk
+        page size or indices would map to wrong byte ranges (silent
+        corruption)."""
+        marker = os.path.join(self.cache_dir, ".page_bytes")
+        try:
+            with open(marker, "x") as f:
+                f.write(str(requested))
+            return requested
+        except FileExistsError:
+            with open(marker) as f:
+                on_disk = int(f.read().strip() or requested)
+            if on_disk != requested:
+                logger.warning(
+                    "cache dir %s holds %d-byte pages; ignoring requested page size %d",
+                    self.cache_dir,
+                    on_disk,
+                    requested,
+                )
+            return on_disk
 
     # ------------------------------------------------------------------ index
     def _rebuild_index(self) -> None:
@@ -152,6 +184,15 @@ class DiskPageCache:
                 run = []
             if idx is not None:
                 run.append(idx)
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug(
+                "page cache read %s [%d,%d): %d hit / %d miss pages",
+                path,
+                start,
+                end,
+                (last - first + 1) - len(missing),
+                len(missing),
+            )
         blob = b"".join(pages[i] for i in range(first, last + 1))
         lo = start - first * pb
         return blob[lo : lo + (end - start)]
@@ -199,6 +240,9 @@ class DiskPageCache:
                 pass
         if evict:
             self.stats.record_eviction(len(evict))
+            logger.debug(
+                "page cache evicted %d pages (bound %d bytes)", len(evict), self.max_bytes
+            )
 
     # ------------------------------------------------------------------ admin
     def current_bytes(self) -> int:

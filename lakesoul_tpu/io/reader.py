@@ -18,11 +18,15 @@ Two execution modes share one plan:
 
 from __future__ import annotations
 
+import logging
+import time
 from dataclasses import dataclass
 from typing import Iterator
 
 import pyarrow as pa
 import pyarrow.dataset as pads
+
+logger = logging.getLogger(__name__)
 
 from lakesoul_tpu.io.config import DEFAULT_MEMORY_BUDGET
 from lakesoul_tpu.io.filters import Filter
@@ -172,6 +176,7 @@ def read_scan_unit(
     ``partition_values`` fills the directory-encoded columns back in
     (reference: stream/default_column.rs)."""
     partition_values = partition_values or {}
+    started = time.perf_counter()
     plan = _plan_unit(
         primary_keys,
         schema=schema,
@@ -204,7 +209,7 @@ def read_scan_unit(
     else:
         merged = pa.concat_tables(tables) if tables else pa.table({})
 
-    return _postprocess(
+    out = _postprocess(
         merged,
         schema=schema,
         partition_values=partition_values,
@@ -213,6 +218,15 @@ def read_scan_unit(
         post_filter=plan.post_filter,
         columns=columns,
     )
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug(
+            "scan unit materialized: files=%d rows=%d merge=%s in %.1fms",
+            len(files),
+            len(out),
+            bool(primary_keys),
+            (time.perf_counter() - started) * 1e3,
+        )
+    return out
 
 
 def _stream_batch_rows(
@@ -336,6 +350,8 @@ def iter_scan_unit_batches(
     from lakesoul_tpu.io.streaming_merge import iter_merged_windows
 
     rows = _stream_batch_rows(plan.file_schema, len(files), memory_budget_bytes)
+    started = time.perf_counter()
+    out_rows = windows = 0
     for window in iter_merged_windows(
         files,
         primary_keys,
@@ -348,8 +364,19 @@ def iter_scan_unit_batches(
         stream_batch_rows=rows,
     ):
         t = post(window)
+        windows += 1
         if len(t):
+            out_rows += len(t)
             yield from t.to_batches(max_chunksize=batch_size)
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug(
+            "scan unit streamed: files=%d windows=%d rows=%d window_rows=%d in %.1fms",
+            len(files),
+            windows,
+            out_rows,
+            rows,
+            (time.perf_counter() - started) * 1e3,
+        )
 
 
 def _filter_column_names(flt: Filter) -> set[str]:

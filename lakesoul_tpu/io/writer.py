@@ -17,8 +17,12 @@ without changing the algorithm.
 
 from __future__ import annotations
 
+import logging
 import secrets
+import time
 from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
 
 import numpy as np
 import pyarrow as pa
@@ -145,6 +149,7 @@ class TableWriter:
         flush stages a new set of files)."""
         outputs: list[FlushOutput] = []
         cfg = self.config
+        started = time.perf_counter()
         for (desc, bucket), pieces in sorted(self._cells.items()):
             cell = pa.concat_tables(pieces).combine_chunks()
             if cfg.primary_keys:
@@ -175,6 +180,14 @@ class TableWriter:
         self._cells.clear()
         self._buffered_rows = 0
         self._buffered_bytes = 0
+        if outputs and logger.isEnabledFor(logging.DEBUG):
+            logger.debug(
+                "flush staged %d files rows=%d bytes=%d in %.1fms",
+                len(outputs),
+                sum(o.row_count for o in outputs),
+                sum(o.size for o in outputs),
+                (time.perf_counter() - started) * 1e3,
+            )
         return outputs
 
     def _target_path(self, desc: str, bucket: int, fmt) -> str:
@@ -207,6 +220,8 @@ class TableWriter:
         """Discard buffers and delete every staged file not yet taken for
         commit."""
         self._cells.clear()
+        if self._staged:
+            logger.info("abort: deleting %d staged files", len(self._staged))
         for out in self._staged:
             delete_file(out.path, self.config.object_store_options, missing_ok=True)
         self._staged.clear()

@@ -69,6 +69,19 @@ class TestDiskPageCache:
         assert cache.current_bytes() <= 32 << 10
         assert cache.snapshot()["evictions"] > 0
 
+    def test_page_size_pinned_by_marker(self, tmp_path, mem_fs):
+        # reopening a cache dir with a different page size must adopt the
+        # on-disk size — indices computed at another size would map to wrong
+        # byte ranges (silent corruption)
+        data = bytes(range(256)) * 64  # 16 KiB
+        mem_fs.pipe_file("/pc/marker", data)
+        d = str(tmp_path / "c")
+        c1 = DiskPageCache(d, page_bytes=4 << 10)
+        c1.read_range(mem_fs, "/pc/marker", 0, len(data))
+        c2 = DiskPageCache(d, page_bytes=1 << 10)  # conflicting knob
+        assert c2.page_bytes == 4 << 10
+        assert c2.read_range(mem_fs, "/pc/marker", 3000, 9000) == data[3000:9000]
+
     def test_index_survives_restart(self, tmp_path, mem_fs):
         data = b"q" * (32 << 10)
         mem_fs.pipe_file("/pc/persist", data)
