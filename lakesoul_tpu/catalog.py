@@ -933,7 +933,31 @@ class LakeSoulScan:
                 info.table_name, cursors, info.table_namespace
             )
             units = self._filter_partitions(units)
-            units = self._restrict_units(units, stable_shard=True)
+            # non-PK units must shard per FILE: each rank's polls batch
+            # commits differently, so a multi-file unit's identity (first
+            # file) is timing-dependent — per-file units are not
+            exploded: list[ScanPlanPartition] = []
+            for u in units:
+                if u.primary_keys:
+                    exploded.append(u)
+                    continue
+                sizes = (
+                    u.file_sizes
+                    if len(u.file_sizes) == len(u.data_files)
+                    else [0] * len(u.data_files)
+                )
+                for f, sz in zip(u.data_files, sizes):
+                    exploded.append(
+                        ScanPlanPartition(
+                            data_files=[f],
+                            primary_keys=[],
+                            bucket_id=u.bucket_id,
+                            partition_desc=u.partition_desc,
+                            partition_values=u.partition_values,
+                            file_sizes=[sz],
+                        )
+                    )
+            units = self._restrict_units(exploded, stable_shard=True)
             emitted = False
             for unit in units:
                 for batch in iter_scan_unit_batches(

@@ -35,38 +35,185 @@ _TYPE_MAP = {
 }
 
 
-def _rename_filter_cols(flt: Filter, mapping: dict[str, str]) -> Filter:
-    """Rewrite column references (joins drop the right-side key column — the
-    surviving left key carries the same values)."""
-    col = mapping.get(flt.col, flt.col) if flt.col else flt.col
-    return Filter(
-        op=flt.op,
-        col=col,
-        value=flt.value,
-        args=tuple(_rename_filter_cols(a, mapping) for a in flt.args),
-    )
-
-
 def _expr_columns(expr) -> set[str]:
+    """Columns a value expression references (does NOT descend into
+    subqueries — those resolve against their own tables)."""
     if isinstance(expr, ast.Column):
         return {expr.name}
     if isinstance(expr, ast.Arith):
         return _expr_columns(expr.left) | _expr_columns(expr.right)
+    if isinstance(expr, ast.Agg):
+        return _expr_columns(expr.arg) if expr.arg is not None else set()
+    if isinstance(expr, ast.Case):
+        cols = set()
+        for cond, value in expr.whens:
+            cols |= _node_columns(cond) | _expr_columns(value)
+        if expr.default is not None:
+            cols |= _expr_columns(expr.default)
+        return cols
+    if isinstance(expr, ast.Func):
+        cols = set()
+        for a in expr.args:
+            if a is not None:
+                cols |= _expr_columns(a)
+        return cols
     return set()
 
 
-def _eval_expr(expr, table: pa.Table):
-    """Evaluate a value expression against a table → Arrow array/scalar."""
-    if isinstance(expr, ast.Column):
-        return table.column(expr.name)
-    if isinstance(expr, ast.Literal):
-        return pa.scalar(expr.value)
+def _node_columns(node) -> set[str]:
+    """Columns a boolean tree references on the CURRENT table."""
+    if isinstance(node, ast.Compare):
+        if node.simple:
+            return {node.col}
+        return _expr_columns(node.left) | _expr_columns(node.right)
+    if isinstance(node, (ast.InList, ast.IsNull, ast.Like, ast.Between)):
+        return {node.col}
+    if isinstance(node, ast.InSubquery):
+        return {node.col}
+    if isinstance(node, ast.Exists):
+        return set()
+    if isinstance(node, ast.BoolOp):
+        cols = set()
+        for a in node.args:
+            cols |= _node_columns(a)
+        return cols
+    if isinstance(node, ast.NotOp):
+        return _node_columns(node.arg)
+    return set()
+
+
+def _contains_agg(expr) -> bool:
+    return any(True for _ in _walk_aggs(expr))
+
+
+def _walk_aggs(expr):
+    if isinstance(expr, ast.Agg):
+        yield expr
+        return
     if isinstance(expr, ast.Arith):
-        left = _eval_expr(expr.left, table)
-        right = _eval_expr(expr.right, table)
-        fn = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide}[expr.op]
-        return fn(left, right)
-    raise SqlError(f"unsupported expression {expr!r}")
+        yield from _walk_aggs(expr.left)
+        yield from _walk_aggs(expr.right)
+    elif isinstance(expr, ast.Case):
+        for cond, value in expr.whens:
+            yield from _walk_aggs(value)
+        if expr.default is not None:
+            yield from _walk_aggs(expr.default)
+    elif isinstance(expr, ast.Func):
+        for a in expr.args:
+            if a is not None:
+                yield from _walk_aggs(a)
+
+
+def _bool_exprs(node):
+    """Value expressions embedded in a boolean tree (for agg collection)."""
+    if isinstance(node, ast.Compare) and not node.simple:
+        yield node.left
+        yield node.right
+    elif isinstance(node, ast.BoolOp):
+        for a in node.args:
+            yield from _bool_exprs(a)
+    elif isinstance(node, ast.NotOp):
+        yield from _bool_exprs(node.arg)
+
+
+def _agg_key(a: ast.Agg) -> tuple:
+    # repr of the arg AST: labels are too lossy (every CASE stringifies to
+    # "case", which would merge distinct CASE aggregates)
+    return (a.fn, a.distinct, repr(a.arg) if a.arg is not None else "*")
+
+
+def _subst_aggs(expr, agg_col: dict):
+    """Replace Agg nodes with Column references into the aggregated table."""
+    if isinstance(expr, ast.Agg):
+        return ast.Column(agg_col[_agg_key(expr)])
+    if isinstance(expr, ast.Arith):
+        return ast.Arith(
+            expr.op, _subst_aggs(expr.left, agg_col), _subst_aggs(expr.right, agg_col)
+        )
+    if isinstance(expr, ast.Case):
+        return ast.Case(
+            [(c, _subst_aggs(v, agg_col)) for c, v in expr.whens],
+            _subst_aggs(expr.default, agg_col) if expr.default is not None else None,
+        )
+    if isinstance(expr, ast.Func):
+        return ast.Func(
+            expr.name,
+            [_subst_aggs(a, agg_col) if a is not None else None for a in expr.args],
+        )
+    return expr
+
+
+def _subst_aggs_bool(node, agg_col: dict):
+    if isinstance(node, ast.Compare) and not node.simple:
+        return ast.Compare(
+            node.op, "", None,
+            left=_subst_aggs(node.left, agg_col),
+            right=_subst_aggs(node.right, agg_col),
+        )
+    if isinstance(node, ast.BoolOp):
+        return ast.BoolOp(node.op, [_subst_aggs_bool(a, agg_col) for a in node.args])
+    if isinstance(node, ast.NotOp):
+        return ast.NotOp(_subst_aggs_bool(node.arg, agg_col))
+    return node
+
+
+def _resolve_aliases_bool(node, alias_map: dict):
+    """HAVING may reference select aliases (``HAVING n > 5``); rewrite those
+    columns to the aliased expressions before aggregate collection."""
+
+    def resolve_expr(expr):
+        if isinstance(expr, ast.Column) and expr.name in alias_map:
+            return alias_map[expr.name]
+        if isinstance(expr, ast.Arith):
+            return ast.Arith(expr.op, resolve_expr(expr.left), resolve_expr(expr.right))
+        return expr
+
+    if isinstance(node, ast.Compare):
+        if node.simple and node.col in alias_map:
+            return ast.Compare(
+                node.op, "", None,
+                left=alias_map[node.col], right=ast.Literal(node.value),
+            )
+        if not node.simple:
+            return ast.Compare(
+                node.op, "", None,
+                left=resolve_expr(node.left), right=resolve_expr(node.right),
+            )
+        return node
+    if isinstance(node, ast.BoolOp):
+        return ast.BoolOp(node.op, [_resolve_aliases_bool(a, alias_map) for a in node.args])
+    if isinstance(node, ast.NotOp):
+        return ast.NotOp(_resolve_aliases_bool(node.arg, alias_map))
+    return node
+
+
+def _rename_node_cols(node, mapping: dict):
+    """Rewrite column names in a boolean tree (join key renames)."""
+
+    def ren_expr(expr):
+        if isinstance(expr, ast.Column):
+            return ast.Column(mapping.get(expr.name, expr.name))
+        if isinstance(expr, ast.Arith):
+            return ast.Arith(expr.op, ren_expr(expr.left), ren_expr(expr.right))
+        return expr
+
+    if isinstance(node, ast.Compare):
+        if node.simple:
+            return ast.Compare(node.op, mapping.get(node.col, node.col), node.value)
+        return ast.Compare(
+            node.op, "", None, left=ren_expr(node.left), right=ren_expr(node.right)
+        )
+    if isinstance(node, (ast.InList, ast.IsNull, ast.Like, ast.Between, ast.InSubquery)):
+        import copy as _copy
+
+        out = _copy.copy(node)
+        out.col = mapping.get(node.col, node.col)
+        return out
+    if isinstance(node, ast.BoolOp):
+        return ast.BoolOp(node.op, [_rename_node_cols(a, mapping) for a in node.args])
+    if isinstance(node, ast.NotOp):
+        return ast.NotOp(_rename_node_cols(node.arg, mapping))
+    return node
 
 
 def _broadcast(val, n: int):
@@ -86,14 +233,56 @@ def _expr_label(expr) -> str:
         return str(expr.value)
     if isinstance(expr, ast.Arith):
         return f"{_expr_label(expr.left)}{expr.op}{_expr_label(expr.right)}"
+    if isinstance(expr, ast.Agg):
+        arg = _expr_label(expr.arg) if expr.arg is not None else "*"
+        d = "distinct " if expr.distinct else ""
+        return f"{expr.fn}({d}{arg})"
+    if isinstance(expr, ast.Case):
+        return "case"
+    if isinstance(expr, ast.Func):
+        return expr.name
     return "expr"
+
+
+def _pushable(node) -> bool:
+    """Can this predicate push into the scan as a portable Filter?"""
+    if isinstance(node, ast.Compare):
+        return node.simple
+    if isinstance(node, (ast.InList, ast.IsNull, ast.Between)):
+        return True
+    if isinstance(node, ast.BoolOp):
+        return all(_pushable(a) for a in node.args)
+    if isinstance(node, ast.NotOp):
+        return _pushable(node.arg)
+    return False  # LIKE, subqueries, general comparisons stay residual
+
+
+def _split_where(node) -> tuple[list, list]:
+    """Split a WHERE tree into pushdown-eligible conjuncts and residual
+    conjuncts (evaluated post-scan with the general evaluator)."""
+    conjuncts = (
+        list(node.args) if isinstance(node, ast.BoolOp) and node.op == "and" else [node]
+    )
+    push = [c for c in conjuncts if _pushable(c)]
+    resid = [c for c in conjuncts if not _pushable(c)]
+    return push, resid
 
 
 def _where_to_filter(node) -> Filter:
     if isinstance(node, ast.Compare):
+        if not node.simple:
+            raise SqlError("general comparison cannot push down")
         return Filter(op=node.op, col=node.col, value=node.value)
     if isinstance(node, ast.InList):
         return Filter(op="in", col=node.col, value=list(node.values))
+    if isinstance(node, ast.Between):
+        return Filter(
+            op="and",
+            args=(
+                Filter(op="ge", col=node.col, value=node.low),
+                Filter(op="le", col=node.col, value=node.high),
+            ),
+        )
     if isinstance(node, ast.IsNull):
         return Filter(op="not_null" if node.negated else "is_null", col=node.col)
     if isinstance(node, ast.BoolOp):
@@ -183,177 +372,351 @@ class SqlSession:
 
     # ------------------------------------------------------------------- DQL
     def _select(self, stmt: ast.Select) -> pa.Table:
-        scan = self.catalog.table(stmt.table, self.namespace).scan()
-        if stmt.where is not None and not stmt.joins:
-            scan = scan.filter(_where_to_filter(stmt.where))
+        has_aggs = bool(stmt.group_by) or stmt.having is not None or any(
+            _contains_agg(it.expr) for it in stmt.items
+        )
 
-        aggs = [it for it in stmt.items if isinstance(it.expr, ast.Agg)]
-
-        # columns any select expression references (for projection pushdown)
-        def item_columns(items):
-            cols: set[str] = set()
-            for it in items:
-                if isinstance(it.expr, ast.Agg):
-                    if it.expr.arg is not None:
-                        cols |= _expr_columns(it.expr.arg)
-                else:
-                    cols |= _expr_columns(it.expr)
-            return cols
-
-        if stmt.joins:
-            # hash joins on Arrow compute (pyarrow Table.join).  Predicates
-            # that reference only the base table still push into its scan;
-            # the full WHERE re-applies after the join.
+        # ---- source: scan with pushdown, or a derived table
+        residual_nodes: list = []
+        key_renames: dict[str, str] = {}
+        if stmt.from_subquery is not None:
+            table = self._select(stmt.from_subquery)
             if stmt.where is not None:
-                flt = _where_to_filter(stmt.where)
-                from lakesoul_tpu.io.reader import _filter_column_names
-
-                base_cols = set(
-                    self.catalog.table(stmt.table, self.namespace).schema.names
-                )
-                if _filter_column_names(flt) <= base_cols:
-                    scan = scan.filter(flt)
-            table = scan.to_arrow()
-            key_renames: dict[str, str] = {}
-            for j in stmt.joins:
-                right = self.catalog.table(j.table, self.namespace).to_arrow()
-                join_type = "inner" if j.kind == "inner" else "left outer"
-                left_key, right_key = j.left_on, j.right_on
-                # bind keys by their written qualifier (ON b.x = a.y works in
-                # either order); bare names fall back to column membership
-                if j.left_qual == j.table or (
-                    j.left_qual is None
-                    and left_key not in table.column_names
-                    and left_key in right.column_names
-                ):
-                    left_key, right_key = right_key, left_key
-                # non-key name collisions: suffix the right side (documented,
-                # deterministic; a bare reference resolves to the left table)
-                clashes = (set(table.column_names) & set(right.column_names)) - {right_key}
-                suffix = f"_{j.table}" if clashes else None
-                table = table.join(
-                    right, keys=left_key, right_keys=right_key, join_type=join_type,
-                    right_suffix=suffix,
-                )
-                if right_key != left_key:
-                    # the right key column is dropped by the join; predicates
-                    # on it rewrite to the surviving left key
-                    key_renames[right_key] = left_key
-            if stmt.where is not None:
-                import pyarrow.dataset as pads
-
-                flt = _rename_filter_cols(_where_to_filter(stmt.where), key_renames)
-                table = pads.dataset(table).to_table(filter=flt.to_arrow())
-            if aggs:
-                out = self._aggregate(stmt, table)
-            elif stmt.star:
-                out = table
-            else:
-                out = self._project(stmt.items, table)
-        elif aggs:
-            needed = set(stmt.group_by) | item_columns(stmt.items)
-            table = (scan.select(sorted(needed)) if needed else scan).to_arrow()
-            out = self._aggregate(stmt, table)
+                residual_nodes = [stmt.where]
         else:
-            if not stmt.star:
-                refs = sorted(item_columns(stmt.items))
+            base_schema = set(
+                self.catalog.table(stmt.table, self.namespace).schema.names
+            )
+            scan = self.catalog.table(stmt.table, self.namespace).scan()
+            push_nodes: list = []
+            if stmt.where is not None:
+                push_nodes, residual_nodes = _split_where(stmt.where)
+                if stmt.joins:
+                    # only base-table conjuncts may push below the join
+                    spill = [
+                        n for n in push_nodes if not _node_columns(n) <= base_schema
+                    ]
+                    push_nodes = [n for n in push_nodes if _node_columns(n) <= base_schema]
+                    residual_nodes = residual_nodes + spill
+            if push_nodes:
+                flt = _where_to_filter(push_nodes[0])
+                for n in push_nodes[1:]:
+                    flt = flt & _where_to_filter(n)
+                scan = scan.filter(flt)
+            if not stmt.joins and not stmt.star:
+                needed = self._needed_columns(stmt, residual_nodes)
+                refs = sorted(needed & base_schema)
                 if refs:
                     scan = scan.select(refs)
                 # no refs → full scan keeps the row count for literal selects
             table = scan.to_arrow()
-            if stmt.star:
-                out = table
-            else:
-                out = self._project(stmt.items, table)
 
-        if stmt.order_by:
-            # one multi-key sort: successive single-key sorts would need a
-            # documented-stable sort, which pyarrow does not guarantee
-            out = out.sort_by(
-                [(c, "descending" if d else "ascending") for c, d in stmt.order_by]
+        # ---- joins (hash joins on Arrow compute; right side may be derived)
+        for j in stmt.joins:
+            if j.subquery is not None:
+                right = self._select(j.subquery)
+            else:
+                right = self.catalog.table(j.table, self.namespace).to_arrow()
+            rname = j.alias or j.table
+            join_type = "inner" if j.kind == "inner" else "left outer"
+            left_key, right_key = j.left_on, j.right_on
+            # bind keys by their written qualifier (ON b.x = a.y works in
+            # either order); bare names fall back to column membership
+            if (j.left_qual is not None and j.left_qual in (j.table, j.alias)) or (
+                j.left_qual is None
+                and left_key not in table.column_names
+                and left_key in right.column_names
+            ):
+                left_key, right_key = right_key, left_key
+            # non-key name collisions: suffix the right side (documented,
+            # deterministic; a bare reference resolves to the left table)
+            clashes = (set(table.column_names) & set(right.column_names)) - {right_key}
+            suffix = f"_{rname}" if clashes else None
+            table = table.join(
+                right, keys=left_key, right_keys=right_key, join_type=join_type,
+                right_suffix=suffix,
             )
+            if right_key != left_key:
+                # the right key column is dropped by the join; predicates
+                # on it rewrite to the surviving left key
+                key_renames[right_key] = left_key
+
+        # ---- residual WHERE (general predicates, subqueries, post-join)
+        if residual_nodes:
+            node = (
+                residual_nodes[0]
+                if len(residual_nodes) == 1
+                else ast.BoolOp("and", list(residual_nodes))
+            )
+            if key_renames:
+                node = _rename_node_cols(node, key_renames)
+            mask = self._eval_bool(node, table)
+            table = table.filter(pc.fill_null(_broadcast(mask, len(table)), False))
+
+        # ---- aggregate / project
+        if has_aggs:
+            out, hidden = self._aggregate(stmt, table)
+        elif stmt.star:
+            out, hidden = table, []
+        else:
+            out, hidden = self._project(stmt, table)
+
+        # ---- DISTINCT (on the visible projection)
+        if stmt.distinct:
+            if hidden:
+                out = out.drop_columns(hidden)
+                hidden = []
+            out = out.group_by(out.column_names).aggregate([])
+
+        # ---- ORDER BY (one multi-key sort; hidden columns carry unprojected
+        # sort keys) / LIMIT
+        if stmt.order_by:
+            keys = []
+            for c, desc in stmt.order_by:
+                name = c if c in out.column_names else f"__ord_{c}"
+                if name not in out.column_names:
+                    raise SqlError(f"ORDER BY column {c!r} not available")
+                keys.append((name, "descending" if desc else "ascending"))
+            out = out.sort_by(keys)
+        if hidden:
+            out = out.drop_columns(hidden)
         if stmt.limit is not None:
             out = out.slice(0, stmt.limit)
         return out
 
-    def _project(self, items, table: pa.Table) -> pa.Table:
-        """Evaluate non-aggregate select items (columns + expressions)."""
-        cols, labels = [], []
-        for it in items:
-            cols.append(_broadcast(_eval_expr(it.expr, table), len(table)))
-            labels.append(it.alias or _expr_label(it.expr))
-        return pa.table(cols, names=labels)  # list form keeps duplicate labels
+    def _needed_columns(self, stmt: ast.Select, residual_nodes: list) -> set[str]:
+        cols: set[str] = set(stmt.group_by)
+        for it in stmt.items:
+            cols |= _expr_columns(it.expr)
+        for c, _ in stmt.order_by:
+            cols.add(c)
+        if stmt.having is not None:
+            cols |= _node_columns(stmt.having)
+        for n in residual_nodes:
+            cols |= _node_columns(n)
+        return cols
 
-    def _aggregate(self, stmt: ast.Select, table: pa.Table) -> pa.Table:
-        fn_map = {"count": "count", "sum": "sum", "min": "min", "max": "max", "avg": "mean"}
-        if stmt.group_by:
-            specs = []
-            names = []
-            work = table
-            for i, it in enumerate(stmt.items):
-                if isinstance(it.expr, ast.Agg):
-                    agg = it.expr
-                    if agg.arg is None:
-                        # COUNT(*) counts rows, not non-null values of some
-                        # column (a NULL group key must still count its rows)
-                        target = []
-                        pa_fn = "count_all"
-                        label = it.alias or "count(*)"
-                    else:
-                        # aggregate over a computed expression: materialize a
-                        # temp column, then aggregate it
-                        if isinstance(agg.arg, ast.Column):
-                            target = agg.arg.name
-                        else:
-                            target = f"__agg_expr_{i}"
-                            arr = _broadcast(_eval_expr(agg.arg, work), len(work))
-                            work = work.append_column(target, arr)
-                        pa_fn = fn_map[agg.fn]
-                        label = it.alias or f"{agg.fn}({_expr_label(agg.arg)})"
-                    specs.append((target, pa_fn))
-                    names.append(label)
-                elif isinstance(it.expr, ast.Column):
-                    if it.expr.name not in stmt.group_by:
-                        raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
-                else:
-                    raise SqlError("non-aggregate expressions in GROUP BY selects not supported")
-            # dedup identical aggregates: repeating e.g. COUNT(*) or sum(v)
-            # in one select must not produce colliding grouped-schema columns
-            call_specs, seen = [], set()
-            for target, pa_fn in specs:
-                k = (tuple(target) if isinstance(target, list) else target, pa_fn)
-                if k in seen:
-                    continue
-                seen.add(k)
-                call_specs.append((target, pa_fn))
-            grouped = work.group_by(stmt.group_by).aggregate(call_specs)
-            cols, labels = [], []
-            for it in stmt.items:
-                if isinstance(it.expr, ast.Column):
-                    cols.append(grouped.column(it.expr.name))
-                    labels.append(it.alias or it.expr.name)
-            for (target, pa_fn), label in zip(specs, names):
-                col = "count_all" if pa_fn == "count_all" else f"{target}_{pa_fn}"
-                cols.append(grouped.column(col))
-                labels.append(label)
-            return pa.table(dict(zip(labels, cols)))
-        # global aggregates
+    def _project(self, stmt: ast.Select, table: pa.Table) -> tuple[pa.Table, list[str]]:
+        """Evaluate non-aggregate select items; append hidden ``__ord_*``
+        columns for ORDER BY keys that are not projected."""
         cols, labels = [], []
         for it in stmt.items:
-            agg = it.expr
-            if not isinstance(agg, ast.Agg):
-                raise SqlError("mixing plain columns with global aggregates needs GROUP BY")
+            cols.append(_broadcast(self._eval_expr(it.expr, table), len(table)))
+            labels.append(it.alias or _expr_label(it.expr))
+        hidden: list[str] = []
+        for c, _ in stmt.order_by:
+            if c not in labels and c in table.column_names:
+                h = f"__ord_{c}"
+                cols.append(table.column(c))
+                labels.append(h)
+                hidden.append(h)
+        return pa.table(cols, names=labels), hidden  # list form keeps dup labels
+
+    _AGG_FN = {"count": "count", "sum": "sum", "min": "min", "max": "max", "avg": "mean"}
+
+    def _aggregate(self, stmt: ast.Select, table: pa.Table) -> tuple[pa.Table, list[str]]:
+        """GROUP BY / global aggregation with HAVING and expressions over
+        aggregates (e.g. ``100 * sum(a) / sum(b)``)."""
+        # alias resolution for HAVING/expressions: alias → item expression
+        alias_map = {it.alias: it.expr for it in stmt.items if it.alias}
+
+        # collect every distinct aggregate across select items + HAVING
+        agg_nodes: dict[tuple, ast.Agg] = {}
+
+        def collect(expr):
+            for a in _walk_aggs(expr):
+                agg_nodes.setdefault(_agg_key(a), a)
+
+        for it in stmt.items:
+            collect(it.expr)
+        having = stmt.having
+        if having is not None:
+            having = _resolve_aliases_bool(having, alias_map)
+            for sub in _bool_exprs(having):
+                collect(sub)
+
+        # materialize expression arguments, build one spec per distinct agg
+        work = table
+        specs: list = []
+        agg_col: dict[tuple, str] = {}
+        for i, (key, agg) in enumerate(agg_nodes.items()):
             if agg.arg is None:
-                value = pa.array([table.num_rows], type=pa.int64())
-                label = it.alias or "count(*)"
+                specs.append(([], "count_all"))
+                agg_col[key] = "count_all"
+                continue
+            if isinstance(agg.arg, ast.Column):
+                target = agg.arg.name
             else:
-                arr = _broadcast(_eval_expr(agg.arg, table), table.num_rows)
-                fn = fn_map[agg.fn]
-                value = pa.array([getattr(pc, fn)(arr).as_py()])
-                label = it.alias or f"{agg.fn}({_expr_label(agg.arg)})"
-            cols.append(value)
-            labels.append(label)
-        return pa.table(dict(zip(labels, cols)))
+                target = f"__agg_in_{i}"
+                arr = _broadcast(self._eval_expr(agg.arg, work), len(work))
+                work = work.append_column(target, arr)
+            if agg.distinct and agg.fn != "count":
+                raise SqlError(
+                    f"DISTINCT is only supported for count, not {agg.fn}"
+                )
+            fn = "count_distinct" if agg.distinct else self._AGG_FN[agg.fn]
+            specs.append((target, fn))
+            agg_col[key] = f"{target}_{fn}"
+        # dedup identical specs (repeated aggregates share one output column)
+        call_specs, seen = [], set()
+        for target, fn in specs:
+            k = (tuple(target) if isinstance(target, list) else target, fn)
+            if k not in seen:
+                seen.add(k)
+                call_specs.append((target, fn))
+
+        grouped = work.group_by(list(stmt.group_by)).aggregate(call_specs)
+
+        if having is not None:
+            mask = self._eval_bool(_subst_aggs_bool(having, agg_col), grouped)
+            grouped = grouped.filter(pc.fill_null(_broadcast(mask, len(grouped)), False))
+
+        # project select items over the aggregated table
+        cols, labels = [], []
+        for it in stmt.items:
+            if isinstance(it.expr, ast.Column):
+                if it.expr.name not in stmt.group_by:
+                    raise SqlError(f"column {it.expr.name} must appear in GROUP BY")
+                cols.append(grouped.column(it.expr.name))
+                labels.append(it.alias or it.expr.name)
+            else:
+                expr = _subst_aggs(it.expr, agg_col)
+                cols.append(_broadcast(self._eval_expr(expr, grouped), len(grouped)))
+                labels.append(it.alias or _expr_label(it.expr))
+        out = pa.table(cols, names=labels)
+        # unprojected ORDER BY keys that are group keys ride along hidden
+        hidden: list[str] = []
+        for c, _ in stmt.order_by:
+            if c not in labels and c in grouped.column_names:
+                h = f"__ord_{c}"
+                out = out.append_column(h, grouped.column(c))
+                hidden.append(h)
+        return out, hidden
+
+    # ------------------------------------------------------- expression eval
+    def _eval_expr(self, expr, table: pa.Table):
+        """Evaluate a value expression against a table → Arrow array/scalar."""
+        if isinstance(expr, ast.Column):
+            return table.column(expr.name)
+        if isinstance(expr, ast.Literal):
+            return pa.scalar(expr.value)
+        if isinstance(expr, ast.Arith):
+            left = self._eval_expr(expr.left, table)
+            right = self._eval_expr(expr.right, table)
+            fn = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide}[expr.op]
+            return fn(left, right)
+        if isinstance(expr, ast.Case):
+            return self._eval_case(expr, table)
+        if isinstance(expr, ast.Func):
+            if expr.name == "substring":
+                arr, start, length = expr.args
+                s = self._eval_expr(start, table)
+                s0 = (s.as_py() if isinstance(s, pa.Scalar) else s) - 1  # SQL is 1-based
+                stop = None
+                if length is not None:
+                    ln = self._eval_expr(length, table)
+                    stop = s0 + (ln.as_py() if isinstance(ln, pa.Scalar) else ln)
+                return pc.utf8_slice_codeunits(
+                    self._eval_expr(arr, table), start=s0, stop=stop
+                )
+            raise SqlError(f"unknown function {expr.name!r}")
+        if isinstance(expr, ast.ScalarSubquery):
+            sub = self._select(expr.select)
+            if sub.num_columns != 1 or len(sub) > 1:
+                raise SqlError("scalar subquery must produce one value")
+            return sub.column(0)[0] if len(sub) else pa.scalar(None)
+        if isinstance(expr, ast.Agg):
+            raise SqlError("aggregate not allowed here (missing GROUP BY context?)")
+        raise SqlError(f"unsupported expression {expr!r}")
+
+    def _eval_case(self, expr: ast.Case, table: pa.Table):
+        """CASE with SQL's lazy-branch guarantee: each THEN/ELSE evaluates
+        only over the rows its condition selects (``CASE WHEN b != 0 THEN
+        a / b ...`` must not divide by zero on guarded rows), then results
+        scatter back into row order."""
+        import numpy as np
+
+        n = len(table)
+        remaining = np.ones(n, dtype=bool)
+        parts: list[tuple[np.ndarray, pa.Table]] = []
+        for cond, value in expr.whens:
+            mask = pc.fill_null(
+                _broadcast(self._eval_bool(cond, table), n), False
+            )
+            m = np.asarray(mask) & remaining
+            rows = np.nonzero(m)[0]
+            if rows.size:
+                sub = table.take(pa.array(rows))
+                vals = _broadcast(self._eval_expr(value, sub), len(sub))
+                parts.append((rows, pa.table({"v": vals})))
+            remaining &= ~m
+        rest = np.nonzero(remaining)[0]
+        if rest.size:
+            if expr.default is not None:
+                sub = table.take(pa.array(rest))
+                vals = _broadcast(self._eval_expr(expr.default, sub), len(sub))
+            else:
+                vals = pa.nulls(rest.size)
+            parts.append((rest, pa.table({"v": vals})))
+        if not parts:
+            return pa.nulls(0)
+        merged = pa.concat_tables(
+            [p for _, p in parts], promote_options="permissive"
+        ).column("v")
+        order = np.concatenate([r for r, _ in parts])
+        inverse = np.empty(n, dtype=np.int64)
+        inverse[order] = np.arange(n, dtype=np.int64)
+        return merged.take(pa.array(inverse))
+
+    def _eval_bool(self, node, table: pa.Table):
+        """Evaluate a boolean tree to an Arrow mask (Kleene semantics)."""
+        if isinstance(node, ast.Compare):
+            ops = {"eq": pc.equal, "ne": pc.not_equal, "lt": pc.less,
+                   "le": pc.less_equal, "gt": pc.greater, "ge": pc.greater_equal}
+            if node.simple:
+                return ops[node.op](table.column(node.col), pa.scalar(node.value))
+            return ops[node.op](
+                self._eval_expr(node.left, table), self._eval_expr(node.right, table)
+            )
+        if isinstance(node, ast.InList):
+            return pc.is_in(table.column(node.col), value_set=pa.array(node.values))
+        if isinstance(node, ast.InSubquery):
+            sub = self._select(node.select)
+            if sub.num_columns != 1:
+                raise SqlError("IN (SELECT ...) must produce one column")
+            mask = pc.is_in(
+                table.column(node.col), value_set=sub.column(0).combine_chunks()
+            )
+            return pc.invert(mask) if node.negated else mask
+        if isinstance(node, ast.Exists):
+            exists = len(self._select(node.select)) > 0
+            return pa.scalar(exists != node.negated)
+        if isinstance(node, ast.Like):
+            mask = pc.match_like(table.column(node.col), node.pattern)
+            return pc.invert(mask) if node.negated else mask
+        if isinstance(node, ast.Between):
+            col = table.column(node.col)
+            return pc.and_kleene(
+                pc.greater_equal(col, pa.scalar(node.low)),
+                pc.less_equal(col, pa.scalar(node.high)),
+            )
+        if isinstance(node, ast.IsNull):
+            col = table.column(node.col)
+            return col.is_valid() if node.negated else pc.is_null(col)
+        if isinstance(node, ast.BoolOp):
+            fold = pc.and_kleene if node.op == "and" else pc.or_kleene
+            masks = [
+                _broadcast(self._eval_bool(a, table), len(table)) for a in node.args
+            ]
+            out = masks[0]
+            for m in masks[1:]:
+                out = fold(out, m)
+            return out
+        if isinstance(node, ast.NotOp):
+            return pc.invert(
+                _broadcast(self._eval_bool(node.arg, table), len(table))
+            )
+        raise SqlError(f"unsupported predicate {node!r}")
 
     # ------------------------------------------------------------------- DML
     def _insert(self, stmt: ast.Insert) -> pa.Table:

@@ -38,6 +38,8 @@ KEYWORDS = {
     "primary", "key", "partitioned", "with", "if", "exists", "distinct",
     "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
     "column", "call", "update", "set", "delete", "join", "inner", "left", "on",
+    "case", "when", "then", "else", "end", "having", "between", "like",
+    "substring", "for",
 }
 
 
@@ -82,6 +84,7 @@ class Agg:
     fn: str  # count | sum | min | max | avg
     arg: object | None  # Column/Literal/Arith expression; None = count(*)
     alias: str | None = None
+    distinct: bool = False  # count(DISTINCT x)
 
 
 @dataclass
@@ -89,6 +92,29 @@ class Arith:
     op: str  # + - * /
     left: object
     right: object
+
+
+@dataclass
+class Case:
+    """CASE WHEN cond THEN expr [...] [ELSE expr] END."""
+
+    whens: list  # [(bool_node, value_expr), ...]
+    default: object | None = None
+
+
+@dataclass
+class Func:
+    """Scalar function call (substring, ...)."""
+
+    name: str
+    args: list
+
+
+@dataclass
+class ScalarSubquery:
+    """Uncorrelated (SELECT ...) used as a value."""
+
+    select: "Select"
 
 
 @dataclass
@@ -100,14 +126,48 @@ class SelectItem:
 @dataclass
 class Compare:
     op: str
-    col: str
-    value: Any
+    col: str  # simple column name when the LHS is a bare column, else ""
+    value: Any  # literal when the RHS is a literal, else None
+    left: Any = None  # general expressions (col-col / arith comparisons)
+    right: Any = None
+
+    @property
+    def simple(self) -> bool:
+        """Pushdown-eligible: bare column vs literal."""
+        return bool(self.col) and self.left is None
 
 
 @dataclass
 class InList:
     col: str
     values: list
+
+
+@dataclass
+class InSubquery:
+    col: str
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass
+class Exists:
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass
+class Like:
+    col: str
+    pattern: str
+    negated: bool = False
+
+
+@dataclass
+class Between:
+    col: str
+    low: Any
+    high: Any
 
 
 @dataclass
@@ -129,22 +189,28 @@ class NotOp:
 
 @dataclass
 class Join:
-    table: str
+    table: str  # name, or "" when right is a derived table
     kind: str  # inner | left
     left_on: str
     right_on: str
     left_qual: str | None = None  # table qualifier as written (a.col)
     right_qual: str | None = None
+    subquery: "Select | None" = None  # JOIN (SELECT ...) alias
+    alias: str | None = None
 
 
 @dataclass
 class Select:
     items: list[SelectItem]
     star: bool
-    table: str
+    table: str  # name, or "" when from_subquery is set
+    from_subquery: "Select | None" = None  # FROM (SELECT ...) alias
+    from_alias: str | None = None
+    distinct: bool = False
     joins: list = field(default_factory=list)
     where: Any = None
     group_by: list[str] = field(default_factory=list)
+    having: Any = None
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
     limit: int | None = None
 
@@ -278,6 +344,7 @@ class Parser:
 
     def parse_select(self) -> Select:
         self.expect("kw", "select")
+        distinct = bool(self.accept("kw", "distinct"))
         star = False
         items: list[SelectItem] = []
         if self.accept("op", "*"):
@@ -288,8 +355,20 @@ class Parser:
                 if not self.accept("op", ","):
                     break
         self.expect("kw", "from")
-        table = self.ident()
-        sel = Select(items=items, star=star, table=table)
+        sel = Select(items=items, star=star, table="", distinct=distinct)
+        if self.accept("op", "("):
+            sel.from_subquery = self.parse_select()
+            self.expect("op", ")")
+            self.accept("kw", "as")
+            if self.peek() is not None and self.peek().kind == "ident":
+                sel.from_alias = self.ident()
+        else:
+            sel.table = self.ident()
+            # optional table alias (FROM lineitem l) — ignored for resolution,
+            # accepted so qualified queries parse
+            nxt = self.peek()
+            if nxt is not None and nxt.kind == "ident":
+                sel.from_alias = self.ident()
         while True:
             kind = None
             if self.accept("kw", "inner"):
@@ -302,24 +381,38 @@ class Parser:
                 kind = "inner"
             else:
                 break
-            jt = self.ident()
+            sub = None
+            jt = ""
+            alias = None
+            if self.accept("op", "("):
+                sub = self.parse_select()
+                self.expect("op", ")")
+                self.accept("kw", "as")
+                alias = self.ident()
+            else:
+                jt = self.ident()
+                nxt = self.peek()
+                if nxt is not None and nxt.kind == "ident":
+                    alias = self.ident()
             self.expect("kw", "on")
             # ON a.col = b.col  (qualified or bare column names)
             lq, left_on = self._qualified_ident()
             self.expect("op", "=")
             rq, right_on = self._qualified_ident()
-            sel.joins.append(Join(jt, kind, left_on, right_on, lq, rq))
+            sel.joins.append(Join(jt, kind, left_on, right_on, lq, rq, sub, alias))
         if self.accept("kw", "where"):
             sel.where = self._bool_expr()
         if self.accept("kw", "group"):
             self.expect("kw", "by")
-            sel.group_by.append(self.ident())
+            sel.group_by.append(self._qualified_ident()[1])
             while self.accept("op", ","):
-                sel.group_by.append(self.ident())
+                sel.group_by.append(self._qualified_ident()[1])
+        if self.accept("kw", "having"):
+            sel.having = self._bool_expr()
         if self.accept("kw", "order"):
             self.expect("kw", "by")
             while True:
-                col = self.ident()
+                col = self._qualified_ident()[1]
                 desc = False
                 if self.accept("kw", "desc"):
                     desc = True
@@ -340,31 +433,57 @@ class Parser:
         return None, name
 
     def _select_item(self) -> SelectItem:
-        tok = self.peek()
-        if tok.kind == "kw" and tok.value in ("count", "sum", "min", "max", "avg"):
-            fn = self.next().value
-            self.expect("op", "(")
-            if self.accept("op", "*"):
-                arg = None
-                if fn != "count":
-                    raise SqlError(f"{fn}(*) not supported")
-            else:
-                arg = self._arith_expr()
-            self.expect("op", ")")
-            alias = self.ident() if self.accept("kw", "as") else None
-            return SelectItem(Agg(fn, arg), alias)
+        # aggregates are ordinary factors, so `sum(a) / sum(b)` parses whole
         expr = self._arith_expr()
         alias = self.ident() if self.accept("kw", "as") else None
         return SelectItem(expr, alias)
 
+    def _maybe_agg(self) -> Agg | None:
+        tok = self.peek()
+        if not (tok and tok.kind == "kw" and tok.value in ("count", "sum", "min", "max", "avg")):
+            return None
+        fn = self.next().value
+        self.expect("op", "(")
+        distinct = bool(self.accept("kw", "distinct"))
+        if self.accept("op", "*"):
+            arg = None
+            if fn != "count":
+                raise SqlError(f"{fn}(*) not supported")
+        else:
+            arg = self._arith_expr()
+        self.expect("op", ")")
+        return Agg(fn, arg, distinct=distinct)
+
     # arithmetic value expressions: expr := term (±term)*; term := factor (*/factor)*
+    @staticmethod
+    def _fold(op: str, left, right):
+        """Constant-fold literal arithmetic so negative numbers and literal
+        math stay pushdown-eligible literals."""
+        if isinstance(left, Literal) and isinstance(right, Literal):
+            if isinstance(left.value, str) or isinstance(right.value, str):
+                raise SqlError("arithmetic requires numeric operands")
+            if left.value is None or right.value is None:
+                return Literal(None)
+            if op == "/":
+                if right.value == 0:
+                    raise SqlError("division by zero in literal expression")
+                if isinstance(left.value, int) and isinstance(right.value, int):
+                    # match the runtime's pc.divide: integer division
+                    # truncating toward zero, not Python floor/true division
+                    return Literal(int(left.value / right.value))
+                return Literal(left.value / right.value)
+            py = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
+                  "*": lambda a, b: a * b}[op]
+            return Literal(py(left.value, right.value))
+        return Arith(op, left, right)
+
     def _arith_expr(self):
         left = self._arith_term()
         while True:
             if self.accept("op", "+"):
-                left = Arith("+", left, self._arith_term())
+                left = self._fold("+", left, self._arith_term())
             elif self.accept("op", "-"):
-                left = Arith("-", left, self._arith_term())
+                left = self._fold("-", left, self._arith_term())
             else:
                 return left
 
@@ -372,28 +491,71 @@ class Parser:
         left = self._arith_factor()
         while True:
             if self.accept("op", "*"):
-                left = Arith("*", left, self._arith_factor())
+                left = self._fold("*", left, self._arith_factor())
             elif self.accept("op", "/"):
-                left = Arith("/", left, self._arith_factor())
+                left = self._fold("/", left, self._arith_factor())
             else:
                 return left
 
     def _arith_factor(self):
         if self.accept("op", "("):
+            # (SELECT ...) scalar subquery or parenthesized expression
+            nxt = self.peek()
+            if nxt is not None and nxt.kind == "kw" and nxt.value == "select":
+                sub = self.parse_select()
+                self.expect("op", ")")
+                return ScalarSubquery(sub)
             e = self._arith_expr()
             self.expect("op", ")")
             return e
         if self.accept("op", "-"):
-            return Arith("-", Literal(0), self._arith_factor())
+            return self._fold("-", Literal(0), self._arith_factor())
         tok = self.peek()
         if tok is None:
             raise SqlError("unexpected end of statement in expression")
+        if tok.kind == "kw" and tok.value == "case":
+            return self._case_expr()
+        if tok.kind == "kw" and tok.value == "substring":
+            return self._substring_expr()
+        agg = self._maybe_agg()
+        if agg is not None:
+            return agg  # aggregates inside expressions (HAVING, agg arith)
         if tok.kind == "number" or tok.kind == "string" or (
             tok.kind == "kw" and tok.value in ("true", "false", "null")
         ):
             return Literal(self._value())
         _, name = self._qualified_ident()
         return Column(name)
+
+    def _case_expr(self) -> Case:
+        self.expect("kw", "case")
+        whens = []
+        default = None
+        while self.accept("kw", "when"):
+            cond = self._bool_expr()
+            self.expect("kw", "then")
+            whens.append((cond, self._arith_expr()))
+        if self.accept("kw", "else"):
+            default = self._arith_expr()
+        self.expect("kw", "end")
+        if not whens:
+            raise SqlError("CASE requires at least one WHEN")
+        return Case(whens, default)
+
+    def _substring_expr(self) -> Func:
+        self.expect("kw", "substring")
+        self.expect("op", "(")
+        arg = self._arith_expr()
+        # substring(x FROM a FOR b) or substring(x, a, b)
+        if self.accept("kw", "from"):
+            start = self._arith_expr()
+            length = self._arith_expr() if self.accept("kw", "for") else None
+        else:
+            self.expect("op", ",")
+            start = self._arith_expr()
+            length = self._arith_expr() if self.accept("op", ",") else None
+        self.expect("op", ")")
+        return Func("substring", [arg, start, length])
 
     # ------------------------------------------------------------- where expr
     def _bool_expr(self):
@@ -419,28 +581,92 @@ class Parser:
     def _bool_factor(self):
         if self.accept("kw", "not"):
             return NotOp(self._bool_factor())
-        if self.accept("op", "("):
-            e = self._bool_expr()
+        if self.accept("kw", "exists"):
+            self.expect("op", "(")
+            sub = self.parse_select()
             self.expect("op", ")")
-            return e
+            return Exists(sub)
+        if self.peek() and self.peek().kind == "op" and self.peek().value == "(":
+            # lookahead: "(bool expr)" vs a parenthesized arith LHS like
+            # "(a + b) > c" — try bool first, rewind on failure
+            mark = self.pos
+            self.next()
+            try:
+                e = self._bool_expr()
+                self.expect("op", ")")
+                nxt = self.peek()
+                # "(x)" followed by a comparison means x was an arith LHS
+                if not (nxt and nxt.kind == "op" and nxt.value in ("<", "<=", ">", ">=", "=", "!=", "<>")):
+                    return e
+            except SqlError:
+                pass
+            self.pos = mark
         return self._predicate()
 
+    _OP_MAP = {"=": "eq", "!=": "ne", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+
     def _predicate(self):
-        col = self.ident()
-        if self.accept("kw", "is"):
+        left = self._arith_expr()
+        simple_col = left.name if isinstance(left, Column) else None
+        if simple_col is not None and self.accept("kw", "is"):
             negated = bool(self.accept("kw", "not"))
             self.expect("kw", "null")
-            return IsNull(col, negated)
-        if self.accept("kw", "not"):
+            return IsNull(simple_col, negated)
+        if simple_col is not None and self.accept("kw", "between"):
+            low = self._arith_expr()
+            self.expect("kw", "and")
+            high = self._arith_expr()
+            if not (isinstance(low, Literal) and isinstance(high, Literal)):
+                raise SqlError("BETWEEN bounds must be literals")
+            return Between(simple_col, low.value, high.value)
+        if self.peek() and self.peek().kind == "kw" and self.peek().value == "not":
+            self.next()
+            if self.accept("kw", "like"):
+                if simple_col is None:
+                    raise SqlError("LIKE requires a plain column")
+                return Like(simple_col, self._string_value(), negated=True)
             self.expect("kw", "in")
-            return NotOp(InList(col, self._value_list()))
+            node = self._in_tail(simple_col)
+            if isinstance(node, InSubquery):
+                node.negated = True
+                return node
+            return NotOp(node)
+        if simple_col is not None and self.accept("kw", "like"):
+            return Like(simple_col, self._string_value())
         if self.accept("kw", "in"):
-            return InList(col, self._value_list())
+            return self._in_tail(simple_col)
         op_tok = self.next()
-        op_map = {"=": "eq", "!=": "ne", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
-        if op_tok.kind != "op" or op_tok.value not in op_map:
+        if op_tok.kind != "op" or op_tok.value not in self._OP_MAP:
             raise SqlError(f"expected comparison operator, got {op_tok.value!r}")
-        return Compare(op_map[op_tok.value], col, self._value())
+        op = self._OP_MAP[op_tok.value]
+        right = self._arith_expr()
+        if simple_col is not None and isinstance(right, Literal):
+            return Compare(op, simple_col, right.value)  # pushdown-eligible
+        return Compare(op, "", None, left=left, right=right)
+
+    def _in_tail(self, simple_col: str | None):
+        """After IN: either a literal list or a subquery."""
+        self.expect("op", "(")
+        nxt = self.peek()
+        if nxt is not None and nxt.kind == "kw" and nxt.value == "select":
+            sub = self.parse_select()
+            self.expect("op", ")")
+            if simple_col is None:
+                raise SqlError("IN (SELECT ...) requires a plain column")
+            return InSubquery(simple_col, sub)
+        vals = [self._value()]
+        while self.accept("op", ","):
+            vals.append(self._value())
+        self.expect("op", ")")
+        if simple_col is None:
+            raise SqlError("IN list requires a plain column")
+        return InList(simple_col, vals)
+
+    def _string_value(self) -> str:
+        v = self._value()
+        if not isinstance(v, str):
+            raise SqlError("LIKE pattern must be a string literal")
+        return v
 
     def _value_list(self) -> list:
         self.expect("op", "(")

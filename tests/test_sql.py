@@ -397,39 +397,18 @@ class TestExpressions:
 
 
 class TestTpchLite:
-    def test_harness_runs_and_is_consistent(self, tmp_warehouse):
-        from lakesoul_tpu.sql.tpch import TpchLite
+    # full Q1-Q22 coverage (with pandas result checks) lives in
+    # tests/test_tpch.py; this is a smoke check of the harness surface
+    def test_harness_smoke(self, tmp_warehouse):
+        from lakesoul_tpu.sql.tpch import QUERIES, TpchLite
 
         catalog = LakeSoulCatalog(str(tmp_warehouse / "tpch"))
-        h = TpchLite(catalog, scale_rows=5000, seed=1)
+        h = TpchLite(catalog, scale_rows=3000, seed=1)
         h.generate()
-        results = h.run_all()
-        assert set(results) == {
-            "q1_pricing_summary", "q3_shipping_priority",
-            "q6_forecast_revenue", "q_customer_revenue",
-        }
-        q1 = results["q1_pricing_summary"][1]
-        assert q1.column("returnflag").to_pylist() == ["A", "N", "R"]
-        # cross-check q6 against direct arrow compute
-        li = catalog.table("lineitem").to_arrow()
-        import pyarrow.compute as pc
-
-        mask = (
-            (pc.greater_equal(li["shipdate"], pa.scalar("1994-01-01")))
-            .to_pandas()
-            & (pc.less(li["shipdate"], pa.scalar("1995-01-01"))).to_pandas()
-            & (pc.greater_equal(li["discount"], pa.scalar(0.05))).to_pandas()
-            & (pc.less_equal(li["discount"], pa.scalar(0.07))).to_pandas()
-            & (pc.less(li["quantity"], pa.scalar(24.0))).to_pandas()
-        )
-        sub = li.to_pandas()[mask.values]
-        expected = float((sub["extendedprice"] * sub["discount"]).sum())
-        got = results["q6_forecast_revenue"][1].column("revenue").to_pylist()[0]
-        assert abs(got - expected) < 1e-6
-        q3 = results["q3_shipping_priority"][1]
-        assert q3.num_rows == 10
-        rev = q3.column("revenue").to_pylist()
-        assert rev == sorted(rev, reverse=True)
+        assert len(QUERIES) == 22
+        secs, q1 = h.run("q01")
+        assert secs >= 0 and q1.num_rows > 0
+        assert h.verify("q06")
 
 
 class TestExpressionEdgeCases:
@@ -452,3 +431,159 @@ class TestExpressionEdgeCases:
 
         with pytest.raises(SqlError, match="numeric"):
             session.execute("SELECT id FROM users WHERE name = -'x'")
+
+
+class TestSqlSurfaceR2:
+    """CASE / HAVING / subqueries / derived tables / DISTINCT / LIKE /
+    BETWEEN / substring / expressions over aggregates (VERDICT r1 #3)."""
+
+    def test_case_when(self, session):
+        out = session.execute(
+            "SELECT id, CASE WHEN age >= 30 THEN 'senior' WHEN age >= 26 THEN 'mid'"
+            " ELSE 'junior' END AS band FROM users ORDER BY id"
+        )
+        assert out.column("band").to_pylist() == ["senior", "junior", "senior", "mid"]
+
+    def test_case_without_else_yields_null(self, session):
+        out = session.execute(
+            "SELECT id, CASE WHEN age > 100 THEN 1 END AS x FROM users ORDER BY id"
+        )
+        assert out.column("x").to_pylist() == [None] * 4
+
+    def test_sum_of_case(self, session):
+        out = session.execute(
+            "SELECT sum(CASE WHEN city = 'sf' THEN age ELSE 0 END) AS sf_age FROM users"
+        )
+        assert out.column("sf_age").to_pylist() == [65]
+
+    def test_having(self, session):
+        session.execute("INSERT INTO users VALUES (9, 'zed', 40, 'sf')")
+        out = session.execute(
+            "SELECT city, count(*) AS n FROM users GROUP BY city HAVING count(*) > 2"
+        )
+        assert out.column("city").to_pylist() == ["sf"]
+        assert out.column("n").to_pylist() == [3]
+
+    def test_having_on_alias(self, session):
+        out = session.execute(
+            "SELECT city, avg(age) AS a FROM users GROUP BY city HAVING a > 30"
+        )
+        assert out.column("city").to_pylist() == ["sf"]
+
+    def test_expression_over_aggregates(self, session):
+        out = session.execute(
+            "SELECT 100 * sum(age) / count(*) AS avg100 FROM users"
+        )
+        assert out.column("avg100").to_pylist() == [2950.0]
+
+    def test_scalar_subquery(self, session):
+        out = session.execute(
+            "SELECT id FROM users WHERE age > (SELECT avg(age) FROM users) ORDER BY id"
+        )
+        assert out.column("id").to_pylist() == [1, 3]
+
+    def test_in_subquery(self, session):
+        out = session.execute(
+            "SELECT name FROM users WHERE id IN (SELECT id FROM users WHERE city = 'sf')"
+            " ORDER BY id"
+        )
+        assert out.column("name").to_pylist() == ["alice", "carol"]
+
+    def test_not_in_subquery(self, session):
+        out = session.execute(
+            "SELECT name FROM users WHERE id NOT IN"
+            " (SELECT id FROM users WHERE city = 'sf') ORDER BY id"
+        )
+        assert out.column("name").to_pylist() == ["bob", "dave"]
+
+    def test_exists(self, session):
+        out = session.execute(
+            "SELECT count(*) AS n FROM users WHERE EXISTS"
+            " (SELECT id FROM users WHERE age > 100)"
+        )
+        assert out.column("n").to_pylist() == [0]
+        out2 = session.execute(
+            "SELECT count(*) AS n FROM users WHERE NOT EXISTS"
+            " (SELECT id FROM users WHERE age > 100)"
+        )
+        assert out2.column("n").to_pylist() == [4]
+
+    def test_derived_table(self, session):
+        out = session.execute(
+            "SELECT city, n FROM (SELECT city, count(*) AS n FROM users GROUP BY city) t"
+            " WHERE n >= 2 ORDER BY city"
+        )
+        assert out.column("city").to_pylist() == ["nyc", "sf"]
+
+    def test_join_derived_table(self, session):
+        out = session.execute(
+            "SELECT name, n FROM users JOIN"
+            " (SELECT city AS jcity, count(*) AS n FROM users GROUP BY city) t"
+            " ON city = jcity WHERE age > 28 ORDER BY id"
+        )
+        assert out.column("name").to_pylist() == ["alice", "carol"]
+        assert out.column("n").to_pylist() == [2, 2]
+
+    def test_distinct(self, session):
+        out = session.execute("SELECT DISTINCT city FROM users")
+        assert sorted(out.column("city").to_pylist()) == ["nyc", "sf"]
+
+    def test_count_distinct(self, session):
+        out = session.execute("SELECT count(DISTINCT city) AS c FROM users")
+        assert out.column("c").to_pylist() == [2]
+
+    def test_like_and_not_like(self, session):
+        out = session.execute("SELECT name FROM users WHERE name LIKE 'a%'")
+        assert out.column("name").to_pylist() == ["alice"]
+        out2 = session.execute(
+            "SELECT name FROM users WHERE name NOT LIKE '%e%' ORDER BY id"
+        )
+        assert out2.column("name").to_pylist() == ["bob", "carol"]
+
+    def test_between(self, session):
+        out = session.execute(
+            "SELECT id FROM users WHERE age BETWEEN 26 AND 31 ORDER BY id"
+        )
+        assert out.column("id").to_pylist() == [1, 4]
+
+    def test_substring(self, session):
+        out = session.execute(
+            "SELECT substring(name, 1, 2) AS pre FROM users ORDER BY id"
+        )
+        assert out.column("pre").to_pylist() == ["al", "bo", "ca", "da"]
+
+    def test_order_by_unprojected_column(self, session):
+        out = session.execute("SELECT name FROM users ORDER BY age DESC, id")
+        assert out.column("name").to_pylist() == ["carol", "alice", "dave", "bob"]
+        assert out.num_columns == 1
+
+    def test_column_vs_column_comparison(self, session):
+        out = session.execute("SELECT id FROM users WHERE age > id + 25 ORDER BY id")
+        assert out.column("id").to_pylist() == [1, 3]
+
+    def test_table_alias_accepted(self, session):
+        out = session.execute("SELECT u.id FROM users u WHERE u.age > 30")
+        assert out.column("id").to_pylist() == [3]
+
+    def test_case_guards_failing_branch(self, session):
+        # SQL guarantees the guarded branch is not evaluated on excluded rows
+        session.execute("CREATE TABLE dz (id bigint PRIMARY KEY, a bigint, b bigint)")
+        session.execute("INSERT INTO dz VALUES (1, 10, 0), (2, 10, 2), (3, 7, 7)")
+        out = session.execute(
+            "SELECT id, CASE WHEN b <> 0 THEN a / b ELSE -1 END AS r FROM dz ORDER BY id"
+        )
+        assert out.column("r").to_pylist() == [-1, 5, 1]
+
+    def test_distinct_only_for_count(self, session):
+        with pytest.raises(SqlError, match="DISTINCT"):
+            session.execute("SELECT sum(DISTINCT age) FROM users")
+
+    def test_literal_division_matches_runtime(self, session):
+        out = session.execute("SELECT 5 / 2 AS lit, id / 2 AS col FROM users WHERE id = 5")
+        # both sides integer-divide (pc.divide semantics), consistently
+        session.execute("INSERT INTO users (id, name) VALUES (5, 'eve')")
+        out = session.execute("SELECT 5 / 2 AS lit, id / 2 AS col FROM users WHERE id = 5")
+        assert out.column("lit").to_pylist() == [2]
+        assert out.column("col").to_pylist() == [2]
+        with pytest.raises(SqlError, match="division by zero"):
+            session.execute("SELECT 1 / 0 FROM users")

@@ -1,0 +1,25 @@
+"""TPC-H-lite: every one of the 22 adapted query shapes executes and matches
+an independent pandas implementation (VERDICT r1 #3 'done' criterion)."""
+
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.sql.tpch import QUERIES, TpchLite
+
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    wh = tmp_path_factory.mktemp("tpch_wh")
+    t = TpchLite(LakeSoulCatalog(str(wh)), scale_rows=12_000, seed=7)
+    t.generate()
+    return t
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_query_matches_pandas(tpch, name):
+    assert tpch.verify(name)
+
+
+def test_all_queries_covered():
+    assert len(QUERIES) == 22
+    assert sorted(QUERIES) == [f"q{i:02d}" for i in range(1, 23)]
