@@ -15,6 +15,9 @@ import sys
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lakesoul_tpu.utils import honor_platform_env
+
+honor_platform_env()
 
 import numpy as np
 import pyarrow as pa

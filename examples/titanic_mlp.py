@@ -11,6 +11,9 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lakesoul_tpu.utils import honor_platform_env
+
+honor_platform_env()
 import tempfile
 
 import numpy as np

@@ -1,61 +1,100 @@
 """Ray Data adapter (parity with python/src/lakesoul/ray/read_lakesoul.py:60,80
 and write_lakesoul.py:23,99): one read task per scan unit; distributed writes
-stage files on workers and the driver commits once."""
+stage files on workers and the driver commits once.
+
+Ray contract used here (stable public API): ``ray.data.from_items(items)``
+produces rows of the form ``{"item": <obj>}``; ``map_batches(fn,
+batch_size=1, batch_format="pandas")`` hands ``fn`` a pandas DataFrame of
+those rows and accepts a pyarrow Table (of any length) as the return value;
+``take_all()`` returns rows as dicts.  tests/test_adapters.py pins this
+contract with a wire-faithful stub so the adapter stays correct without ray
+in the image.
+"""
 
 from __future__ import annotations
 
 
 def read_lakesoul(scan):
-    """LakeSoulScan → ray.data.Dataset (one block per scan unit)."""
+    """LakeSoulScan → ray.data.Dataset (one read task per scan unit)."""
     try:
         import ray
     except ImportError as e:  # pragma: no cover - ray not in the TPU image
         raise ImportError("ray is required for read_lakesoul") from e
 
     units = [
-        {"data_files": u.data_files, "primary_keys": u.primary_keys, **scan._unit_kwargs(u)}
+        {
+            "data_files": u.data_files,
+            "primary_keys": u.primary_keys,
+            **scan._unit_kwargs(u),
+        }
         for u in scan.scan_plan()
     ]
 
-    def load(unit: dict):
+    def load_batch(df):
+        # batch_size=1 → exactly one scan-unit dict per call, in the "item"
+        # column from_items creates
+        unit = dict(df["item"].iloc[0])
+        files = unit.pop("data_files")
+        pks = unit.pop("primary_keys")
         from lakesoul_tpu.io.reader import read_scan_unit
 
-        kwargs = {k: v for k, v in unit.items() if k not in ("data_files", "primary_keys")}
-        return read_scan_unit(unit["data_files"], unit["primary_keys"], **kwargs)
+        return read_scan_unit(files, pks, **unit)
 
     return ray.data.from_items(units).map_batches(
-        lambda b: load(b), batch_format="pyarrow"
+        load_batch, batch_size=1, batch_format="pandas"
     )
 
 
 def write_lakesoul(dataset, table) -> None:
-    """ray.data.Dataset → table: workers stage parquet via TableWriter, the
-    driver commits all FlushOutputs in one ACID commit (reference: Datasink
-    distributed write + driver-side single commit)."""
+    """ray.data.Dataset → table: workers stage files via TableWriter, the
+    driver commits every staged file in ONE ACID commit (reference: Datasink
+    distributed write + driver-side single commit, write_lakesoul.py:99)."""
     try:
         import ray  # noqa: F401
     except ImportError as e:  # pragma: no cover
         raise ImportError("ray is required for write_lakesoul") from e
 
+    import pandas as pd
+
     cfg = table.io_config()
     table_path = table.info.table_path
 
     def stage(batch):
+        # emit one plain-typed row per staged file: worker→driver transport
+        # must stay arrow-serializable (no dataclass objects in columns)
+        import pyarrow as pa
+
         from lakesoul_tpu.io.writer import TableWriter
 
         w = TableWriter(cfg, table_path)
-        w.write_batch(batch)
-        return {"outputs": [w.close()]}
+        w.write_batch(pa.Table.from_pandas(batch, preserve_index=False))
+        outs = w.close()
+        return pd.DataFrame(
+            {
+                "partition_desc": [o.partition_desc for o in outs],
+                "path": [o.path for o in outs],
+                "size": [o.size for o in outs],
+                "file_exist_cols": [o.file_exist_cols for o in outs],
+            }
+        )
 
     from lakesoul_tpu.meta import CommitOp, DataFileOp
 
-    staged = dataset.map_batches(stage, batch_format="pyarrow").take_all()
+    staged = dataset.map_batches(stage, batch_format="pandas").take_all()
     files_by_partition: dict[str, list[DataFileOp]] = {}
     for row in staged:
-        for out in row["outputs"]:
-            files_by_partition.setdefault(out.partition_desc, []).append(
-                DataFileOp(path=out.path, file_op="add", size=out.size,
-                           file_exist_cols=out.file_exist_cols)
+        files_by_partition.setdefault(row["partition_desc"], []).append(
+            DataFileOp(
+                path=row["path"],
+                file_op="add",
+                size=row["size"],
+                file_exist_cols=row["file_exist_cols"],
             )
+        )
     op = CommitOp.MERGE if table.info.primary_keys else CommitOp.APPEND
-    table.catalog.client.commit_data_files(table.info, files_by_partition, op)
+    table.catalog.client.commit_data_files(
+        table.info,
+        files_by_partition,
+        op,
+        storage_options=cfg.object_store_options,
+    )

@@ -25,7 +25,10 @@ OPTION_VECTOR_SEARCH_NPROBE = "vector_search_nprobe"
 
 DEFAULT_BATCH_SIZE = 8192
 DEFAULT_MAX_ROW_GROUP_SIZE = 250_000
-DEFAULT_MEMORY_BUDGET = 256 << 20  # single source for IOConfig + direct readers
+# single source for IOConfig + direct readers.  Sized for TPU-VM hosts
+# (tens of GB of host RAM): units within the budget take the fast
+# materialized decode; anything larger streams with bounded memory.
+DEFAULT_MEMORY_BUDGET = 2 << 30
 
 
 @dataclass

@@ -1,3 +1,24 @@
 from lakesoul_tpu.utils.spark_hash import HASH_SEED, hash_columns, hash_scalar, bucket_ids
 
 __all__ = ["HASH_SEED", "hash_columns", "hash_scalar", "bucket_ids"]
+
+
+def honor_platform_env() -> None:
+    """Make JAX_PLATFORMS env authoritative before backend init.
+
+    The axon boot hook (sitecustomize) pins ``jax.config.jax_platforms`` to
+    "axon,cpu", which silently overrides a caller-set ``JAX_PLATFORMS=cpu``
+    env var — and a wedged TPU tunnel then hangs backend init.  Call this
+    before the first jax array op in scripts that honor the env var."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # jax already initialized; too late to switch

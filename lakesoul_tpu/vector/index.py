@@ -113,7 +113,8 @@ class IvfRabitqIndex:
     def _make_cluster(self, vectors, ids, centroid) -> _Cluster:
         if len(vectors) == 0:
             if self._ex_bits:
-                codes0 = np.zeros((0, self.quantizer.padded_dim), np.int8)
+                dt = np.int8 if self.config.total_bits <= 8 else np.int16
+                codes0 = np.zeros((0, self.quantizer.padded_dim), dt)
             else:
                 codes0 = np.zeros((0, self.quantizer.padded_dim // 8), np.uint8)
             return _Cluster(
@@ -127,7 +128,7 @@ class IvfRabitqIndex:
             )
         if self._ex_bits:
             codes, scales, norms, factors, code_dot_c = self.quantizer.quantize_ex(
-                vectors, centroid, min(self.config.total_bits, 8)
+                vectors, centroid, self.config.total_bits
             )
         else:
             codes, norms, factors, code_dot_c = self.quantizer.quantize(vectors, centroid)
@@ -332,16 +333,26 @@ class IvfRabitqIndex:
             raise VectorIndexError("index not trained")
         query = np.asarray(query, dtype=np.float32)
         nprobe = min(params.nprobe, len(self.centroids))
-        cd = np.sum((self.centroids - query[None, :]) ** 2, axis=1)
-        probe = np.argsort(cd)[:nprobe]
 
         if (
             getattr(self, "_device_cache_enabled", False)
             and allowed_ids is None
             and rerank == self.keep_raw
-            and not self._ex_bits
         ):
-            return self._search_device_resident(query, params, probe)
+            if not self._ex_bits:
+                cd = np.sum((self.centroids - query[None, :]) ** 2, axis=1)
+                probe = np.argsort(cd)[:nprobe]
+                return self._search_device_resident(query, params, probe)
+            # ex-codes: the batched resident kernel IS the single-query path
+            # (Q=1 column) — same HBM-resident codes, one dispatch; it
+            # computes its own probe set, so none is computed here
+            out = self._batch_search_device_resident(query[None, :], params)
+            if out is not None:
+                ids_b, dists_b = out
+                return ids_b[0], dists_b[0]
+
+        cd = np.sum((self.centroids - query[None, :]) ** 2, axis=1)
+        probe = np.argsort(cd)[:nprobe]
 
         # All probed segments are concatenated into ONE fused device call.
         # Rotation is linear, so the estimator works in the *global* query

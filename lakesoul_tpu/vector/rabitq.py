@@ -152,24 +152,28 @@ class RabitqQuantizer:
         return self.rotator(np.asarray(x, dtype=np.float32))
 
     def quantize_ex(self, vectors: np.ndarray, centroid: np.ndarray, total_bits: int):
-        """Multi-bit quantization (total_bits in [2, 8]) → (codes [N, padded]
-        int8, scales [N] f32, norms [N] f32, factors [N] f32,
+        """Multi-bit quantization (total_bits in [2, 16]) → (codes [N, padded]
+        int8|int16, scales [N] f32, norms [N] f32, factors [N] f32,
         code_dot_c [N] f32).
 
         TPU-native redesign of the reference's 2-16-bit ex-codes
-        (quantizer.rs): instead of tight bit-packing + SIMD unpack, codes are
-        symmetric int8 — the MXU's native operand format — with a per-vector
-        scale.  u_hat ≈ (scale/qmax)·codes reconstructs the unit residual;
-        the estimator uses factor = <u_hat, u> exactly like the 1-bit path."""
-        if not 2 <= total_bits <= 8:
-            raise VectorIndexError(f"ex-code total_bits must be in [2, 8], got {total_bits}")
+        (quantizer.rs, config.rs:32): instead of tight bit-packing + SIMD
+        unpack, codes are symmetric integers in the narrowest MXU-friendly
+        lane — int8 through 8 bits, int16 for 9-16 — with a per-vector scale.
+        u_hat ≈ scale·codes reconstructs the unit residual; the estimator
+        uses factor = <u_hat, u> exactly like the 1-bit path."""
+        if not 2 <= total_bits <= 16:
+            raise VectorIndexError(
+                f"ex-code total_bits must be in [2, 16], got {total_bits}"
+            )
+        code_dtype = np.int8 if total_bits <= 8 else np.int16
         qmax = float(2 ** (total_bits - 1) - 1)  # symmetric levels, e.g. 127 for 8
         r = self.rotator(vectors - centroid[None, :])
         norms = np.linalg.norm(r, axis=1)
         safe = np.maximum(norms, 1e-20)
         u = r / safe[:, None]
         amax = np.maximum(np.abs(u).max(axis=1), 1e-20)
-        codes = np.clip(np.rint(u / amax[:, None] * qmax), -qmax, qmax).astype(np.int8)
+        codes = np.clip(np.rint(u / amax[:, None] * qmax), -qmax, qmax).astype(code_dtype)
         # effective scale folds qmax: u_hat = codes * scales (kernel-ready)
         scales = (amax / qmax).astype(np.float32)
         u_hat = codes.astype(np.float32) * scales[:, None]

@@ -1,0 +1,141 @@
+"""Ray/Daft adapter round-trips against wire-faithful stubs (VERDICT r1 #6).
+
+Ray is not in the TPU image, so the stub reproduces the exact public-API
+behavior the adapter depends on (documented in data/ray_adapter.py):
+``from_items`` wraps each item in an ``{"item": ...}`` row, ``map_batches``
+slices rows into ``batch_size`` pandas DataFrames and accepts pyarrow/pandas
+returns, ``take_all`` yields dict rows."""
+
+import sys
+import types
+
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+class _StubDataset:
+    def __init__(self, rows):
+        self.rows = rows  # list[dict]
+
+    def map_batches(self, fn, *, batch_size=None, batch_format="pandas"):
+        if batch_format != "pandas":
+            raise NotImplementedError("stub supports pandas batches only")
+        size = batch_size or max(1, len(self.rows))
+        out_rows = []
+        for start in range(0, len(self.rows), size):
+            chunk = self.rows[start : start + size]
+            df = pd.DataFrame(chunk)
+            result = fn(df)
+            if isinstance(result, pa.Table):
+                out_rows.extend(result.to_pylist())
+            elif isinstance(result, pd.DataFrame):
+                out_rows.extend(result.to_dict("records"))
+            else:
+                raise NotImplementedError(type(result))
+        return _StubDataset(out_rows)
+
+    def take_all(self):
+        return list(self.rows)
+
+    def to_arrow(self):
+        return pa.Table.from_pylist(self.rows)
+
+
+def _install_ray_stub(monkeypatch):
+    ray = types.ModuleType("ray")
+    ray_data = types.ModuleType("ray.data")
+    ray_data.from_items = lambda items: _StubDataset([{"item": it} for it in items])
+    ray.data = ray_data
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    monkeypatch.setitem(sys.modules, "ray.data", ray_data)
+
+
+def _install_daft_stub(monkeypatch):
+    daft = types.ModuleType("daft")
+
+    class _DF:
+        def __init__(self, table):
+            self._table = table
+
+        def to_arrow(self):
+            return self._table
+
+    daft.from_arrow = lambda table: _DF(table)
+    monkeypatch.setitem(sys.modules, "daft", daft)
+
+
+@pytest.fixture()
+def table(tmp_warehouse):
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    t = catalog.create_table("adp", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+    t.write_arrow(pa.table({"id": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]}))
+    t.upsert(pa.table({"id": [2], "v": [20.0]}))
+    return t
+
+
+class TestRayAdapter:
+    def test_read_round_trip(self, table, monkeypatch):
+        _install_ray_stub(monkeypatch)
+        from lakesoul_tpu.data.ray_adapter import read_lakesoul
+
+        ds = read_lakesoul(table.scan())
+        got = ds.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2, 3, 4]
+        assert got.column("v").to_pylist() == [1.0, 20.0, 3.0, 4.0]  # MOR applied
+
+    def test_read_respects_filter_and_projection(self, table, monkeypatch):
+        _install_ray_stub(monkeypatch)
+        from lakesoul_tpu.data.ray_adapter import read_lakesoul
+        from lakesoul_tpu.io.filters import col
+
+        ds = read_lakesoul(table.scan().filter(col("v") > 2.5).select(["id"]))
+        got = ds.to_arrow().sort_by("id")
+        assert got.column_names == ["id"]
+        assert got.column("id").to_pylist() == [2, 3, 4]
+
+    def test_write_stages_then_single_commit(self, tmp_warehouse, monkeypatch):
+        _install_ray_stub(monkeypatch)
+        import ray
+
+        from lakesoul_tpu.data.ray_adapter import write_lakesoul
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table("rw", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+        ds = _StubDataset(
+            pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0]}).to_pylist()
+        )
+        write_lakesoul(ds, t)
+        got = t.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2, 3]
+        # one commit → version 0 heads only
+        heads = catalog.client.store.get_all_latest_partition_info(t.info.table_id)
+        assert all(h.version == 0 for h in heads)
+        assert ray is sys.modules["ray"]  # the stub was what the adapter used
+
+    def test_read_and_write_compose(self, table, tmp_warehouse, monkeypatch):
+        _install_ray_stub(monkeypatch)
+        from lakesoul_tpu.data.ray_adapter import read_lakesoul, write_lakesoul
+
+        dst = table.catalog.create_table(
+            "adp_copy", SCHEMA, primary_keys=["id"], hash_bucket_num=1
+        )
+        write_lakesoul(read_lakesoul(table.scan()), dst)
+        assert dst.to_arrow().sort_by("id").equals(table.to_arrow().sort_by("id"))
+
+
+class TestDaftAdapter:
+    def test_round_trip(self, table, monkeypatch):
+        _install_daft_stub(monkeypatch)
+        from lakesoul_tpu.data.daft_adapter import read_lakesoul, write_lakesoul
+
+        df = read_lakesoul(table.scan())
+        dst = table.catalog.create_table(
+            "adp_daft", SCHEMA, primary_keys=["id"], hash_bucket_num=1
+        )
+        write_lakesoul(df, dst)
+        assert dst.to_arrow().sort_by("id").equals(table.to_arrow().sort_by("id"))

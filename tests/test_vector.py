@@ -371,6 +371,67 @@ class TestExCodes:
         np.testing.assert_array_equal(a, b)
 
 
+class TestWideExCodes:
+    """9-16-bit ex-codes (VERDICT r1 #8): int16 code plane, monotone recall,
+    manifest round-trip, and the single-query resident path."""
+
+    def _recall(self, index, vectors, n_queries=20, seed=3, nprobe=8):
+        rng = np.random.default_rng(seed)
+        recalls = []
+        for _ in range(n_queries):
+            q = rng.normal(size=vectors.shape[1]).astype(np.float32)
+            true = set(brute_force_knn(vectors, q, 10))
+            got, _ = index.search(q, SearchParams(top_k=10, nprobe=nprobe))
+            recalls.append(len(true & set(int(i) for i in got)) / 10)
+        return float(np.mean(recalls))
+
+    def test_recall_monotone_8_12_16(self):
+        rng = np.random.default_rng(6)
+        vectors = rng.normal(size=(1500, 32)).astype(np.float32)
+        ids = np.arange(1500, dtype=np.uint64)
+        rs = {}
+        for bits in (8, 12, 16):
+            idx = IvfRabitqIndex.train(
+                vectors, ids,
+                VectorIndexConfig(column="e", dim=32, nlist=12, total_bits=bits),
+                keep_raw=False,
+            )
+            assert idx.clusters[0].codes.dtype == (np.int8 if bits <= 8 else np.int16)
+            rs[bits] = self._recall(idx, vectors)
+        # wider codes must not regress (quantization error only shrinks)
+        assert rs[12] >= rs[8] - 0.02, rs
+        assert rs[16] >= rs[12] - 0.02, rs
+        assert rs[16] >= 0.8, rs
+
+    def test_wide_codes_manifest_round_trip(self, tmp_path):
+        rng = np.random.default_rng(7)
+        vectors = rng.normal(size=(300, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=12)
+        idx = IvfRabitqIndex.train(vectors, np.arange(300, dtype=np.uint64), cfg)
+        store = ManifestStore(str(tmp_path / "wide"))
+        store.write_index(idx)
+        loaded = store.read_latest()
+        assert loaded.clusters[0].codes.dtype == np.int16
+        q = vectors[11]
+        a, _ = idx.search(q, SearchParams(top_k=5, nprobe=4))
+        b, _ = loaded.search(q, SearchParams(top_k=5, nprobe=4))
+        np.testing.assert_array_equal(a, b)
+
+    def test_single_query_uses_resident_ex_path(self):
+        rng = np.random.default_rng(8)
+        vecs = rng.normal(size=(600, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=8)
+        idx = IvfRabitqIndex.train(vecs, np.arange(600, dtype=np.uint64), cfg)
+        idx.enable_device_cache()
+        ids, dists = idx.search(vecs[3], SearchParams(top_k=3, nprobe=4))
+        assert int(ids[0]) == 3
+        assert idx._device_bundle is not None  # the resident bundle was built
+        # matches the non-resident answer
+        idx2 = IvfRabitqIndex.train(vecs, np.arange(600, dtype=np.uint64), cfg)
+        ids2, _ = idx2.search(vecs[3], SearchParams(top_k=3, nprobe=4))
+        assert [int(i) for i in ids] == [int(i) for i in ids2]
+
+
 class TestExCodeGuards:
     def test_batch_search_ex_bits_uses_ex_resident_kernel(self):
         rng = np.random.default_rng(4)
