@@ -71,6 +71,18 @@ def _chunks(n_rows, start_at=0, chunk=500_000, seed=0):
         yield pa.table(cols, schema=_bench_schema())
 
 
+def _upsert_wave(t, seed: int) -> None:
+    """One MOR-provoking upsert wave: re-write UPSERT_FRAC of the keys."""
+    rng = np.random.default_rng(seed)
+    n_up = int(N_ROWS * UPSERT_FRAC)
+    upd = rng.choice(N_ROWS, n_up, replace=False).astype(np.int64)
+    cols = {"id": upd}
+    for i in range(N_FEATURES):
+        cols[f"f{i}"] = rng.normal(size=n_up).astype(np.float32)
+    cols["label"] = rng.integers(0, 2, n_up).astype(np.int32)
+    t.upsert(pa.table(cols, schema=_bench_schema()))
+
+
 def build_table(catalog):
     """Our table with TPU-first defaults (lz4) + an upsert wave → real MOR."""
     name = f"bench_{N_ROWS}"
@@ -81,14 +93,7 @@ def build_table(catalog):
     )
     for chunk in _chunks(N_ROWS):
         t.write_arrow(chunk)
-    rng = np.random.default_rng(1)
-    n_up = int(N_ROWS * UPSERT_FRAC)
-    upd = rng.choice(N_ROWS, n_up, replace=False).astype(np.int64)
-    cols = {"id": upd}
-    for i in range(N_FEATURES):
-        cols[f"f{i}"] = rng.normal(size=n_up).astype(np.float32)
-    cols["label"] = rng.integers(0, 2, n_up).astype(np.int32)
-    t.upsert(pa.table(cols, schema=_bench_schema()))
+    _upsert_wave(t, seed=1)
     return t
 
 
@@ -336,14 +341,7 @@ def main():
     # A cached table from a previous run was left compacted: re-apply an
     # upsert wave so this leg never silently measures the no-merge workload.
     if all(len(u.data_files) <= 1 for u in t.scan().scan_plan()):
-        rng = np.random.default_rng(3)
-        n_up = int(N_ROWS * UPSERT_FRAC)
-        upd = rng.choice(N_ROWS, n_up, replace=False).astype(np.int64)
-        cols = {"id": upd}
-        for i in range(N_FEATURES):
-            cols[f"f{i}"] = rng.normal(size=n_up).astype(np.float32)
-        cols["label"] = rng.integers(0, 2, n_up).astype(np.int32)
-        t.upsert(pa.table(cols, schema=_bench_schema()))
+        _upsert_wave(t, seed=3)
     mor = bench_lakesoul(t, epochs=2)
     # leg 2 (headline): steady-state delivery after compaction, the state a
     # served table sits in (the reference's stance too: read throughput

@@ -3,12 +3,14 @@ and write_lakesoul.py:23,99): one read task per scan unit; distributed writes
 stage files on workers and the driver commits once.
 
 Ray contract used here (stable public API): ``ray.data.from_items(items)``
-produces rows of the form ``{"item": <obj>}``; ``map_batches(fn,
-batch_size=1, batch_format="pandas")`` hands ``fn`` a pandas DataFrame of
-those rows and accepts a pyarrow Table (of any length) as the return value;
-``take_all()`` returns rows as dicts.  tests/test_adapters.py pins this
-contract with a wire-faithful stub so the adapter stays correct without ray
-in the image.
+treats a MAPPING item as a row (its keys become columns) and wraps any
+other item as ``{"item": <obj>}``; ``map_batches(fn, batch_size=1,
+batch_format="pandas")`` hands ``fn`` a pandas DataFrame of rows and accepts
+a pyarrow Table (of any length) as the return value; ``take_all()`` returns
+rows as dicts.  Each scan unit therefore travels as ``{"unit": <dict>}`` —
+one object column — never as a bare dict whose keys would explode into
+columns.  tests/test_adapters.py pins this contract with a wire-faithful
+stub so the adapter stays correct without ray in the image.
 """
 
 from __future__ import annotations
@@ -23,17 +25,19 @@ def read_lakesoul(scan):
 
     units = [
         {
-            "data_files": u.data_files,
-            "primary_keys": u.primary_keys,
-            **scan._unit_kwargs(u),
+            "unit": {
+                "data_files": u.data_files,
+                "primary_keys": u.primary_keys,
+                **scan._unit_kwargs(u),
+            }
         }
         for u in scan.scan_plan()
     ]
 
     def load_batch(df):
-        # batch_size=1 → exactly one scan-unit dict per call, in the "item"
-        # column from_items creates
-        unit = dict(df["item"].iloc[0])
+        # batch_size=1 → exactly one scan-unit dict per call, in the single
+        # "unit" object column built above
+        unit = dict(df["unit"].iloc[0])
         files = unit.pop("data_files")
         pks = unit.pop("primary_keys")
         from lakesoul_tpu.io.reader import read_scan_unit

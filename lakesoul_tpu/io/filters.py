@@ -2,9 +2,16 @@
 
 The reference serializes filters once as Substrait plan bytes and re-parses
 them in the native core so every engine gets identical semantics
-(rust/lakesoul-io/src/filter/parser.rs).  Here the portable encoding is a
-small JSON expression tree — same role, no Substrait dependency — compiled to
-``pyarrow.compute.Expression`` for pushdown into Parquet scans.
+(rust/lakesoul-io/src/filter/parser.rs:15-27).  Two portable encodings are
+accepted here:
+
+- the framework's small JSON expression tree (compiled to
+  ``pyarrow.compute.Expression`` for pushdown into file scans), and
+- **Substrait ExtendedExpression bytes** (``Filter.from_substrait``) — the
+  exact wire format external engines emit, deserialized via
+  ``pyarrow.substrait``.  Substrait filters are opaque (no column
+  introspection), so the reader applies them with conservative pushdown:
+  never pre-merge on PK tables, full-width file reads under projection.
 
 Also provides the OR-conjunctive PK-equality analysis used for hash-bucket
 pruning (reference: helpers/mod.rs collect_or_conjunctive_filter_expressions,
@@ -13,6 +20,7 @@ reader.rs:164-225).
 
 from __future__ import annotations
 
+import base64
 import json
 from dataclasses import dataclass
 from typing import Any
@@ -74,7 +82,28 @@ class Filter:
             return out
         if self.op == "not":
             return ~self.args[0].to_arrow()
+        if self.op == "substrait":
+            return _substrait_to_expression(self.value)
         raise ValueError(f"unknown filter op {self.op}")
+
+    # -- substrait interop ---------------------------------------------------
+    @classmethod
+    def from_substrait(cls, data: bytes) -> "Filter":
+        """Wrap Substrait ExtendedExpression bytes (the first expression is
+        the predicate).  Validated eagerly so bad bytes fail at the API
+        boundary, not mid-scan."""
+        _substrait_to_expression(data)
+        return cls(op="substrait", value=bytes(data))
+
+    def to_substrait(self, schema) -> bytes:
+        """Serialize this filter as Substrait ExtendedExpression bytes bound
+        to ``schema`` — what this framework hands an external engine (the
+        reverse of from_substrait)."""
+        import pyarrow.substrait as ps
+
+        if self.op == "substrait":
+            return self.value
+        return bytes(ps.serialize_expressions([self.to_arrow()], ["filter"], schema))
 
     # -- serde ---------------------------------------------------------------
     def to_json(self) -> str:
@@ -84,7 +113,9 @@ class Filter:
         d: dict[str, Any] = {"op": self.op}
         if self.col is not None:
             d["col"] = self.col
-        if self.value is not None or self.op == "eq":
+        if self.op == "substrait":
+            d["substrait_b64"] = base64.b64encode(self.value).decode()
+        elif self.value is not None or self.op == "eq":
             d["value"] = self.value
         if self.args:
             d["args"] = [a._to_dict() for a in self.args]
@@ -96,6 +127,8 @@ class Filter:
 
     @classmethod
     def _from_dict(cls, d: dict) -> "Filter":
+        if d["op"] == "substrait":
+            return cls(op="substrait", value=base64.b64decode(d["substrait_b64"]))
         return cls(
             op=d["op"],
             col=d.get("col"),
@@ -136,6 +169,33 @@ class col:
 
     def not_null(self):
         return Filter(op="not_null", col=self.name)
+
+
+def _substrait_to_expression(data: bytes) -> pc.Expression:
+    import pyarrow.substrait as ps
+
+    bound = ps.deserialize_expressions(bytes(data))
+    if not bound.expressions:
+        raise ValueError("substrait payload contains no expressions")
+    return next(iter(bound.expressions.values()))
+
+
+def filter_column_names(flt: "Filter | None") -> set[str] | None:
+    """Columns a filter references, or None when unknowable (substrait
+    payloads are opaque) — callers must then be conservative: no pre-merge
+    pushdown on PK tables, no projection narrowing."""
+    if flt is None:
+        return set()
+    names: set[str] = set()
+
+    def walk(f: Filter) -> bool:
+        if f.op == "substrait":
+            return False
+        if f.col:
+            names.add(f.col)
+        return all(walk(a) for a in f.args)
+
+    return names if walk(flt) else None
 
 
 def conjoin(filters: list[Filter]) -> Filter | None:

@@ -29,7 +29,7 @@ import pyarrow.dataset as pads
 logger = logging.getLogger(__name__)
 
 from lakesoul_tpu.io.config import DEFAULT_MEMORY_BUDGET
-from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.io.filters import Filter, filter_column_names
 from lakesoul_tpu.io.formats import format_for
 from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
 
@@ -68,17 +68,18 @@ def _plan_unit(
 ) -> _UnitPlan:
     arrow_filter = filter.to_arrow() if filter is not None else None
 
+    refs = filter_column_names(filter)  # None = unknowable (substrait bytes)
+
     # columns that must be read even if projected away later: PKs for the
     # merge, the CDC column for delete filtering (session.rs merged_projection),
-    # and any column the filter references
+    # and any column the filter references (ALL columns when unknowable)
     read_columns = None
-    if columns is not None:
+    if columns is not None and refs is not None:
         need = list(columns)
         extra = list(primary_keys)
         if cdc_column:
             extra.append(cdc_column)
-        if filter is not None:
-            extra.extend(_filter_column_names(filter))
+        extra.extend(refs)
         for k in extra:
             if k not in need:
                 need.append(k)
@@ -100,8 +101,13 @@ def _plan_unit(
     file_filter = None
     post_filter = arrow_filter
     if arrow_filter is not None:
-        refs = _filter_column_names(filter)
-        if refs & set(partition_values):
+        if refs is None:
+            # opaque (substrait) predicate: only safe pre-merge when there is
+            # no merge and no directory-encoded column it could reference
+            file_filter = (
+                arrow_filter if not primary_keys and not partition_values else None
+            )
+        elif refs & set(partition_values):
             file_filter = None
         elif primary_keys and not refs <= set(primary_keys):
             file_filter = None
@@ -379,14 +385,3 @@ def iter_scan_unit_batches(
         )
 
 
-def _filter_column_names(flt: Filter) -> set[str]:
-    names: set[str] = set()
-
-    def walk(f: Filter):
-        if f.col:
-            names.add(f.col)
-        for a in f.args:
-            walk(a)
-
-    walk(flt)
-    return names

@@ -8,7 +8,9 @@ actions — over pyarrow.flight instead of tonic/gRPC-rust.
 
 Tickets and descriptors are JSON:
   DoGet ticket: {"table": ..., "namespace": ..., "columns": [...],
-                 "filter": <Filter JSON>, "partitions": {...},
+                 "filter": <Filter JSON — op "substrait" carries base64
+                 Substrait ExtendedExpression bytes, the format external
+                 engines serialize predicates in>, "partitions": {...},
                  "incremental_start_ms": ..., "batch_size": ...}
   DoPut descriptor path: ["<namespace>.<table>"] with app_metadata
                  {"checkpoint_id": ...} for idempotent streaming commits.
@@ -313,6 +315,9 @@ class LakeSoulFlightClient:
             )
 
     def scan(self, table: str, **req) -> pa.Table:
+        flt = req.get("filter")
+        if isinstance(flt, Filter):
+            req["filter"] = flt._to_dict()
         ticket = flight.Ticket(json.dumps({"table": table, **req}).encode())
         return self._client.do_get(ticket, options=self._options).read_all()
 

@@ -1,3 +1,9 @@
 from lakesoul_tpu.streaming.cdc import CdcIngestor, CheckpointedWriter
+from lakesoul_tpu.streaming.db_sync import DatabaseSyncer, DebeziumJsonConsumer
 
-__all__ = ["CdcIngestor", "CheckpointedWriter"]
+__all__ = [
+    "CdcIngestor",
+    "CheckpointedWriter",
+    "DatabaseSyncer",
+    "DebeziumJsonConsumer",
+]

@@ -88,6 +88,19 @@ class CheckpointedWriter:
         )
         return len(committed)
 
+    def adopt_staged(self, other: "CheckpointedWriter | None") -> None:
+        """Take over another checkpointed writer's staged-but-uncommitted
+        files (schema-evolution handoff: the old writer is retired, this one
+        commits its files at the next checkpoint).  The donor is closed and
+        must not be written to again."""
+        if other is None or other._writer is None:
+            return
+        donor = other._writer
+        donor.flush()
+        self._ensure_writer()._staged.extend(donor.take_staged())
+        donor._closed = True
+        other._writer = None
+
     def abort(self) -> None:
         if self._writer is not None:
             self._writer.abort()

@@ -19,7 +19,7 @@ connections really do contend through the storage engine:
 
 from __future__ import annotations
 
-import re
+
 import sqlite3
 import tempfile
 import threading
@@ -72,9 +72,6 @@ def reset(dsn: str | None = None) -> None:
                         os.remove(path + suffix)
                     except OSError:
                         pass
-
-
-_PG_ONLY_TYPES = re.compile(r"\bBYTEA\b|\bBIGINT\b", re.IGNORECASE)
 
 
 class Cursor:

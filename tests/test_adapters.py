@@ -47,9 +47,15 @@ class _StubDataset:
 
 
 def _install_ray_stub(monkeypatch):
+    from collections.abc import Mapping
+
     ray = types.ModuleType("ray")
     ray_data = types.ModuleType("ray.data")
-    ray_data.from_items = lambda items: _StubDataset([{"item": it} for it in items])
+    # faithful from_items: a Mapping item IS a row (keys become columns);
+    # anything else wraps as {"item": obj} — ray.data's documented behavior
+    ray_data.from_items = lambda items: _StubDataset(
+        [dict(it) if isinstance(it, Mapping) else {"item": it} for it in items]
+    )
     ray.data = ray_data
     monkeypatch.setitem(sys.modules, "ray", ray)
     monkeypatch.setitem(sys.modules, "ray.data", ray_data)

@@ -1,0 +1,111 @@
+"""Whole-DB ingest entry (VERDICT r1 missing #7): snapshot sync from a
+DB-API source and Debezium-format CDC consumption with auto DDL."""
+
+import sqlite3
+
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.errors import ConfigError
+from lakesoul_tpu.streaming.db_sync import DatabaseSyncer, DebeziumJsonConsumer
+
+
+@pytest.fixture()
+def catalog(tmp_warehouse):
+    return LakeSoulCatalog(str(tmp_warehouse))
+
+
+@pytest.fixture()
+def source():
+    conn = sqlite3.connect(":memory:")
+    conn.executescript(
+        """
+        CREATE TABLE users (uid INTEGER PRIMARY KEY, name TEXT, score REAL);
+        CREATE TABLE events (ts BIGINT, kind TEXT);
+        INSERT INTO users VALUES (1, 'a', 0.5), (2, 'b', 1.5), (3, 'c', 2.5);
+        INSERT INTO events VALUES (100, 'x'), (200, 'y');
+        """
+    )
+    return conn
+
+
+class TestDatabaseSyncer:
+    def test_whole_db_snapshot(self, catalog, source):
+        out = DatabaseSyncer(catalog).sync(source)
+        assert out == {"users": 3, "events": 2}
+        users = catalog.table("users")
+        assert users.primary_keys == ["uid"]
+        got = users.to_arrow().sort_by("uid")
+        assert got.column("name").to_pylist() == ["a", "b", "c"]
+        assert got.schema.field("score").type == pa.float64()
+        assert catalog.table("events").to_arrow().num_rows == 2
+
+    def test_resync_converges_on_pk_tables(self, catalog, source):
+        s = DatabaseSyncer(catalog)
+        s.sync(source, tables=["users"])
+        source.execute("UPDATE users SET score = 9.9 WHERE uid = 2")
+        source.execute("INSERT INTO users VALUES (4, 'd', 4.0)")
+        s.sync(source, tables=["users"])
+        got = catalog.table("users").to_arrow().sort_by("uid")
+        assert got.num_rows == 4  # upsert, not duplication
+        assert got.column("score").to_pylist()[1] == 9.9
+
+
+def _ev(table, op, row, before=None):
+    return {
+        "payload": {
+            "op": op,
+            "after": row if op != "d" else None,
+            "before": before if before is not None else (row if op == "d" else None),
+            "source": {"table": table},
+        }
+    }
+
+
+class TestDebeziumConsumer:
+    def test_multi_table_stream_with_auto_create(self, catalog):
+        c = DebeziumJsonConsumer(
+            catalog, primary_keys={"users": ["uid"], "orders": ["oid"]}
+        )
+        c.consume_many(
+            [
+                _ev("users", "c", {"uid": 1, "name": "a"}),
+                _ev("orders", "c", {"oid": 10, "total": 5.0}),
+                _ev("users", "u", {"uid": 1, "name": "A"}),
+                _ev("users", "c", {"uid": 2, "name": "b"}),
+                _ev("orders", "d", {"oid": 10, "total": 5.0}),
+            ]
+        )
+        assert c.checkpoint(1) >= 2
+        users = catalog.table("users").to_arrow().sort_by("uid")
+        assert users.column("name").to_pylist() == ["A", "b"]
+        assert catalog.table("orders").to_arrow().num_rows == 0  # deleted
+
+    def test_checkpoint_replay_is_noop(self, catalog):
+        c = DebeziumJsonConsumer(catalog, primary_keys={"t": ["id"]})
+        c.consume(_ev("t", "c", {"id": 1, "v": 1.0}))
+        assert c.checkpoint(7) == 1
+        c.consume(_ev("t", "c", {"id": 1, "v": 1.0}))
+        assert c.checkpoint(7) == 0  # same epoch replays idempotently
+        assert catalog.table("t").to_arrow().num_rows == 1
+
+    def test_auto_schema_evolution(self, catalog):
+        c = DebeziumJsonConsumer(catalog, primary_keys={"t": ["id"]})
+        c.consume(_ev("t", "c", {"id": 1, "v": 1.0}))
+        # mid-stream DDL on the source: a new column appears
+        c.consume(_ev("t", "c", {"id": 2, "v": 2.0, "extra": "new"}))
+        c.checkpoint(1)
+        got = catalog.table("t").to_arrow().sort_by("id")
+        assert got.column("extra").to_pylist() == [None, "new"]
+
+    def test_unknown_table_without_pks_rejected(self, catalog):
+        c = DebeziumJsonConsumer(catalog)
+        with pytest.raises(ConfigError, match="primary"):
+            c.consume(_ev("mystery", "c", {"id": 1}))
+
+    def test_flattened_event_form(self, catalog):
+        c = DebeziumJsonConsumer(catalog, primary_keys={"t": ["id"]})
+        c.consume({"op": "c", "after": {"id": 1, "v": 2.0}, "source": {"table": "t"}})
+        c.checkpoint(1)
+        assert catalog.table("t").to_arrow().column("v").to_pylist() == [2.0]

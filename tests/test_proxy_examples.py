@@ -104,3 +104,74 @@ class TestExamples:
         )
         assert out.returncode == 0, out.stderr[-2000:]
         assert "steps, loss" in out.stdout
+
+
+class TestProxyRangeRequests:
+    """VERDICT r1 weak #7: streamed bodies + HTTP Range support so parquet
+    readers can pull footers/column chunks through the proxy."""
+
+    def _put_blob(self, proxy, token, data):
+        url = f"http://127.0.0.1:{proxy.port}/default/t/blob.bin"
+        _request(url, method="PUT", token=token, data=data)
+        return url
+
+    def test_range_modes(self, proxy_env):
+        catalog, proxy, token, t = proxy_env
+        data = bytes(range(256)) * 40  # 10240 bytes
+        url = self._put_blob(proxy, token, data)
+
+        def get_range(hdr):
+            req = urllib.request.Request(url)
+            req.add_header("Authorization", f"Bearer {token}")
+            req.add_header("Range", hdr)
+            return urllib.request.urlopen(req, timeout=5)
+
+        r = get_range("bytes=100-199")
+        assert r.status == 206
+        assert r.headers["Content-Range"] == f"bytes 100-199/{len(data)}"
+        assert r.read() == data[100:200]
+
+        r = get_range("bytes=10000-")  # open-ended
+        assert r.read() == data[10000:]
+
+        r = get_range("bytes=-16")  # suffix (parquet footer read pattern)
+        assert r.read() == data[-16:]
+
+    def test_unsatisfiable_range_416(self, proxy_env):
+        catalog, proxy, token, t = proxy_env
+        url = self._put_blob(proxy, token, b"tiny")
+        req = urllib.request.Request(url)
+        req.add_header("Authorization", f"Bearer {token}")
+        req.add_header("Range", "bytes=100-200")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 416
+        assert e.value.headers["Content-Range"] == "bytes */4"
+
+    def test_head_advertises_ranges(self, proxy_env):
+        catalog, proxy, token, t = proxy_env
+        url = self._put_blob(proxy, token, b"abcdef")
+        resp = _request(url, method="HEAD", token=token)
+        assert resp.headers["Accept-Ranges"] == "bytes"
+        assert resp.headers["Content-Length"] == "6"
+
+    def test_large_body_streams_round_trip(self, proxy_env):
+        catalog, proxy, token, t = proxy_env
+        data = b"x" * (3 << 20) + b"END"  # spans multiple CHUNKs both ways
+        url = self._put_blob(proxy, token, data)
+        got = _request(url, token=token).read()
+        assert got == data
+
+
+class TestParseRange:
+    def test_parse_cases(self):
+        from lakesoul_tpu.service.storage_proxy import parse_range
+
+        assert parse_range(None, 100) is None
+        assert parse_range("bytes=0-49", 100) == (0, 50)
+        assert parse_range("bytes=50-", 100) == (50, 100)
+        assert parse_range("bytes=-10", 100) == (90, 100)
+        assert parse_range("bytes=90-150", 100) == (90, 100)  # clamped tail
+        for bad in ("bytes=100-", "bytes=5-2", "bytes=-0", "items=0-1", "bytes=0-1,5-6"):
+            with pytest.raises(ValueError):
+                parse_range(bad, 100)

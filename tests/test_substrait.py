@@ -1,0 +1,90 @@
+"""Substrait filter interop (VERDICT r1 missing #6): the scan path accepts
+Substrait ExtendedExpression bytes — the wire format external engines emit —
+with conservative pushdown (reference: filter/parser.rs:15-27)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.substrait as ps
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.io.filters import Filter, col, filter_column_names
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("s", pa.string())])
+
+
+@pytest.fixture()
+def table(tmp_warehouse):
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    t = catalog.create_table("sub", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+    t.write_arrow(
+        pa.table(
+            {
+                "id": np.arange(10, dtype=np.int64),
+                "v": np.arange(10, dtype=np.float64),
+                "s": [f"r{i}" for i in range(10)],
+            }
+        )
+    )
+    # upsert flips v for id=3 from 3.0 → 30.0 (the stale 3.0 must never leak)
+    t.upsert(pa.table({"id": [3], "v": [30.0], "s": ["new"]}))
+    return t
+
+
+class TestSubstraitRoundTrip:
+    def test_own_filter_through_substrait_bytes(self, table):
+        flt = col("v") >= 5.0
+        data = flt.to_substrait(table.schema)
+        direct = table.scan().filter(flt).to_arrow().sort_by("id")
+        via = table.scan().filter(Filter.from_substrait(data)).to_arrow().sort_by("id")
+        assert direct.equals(via)
+        assert via.column("id").to_pylist() == [3, 5, 6, 7, 8, 9]
+
+    def test_external_engine_serialized_expression(self, table):
+        # an external engine serializes its own predicate with pyarrow — no
+        # framework code involved in producing the bytes
+        expr = (pads.field("v") > 2.0) & (pads.field("v") < 8.0)
+        data = bytes(ps.serialize_expressions([expr], ["f"], table.schema))
+        got = table.scan().filter(Filter.from_substrait(data)).to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [4, 5, 6, 7]  # 3 has v=30 now
+
+    def test_no_stale_version_resurrection(self, table):
+        # predicate matches the OLD version of id=3 (v == 3.0); an unsafe
+        # pre-merge pushdown would resurrect the overwritten row
+        expr = pads.field("v") == 3.0
+        data = bytes(ps.serialize_expressions([expr], ["f"], table.schema))
+        got = table.scan().filter(Filter.from_substrait(data)).to_arrow()
+        assert got.num_rows == 0
+
+    def test_json_serde_carries_substrait(self, table):
+        data = (col("v") >= 5.0).to_substrait(table.schema)
+        f = Filter.from_substrait(data)
+        round_tripped = Filter.from_json(f.to_json())
+        a = table.scan().filter(f).to_arrow().sort_by("id")
+        b = table.scan().filter(round_tripped).to_arrow().sort_by("id")
+        assert a.equals(b)
+
+    def test_bad_bytes_rejected_eagerly(self):
+        with pytest.raises(Exception):
+            Filter.from_substrait(b"not substrait")
+
+    def test_column_names_unknowable(self):
+        f = Filter(op="substrait", value=b"...")
+        assert filter_column_names(f) is None
+        assert filter_column_names(col("x") == 1) == {"x"}
+        assert filter_column_names((col("x") == 1) & f) is None
+
+
+class TestSubstraitOverFlight:
+    def test_ticket_with_substrait_filter(self, table):
+        from lakesoul_tpu.service.flight import LakeSoulFlightClient, LakeSoulFlightServer
+
+        data = (col("v") >= 5.0).to_substrait(table.schema)
+        server = LakeSoulFlightServer(table.catalog, "grpc://127.0.0.1:0")
+        try:
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            got = client.scan("sub", filter=Filter.from_substrait(data)).sort_by("id")
+            assert got.column("id").to_pylist() == [3, 5, 6, 7, 8, 9]
+        finally:
+            server.shutdown()
