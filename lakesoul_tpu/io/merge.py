@@ -233,31 +233,68 @@ def merge_sorted_tables(
 
 
 def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
-    """C++ loser-tree merge (native/src/lakesoul_native.cc ls_merge_i64) when
-    the key column is int64, null-free, and each input run is sorted.
-    Returns None when preconditions don't hold (caller falls back)."""
+    """C++ loser-tree merge (native/src/lakesoul_native.cc ls_merge_i64 /
+    ls_merge_bytes) when the key column is a null-free int64 or
+    string/binary and each input run is sorted.  Returns None when
+    preconditions don't hold (caller falls back to the argsort path)."""
     from lakesoul_tpu import native
 
     if not native.available():
         return None
     col = big.column(pk)
-    # strictly signed int64: uint64 would reinterpret, and INT64_MAX is the
-    # C++ merge's run-exhausted sentinel
-    if not (pa.types.is_signed_integer(col.type) and col.type.bit_width == 64):
-        return None
     if col.null_count:
-        return None
-    keys = np.asarray(col).astype(np.int64, copy=False)
-    if len(keys) and keys.max() == np.iinfo(np.int64).max:
         return None
     lengths = np.array([len(t) for t in uniformed], dtype=np.int64)
     run_offsets = np.concatenate([[0], np.cumsum(lengths)])
-    for a, b in zip(run_offsets[:-1], run_offsets[1:]):
-        if b - a > 1 and not np.all(keys[a + 1 : b] >= keys[a : b - 1]):
-            return None  # run not sorted; vectorized path handles it
-    order, tail, _groups = native.merge_sorted_runs_i64(keys, run_offsets)
-    last_idx = order[tail]
-    return big.take(pa.array(last_idx))
+
+    t = col.type
+    if pa.types.is_signed_integer(t) and t.bit_width == 64:
+        keys = np.asarray(col).astype(np.int64, copy=False)
+        # INT64_MAX is the C++ merge's run-exhausted sentinel
+        if len(keys) and keys.max() == np.iinfo(np.int64).max:
+            return None
+        for a, b in zip(run_offsets[:-1], run_offsets[1:]):
+            if b - a > 1 and not np.all(keys[a + 1 : b] >= keys[a : b - 1]):
+                return None  # run not sorted; vectorized path handles it
+        order, tail, _groups = native.merge_sorted_runs_i64(keys, run_offsets)
+        return big.take(pa.array(order[tail]))
+
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        chunk = col.combine_chunks()
+        if isinstance(chunk, pa.ChunkedArray):
+            if chunk.num_chunks != 1:
+                return None
+            chunk = chunk.chunk(0)
+        for a, b in zip(run_offsets[:-1], run_offsets[1:]):
+            if b - a > 1:
+                lo = chunk.slice(a, b - a - 1)
+                hi = chunk.slice(a + 1, b - a - 1)
+                ok = pc.min(pc.greater_equal(hi, lo)).as_py()
+                if not ok:
+                    return None
+        data, offsets = _arrow_bytes_layout(chunk)
+        if data is None:
+            return None
+        order, tail, _groups = native.merge_sorted_runs_bytes(data, offsets, run_offsets)
+        return big.take(pa.array(order[tail]))
+
+    return None
+
+
+def _arrow_bytes_layout(chunk: pa.Array):
+    """(data uint8, offsets int64) view of a string/binary array, or
+    (None, None) when the buffers aren't directly addressable."""
+    bufs = chunk.buffers()
+    if len(bufs) < 3 or bufs[1] is None or bufs[2] is None:
+        return None, None
+    n = len(chunk)
+    width = 8 if pa.types.is_large_string(chunk.type) or pa.types.is_large_binary(chunk.type) else 4
+    dtype = np.int64 if width == 8 else np.int32
+    offsets = np.frombuffer(
+        bufs[1], dtype=dtype, count=n + 1, offset=chunk.offset * width
+    ).astype(np.int64, copy=False)
+    data = np.frombuffer(bufs[2], dtype=np.uint8)
+    return data, offsets
 
 
 def apply_cdc_filter(table: pa.Table, cdc_column: str) -> pa.Table:

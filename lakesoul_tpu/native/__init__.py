@@ -51,6 +51,8 @@ def _bind(lib) -> None:
     lib.ls_bucket_ids.argtypes = [u32p, i64p, ctypes.c_int64, ctypes.c_uint32]
     lib.ls_merge_i64.argtypes = [i64p, i64p, ctypes.c_int32, i64p, u8p]
     lib.ls_merge_i64.restype = ctypes.c_int64
+    lib.ls_merge_bytes.argtypes = [u8p, i64p, i64p, ctypes.c_int32, i64p, u8p]
+    lib.ls_merge_bytes.restype = ctypes.c_int64
     lib.ls_pack_bits.argtypes = [u8p, u8p, ctypes.c_int64, ctypes.c_int64]
 
 
@@ -150,6 +152,24 @@ def merge_sorted_runs_i64(keys: np.ndarray, run_offsets: np.ndarray):
     tail = np.empty(n, dtype=np.uint8)
     groups = lib.ls_merge_i64(
         _ptr(np.ascontiguousarray(keys, np.int64), ctypes.c_int64),
+        _ptr(np.ascontiguousarray(run_offsets, np.int64), ctypes.c_int64),
+        len(run_offsets) - 1,
+        _ptr(order, ctypes.c_int64),
+        _ptr(tail, ctypes.c_uint8),
+    )
+    return order, tail.astype(bool), int(groups)
+
+
+def merge_sorted_runs_bytes(data: np.ndarray, offsets: np.ndarray, run_offsets: np.ndarray):
+    """Loser-tree merge of k sorted byte-string runs (Arrow string layout:
+    uint8 data + int64 offsets[n+1]) → (order, group_tail, n_groups)."""
+    lib = get_lib()
+    n = int(run_offsets[-1])
+    order = np.empty(n, dtype=np.int64)
+    tail = np.empty(n, dtype=np.uint8)
+    groups = lib.ls_merge_bytes(
+        _ptr(np.ascontiguousarray(data, np.uint8), ctypes.c_uint8),
+        _ptr(np.ascontiguousarray(offsets, np.int64), ctypes.c_int64),
         _ptr(np.ascontiguousarray(run_offsets, np.int64), ctypes.c_int64),
         len(run_offsets) - 1,
         _ptr(order, ctypes.c_int64),

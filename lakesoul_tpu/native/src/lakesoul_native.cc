@@ -184,6 +184,97 @@ int64_t ls_merge_i64(const int64_t* keys, const int64_t* run_offsets,
   return groups;
 }
 
+// Merge k sorted byte-string runs (Arrow layout: contiguous `data` + int64
+// `offsets[n+1]`, runs spanning [run_offsets[r], run_offsets[r+1]) rows).
+// Same contract as ls_merge_i64: ascending lexicographic order, ties broken
+// by run index (later run last), outputs merged take-order + group tails.
+// Covers string/binary primary keys — and, via caller-side memcomparable
+// encoding, composite keys (reference: v2 loser tree merges any key shape).
+int64_t ls_merge_bytes(const uint8_t* data, const int64_t* offsets,
+                       const int64_t* run_offsets, int32_t num_runs,
+                       int64_t* order, uint8_t* group_tail) {
+  const int64_t n = run_offsets[num_runs];
+  if (n == 0) return 0;
+  int32_t k2 = 1;
+  while (k2 < num_runs) k2 <<= 1;
+
+  std::vector<int64_t> pos(num_runs);
+  for (int32_t r = 0; r < num_runs; r++) pos[r] = run_offsets[r];
+
+  auto exhausted = [&](int32_t r) -> bool {
+    return r >= num_runs || pos[r] >= run_offsets[r + 1];
+  };
+  auto head_ptr = [&](int32_t r) -> const uint8_t* {
+    return data + offsets[pos[r]];
+  };
+  auto head_len = [&](int32_t r) -> int64_t {
+    return offsets[pos[r] + 1] - offsets[pos[r]];
+  };
+  auto bytes_less = [](const uint8_t* a, int64_t la, const uint8_t* b,
+                       int64_t lb) -> int {
+    const int64_t m = la < lb ? la : lb;
+    int c = m ? std::memcmp(a, b, (size_t)m) : 0;
+    if (c != 0) return c;
+    return la < lb ? -1 : (la > lb ? 1 : 0);
+  };
+  // true when run a's head should be emitted before run b's head
+  // (exhausted = +infinity key)
+  auto run_before = [&](int32_t a, int32_t b) -> bool {
+    const bool ea = exhausted(a), eb = exhausted(b);
+    if (ea && eb) return a < b;
+    if (ea) return false;
+    if (eb) return true;
+    const int c = bytes_less(head_ptr(a), head_len(a), head_ptr(b), head_len(b));
+    if (c != 0) return c < 0;
+    return a < b;  // tie → older run first (stable)
+  };
+
+  std::vector<int32_t> tree(2 * k2, -1);
+  std::vector<int32_t> winner(2 * k2, -1);
+  for (int32_t i = 0; i < k2; i++) winner[k2 + i] = i;
+  for (int32_t node = k2 - 1; node >= 1; node--) {
+    int32_t a = winner[2 * node], b = winner[2 * node + 1];
+    int32_t w2, l2;
+    // a,b < k2 but possibly >= num_runs (virtual exhausted runs)
+    if (run_before(a, b)) { w2 = a; l2 = b; } else { w2 = b; l2 = a; }
+    winner[node] = w2;
+    tree[node] = l2;
+  }
+  int32_t w = winner[1];
+
+  int64_t out_i = 0;
+  const uint8_t* prev_p = nullptr;
+  int64_t prev_l = 0;
+  int64_t groups = 0;
+  while (!exhausted(w)) {
+    const uint8_t* p = head_ptr(w);
+    const int64_t l = head_len(w);
+    const bool new_group =
+        prev_p == nullptr || bytes_less(p, l, prev_p, prev_l) != 0;
+    if (new_group) {
+      if (out_i > 0) group_tail[out_i - 1] = 1;
+      groups++;
+    }
+    prev_p = p;
+    prev_l = l;
+    order[out_i] = pos[w];
+    group_tail[out_i] = 0;
+    out_i++;
+    pos[w]++;
+    int32_t node = (k2 + w) >> 1;
+    while (node >= 1) {
+      int32_t l2 = tree[node];
+      if (run_before(l2, w)) {
+        tree[node] = w;
+        w = l2;
+      }
+      node >>= 1;
+    }
+  }
+  if (out_i > 0) group_tail[out_i - 1] = 1;
+  return groups;
+}
+
 // --------------------------------------------------------------- bit pack
 // bits [n, d] {0,1} bytes → packed [n, ceil(d/8)] MSB-first (np.packbits).
 void ls_pack_bits(const uint8_t* bits, uint8_t* out, int64_t n, int64_t d) {

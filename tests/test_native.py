@@ -168,3 +168,79 @@ class TestNativeWiring:
     def test_pack_bits_nonbinary_input_matches_numpy(self):
         arr = np.array([[2, 0, 1, 0, 7, 0, 0, 0]], dtype=np.uint8)
         np.testing.assert_array_equal(native.pack_bits(arr), np.packbits(arr, axis=-1))
+
+
+class TestNativeBytesMerge:
+    """String/binary PK loser tree (r2: the fast path no longer covers only
+    int64 keys — reference v2 merges any key shape)."""
+
+    def test_bytes_merge_matches_sorted(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from lakesoul_tpu import native
+        from lakesoul_tpu.io.merge import _arrow_bytes_layout
+
+        if not native.available():
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(0)
+        runs = []
+        for _ in range(5):
+            n = int(rng.integers(1, 50))
+            vals = sorted(
+                "".join(rng.choice(list("abcdef"), rng.integers(0, 6)))
+                for _ in range(n)
+            )
+            runs.append(pa.array(vals, type=pa.string()))
+        big = pa.concat_arrays(runs)
+        data, offsets = _arrow_bytes_layout(big)
+        run_offsets = np.concatenate([[0], np.cumsum([len(r) for r in runs])]).astype(np.int64)
+        order, tail, groups = native.merge_sorted_runs_bytes(data, offsets, run_offsets)
+        merged = [big[int(i)].as_py() for i in order]
+        assert merged == sorted(big.to_pylist())
+        assert groups == len(set(big.to_pylist()))
+        # ties resolve to the LAST (newest) run's row
+        last = order[tail]
+        seen = {}
+        starts = run_offsets
+        for idx in last:
+            run_id = int(np.searchsorted(starts, idx, side="right") - 1)
+            key = big[int(idx)].as_py()
+            for r in range(run_id + 1, len(runs)):
+                assert key not in set(runs[r].to_pylist()), (
+                    f"{key!r} surviving from run {run_id} but newer run {r} has it"
+                )
+
+    def test_string_pk_fast_path_equals_fallback(self, monkeypatch):
+        import numpy as np
+        import pyarrow as pa
+
+        from lakesoul_tpu.io.merge import merge_sorted_tables
+
+        rng = np.random.default_rng(1)
+        tables = []
+        for w in range(3):
+            n = 200
+            keys = sorted(f"k{int(x):04d}" for x in rng.integers(0, 300, n))
+            tables.append(pa.table({"k": keys, "v": rng.normal(size=n)}))
+        fast = merge_sorted_tables(tables, ["k"])
+        monkeypatch.setenv("LAKESOUL_TPU_DISABLE_NATIVE", "1")
+        slow = merge_sorted_tables(tables, ["k"])
+        assert fast.equals(slow)
+
+    def test_string_pk_through_table_api(self, tmp_warehouse):
+        import numpy as np
+        import pyarrow as pa
+
+        from lakesoul_tpu import LakeSoulCatalog
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("name", pa.string()), ("v", pa.float64())])
+        t = catalog.create_table("strpk", schema, primary_keys=["name"], hash_bucket_num=2)
+        t.write_arrow(pa.table({"name": [f"u{i}" for i in range(100)],
+                                "v": np.arange(100, dtype=np.float64)}))
+        t.upsert(pa.table({"name": ["u3", "u42"], "v": [300.0, 420.0]}))
+        got = t.to_arrow().sort_by("name")
+        assert got.num_rows == 100
+        vals = dict(zip(got.column("name").to_pylist(), got.column("v").to_pylist()))
+        assert vals["u3"] == 300.0 and vals["u42"] == 420.0 and vals["u50"] == 50.0
