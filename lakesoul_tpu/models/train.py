@@ -43,11 +43,30 @@ def make_bert_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, 
     return params, opt_state, tx, shardings
 
 
-def make_bert_train_step(cfg: BertConfig, plan: MeshPlan, tx, param_shardings):
+def make_bert_train_step(
+    cfg: BertConfig, plan: MeshPlan, tx, param_shardings, *,
+    sequence_parallel: str = "ring",
+):
     """Jitted MLM train step: (params, opt_state, input_ids, labels, mask) →
-    (params, opt_state, loss).  Batch arrives sharded P('dp', 'sp')."""
-    use_ring = plan.sp > 1
-    attention_fn = make_ring_attention(plan.mesh) if use_ring else None
+    (params, opt_state, loss).  Batch arrives sharded P('dp', 'sp').
+
+    ``sequence_parallel`` picks the long-context strategy when sp > 1:
+    "ring" (K/V rotation, O(T/sp) memory, extreme sequence lengths) or
+    "ulysses" (two all-to-alls + one fused full attention, better MXU
+    utilization when heads % sp == 0) — see parallel/ulysses.py for the
+    trade-off."""
+    attention_fn = None
+    if plan.sp > 1:
+        if sequence_parallel == "ring":
+            attention_fn = make_ring_attention(plan.mesh)
+        elif sequence_parallel == "ulysses":
+            from lakesoul_tpu.parallel.ulysses import make_ulysses_attention
+
+            attention_fn = make_ulysses_attention(plan.mesh)
+        else:
+            raise ValueError(
+                f"unknown sequence_parallel {sequence_parallel!r} (ring|ulysses)"
+            )
     batch_sharding = NamedSharding(plan.mesh, P("dp", "sp"))
     loss_fn = functools.partial(bert_mlm_loss, cfg=cfg, attention_fn=attention_fn)
 

@@ -1,3 +1,12 @@
 from lakesoul_tpu.parallel.mesh import MeshPlan, make_mesh
+from lakesoul_tpu.parallel.ring_attention import make_ring_attention, ring_attention
+from lakesoul_tpu.parallel.ulysses import make_ulysses_attention, ulysses_attention
 
-__all__ = ["MeshPlan", "make_mesh"]
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "make_ring_attention",
+    "ring_attention",
+    "make_ulysses_attention",
+    "ulysses_attention",
+]

@@ -263,6 +263,16 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             return [flight.Result(json.dumps({"compacted": n}).encode())]
         if action.type == "metrics":
             return [flight.Result(json.dumps(self.metrics.snapshot()).encode())]
+        if action.type == "data_assets":
+            # per-table asset statistics as Arrow IPC (reference: the
+            # data-assets stats job, entry/assets/CountDataAssets.java)
+            from lakesoul_tpu.service.assets import count_data_assets
+
+            report = count_data_assets(self.catalog).to_arrow()
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, report.schema) as w:
+                w.write_table(report)
+            return [flight.Result(sink.getvalue().to_pybytes())]
         if action.type == "metrics_prometheus":
             return [flight.Result(self.metrics.prometheus_text().encode())]
         if action.type == "sql":
@@ -300,6 +310,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             ("metrics", "server stream metrics snapshot"),
             ("sql", "execute a SQL statement; body: {statement, namespace?}"),
             ("metrics_prometheus", "metrics in Prometheus exposition format"),
+            ("data_assets", "per-table asset statistics as Arrow IPC"),
         ]
 
 

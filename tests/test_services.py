@@ -337,3 +337,42 @@ class TestPrometheusMetrics:
         th.join(timeout=5)
         assert 1 not in seen  # pre-start commit never leaked
         assert 2 in seen
+
+
+class TestDataAssets:
+    def test_counts_match_metadata(self, catalog):
+        from lakesoul_tpu.service.assets import count_data_assets
+
+        t = catalog.create_table("as1", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+        t.write_arrow(pa.table({"id": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]}))
+        t.upsert(pa.table({"id": [2], "v": [20.0]}))
+        catalog.create_table("as2", SCHEMA)
+
+        report = count_data_assets(catalog)
+        by_name = {r.table_name: r for r in report.tables}
+        a = by_name["as1"]
+        assert a.partitions == 1
+        assert a.total_commits == 2  # initial write + upsert
+        live = [f for u in t.scan().scan_plan() for f in u.data_files]
+        assert a.live_files == len(live)
+        assert a.live_bytes > 0
+        assert by_name["as2"].live_files == 0
+
+        ns = report.by_namespace()
+        row = {c: ns.column(c)[0].as_py() for c in ns.column_names}
+        assert row["tables"] == 2 and row["live_files"] == a.live_files
+
+    def test_assets_over_flight(self, catalog):
+        from lakesoul_tpu.service.flight import LakeSoulFlightClient, LakeSoulFlightServer
+
+        t = catalog.create_table("as3", SCHEMA)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0")
+        try:
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            raw = client.action("data_assets")[0]
+            report = pa.ipc.open_stream(raw).read_all()
+            names = report.column("table_name").to_pylist()
+            assert "as3" in names
+        finally:
+            server.shutdown()
