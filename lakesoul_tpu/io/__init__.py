@@ -1,6 +1,10 @@
 from lakesoul_tpu.io.config import IOConfig
+from lakesoul_tpu.io.filters import Filter, col
+from lakesoul_tpu.io.formats import PhysicalFormat, format_by_name, format_for, register_format
+from lakesoul_tpu.io.page_cache import DiskPageCache
+from lakesoul_tpu.io.reader import iter_scan_unit_batches, read_scan_unit
+from lakesoul_tpu.io.streaming_merge import iter_merged_windows
 from lakesoul_tpu.io.writer import FlushOutput, TableWriter
-from lakesoul_tpu.io.reader import read_scan_unit, iter_scan_unit_batches
 
 __all__ = [
     "IOConfig",
@@ -8,4 +12,12 @@ __all__ = [
     "FlushOutput",
     "read_scan_unit",
     "iter_scan_unit_batches",
+    "iter_merged_windows",
+    "Filter",
+    "col",
+    "PhysicalFormat",
+    "format_for",
+    "format_by_name",
+    "register_format",
+    "DiskPageCache",
 ]

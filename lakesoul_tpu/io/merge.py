@@ -137,10 +137,18 @@ def merge_sorted_tables(
     if not primary_keys:
         return big
 
-    # fast path: single non-null int64 PK over already-sorted runs (the
-    # writer sorts every PK cell) → native loser-tree merge, no argsort
-    if len(primary_keys) == 1 and not merge_operators:
-        fast = _native_merge_fast_path(big, uniformed, primary_keys[0])
+    # fast path: null-free PKs over already-sorted runs (the writer sorts
+    # every PK cell) → native loser-tree merge, no argsort.  Single int64 or
+    # string keys merge directly; composite fixed-width keys merge through a
+    # memcomparable byte encoding.
+    if not merge_operators:
+        fast = None
+        if len(primary_keys) == 1:
+            fast = _native_merge_fast_path(big, uniformed, primary_keys[0])
+        if fast is None:
+            # covers composite keys AND single fixed-width keys the direct
+            # helper declines (int32/float/date/... → memcomparable bytes)
+            fast = _native_merge_composite_fast_path(big, uniformed, primary_keys)
         if fast is not None:
             return fast
 
@@ -279,6 +287,104 @@ def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
         return big.take(pa.array(order[tail]))
 
     return None
+
+
+def _native_merge_composite_fast_path(
+    big: pa.Table, uniformed: list[pa.Table], pks: list[str]
+):
+    """Composite PKs through the byte loser tree: encode each key tuple as a
+    fixed-width MEMCOMPARABLE byte string (big-endian, sign-bit flipped for
+    signed ints, IEEE-754 order-flip for floats) so bytewise lexicographic
+    order equals tuple order, then run ls_merge_bytes.  Covers fixed-width
+    key columns (ints/floats/dates/timestamps/bools); anything else falls
+    back to the argsort path."""
+    from lakesoul_tpu import native
+
+    if not native.available():
+        return None
+    n = len(big)
+    if n == 0:
+        return None
+    parts = []
+    for k in pks:
+        col = big.column(k)
+        if col.null_count:
+            return None
+        enc = _memcomparable_fixed(col)
+        if enc is None:
+            return None
+        parts.append(enc)
+    encoded = np.concatenate(parts, axis=1)  # [n, total_width] uint8
+    width = encoded.shape[1]
+
+    lengths = np.array([len(t) for t in uniformed], dtype=np.int64)
+    run_offsets = np.concatenate([[0], np.cumsum(lengths)])
+    if not _runs_sorted_bytes(encoded, run_offsets):
+        return None
+    data = np.ascontiguousarray(encoded).reshape(-1)
+    offsets = (np.arange(n + 1, dtype=np.int64) * width)
+    order, tail, _groups = native.merge_sorted_runs_bytes(data, offsets, run_offsets)
+    return big.take(pa.array(order[tail]))
+
+
+def _memcomparable_fixed(col: pa.ChunkedArray) -> np.ndarray | None:
+    """[n, w] uint8 whose bytewise order equals the column's value order, or
+    None for unsupported types."""
+    t = col.type
+    if pa.types.is_boolean(t):
+        return np.asarray(col).astype(np.uint8)[:, None]
+    if pa.types.is_integer(t):
+        vals = np.asarray(col)
+        w = t.bit_width // 8
+        udt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[w]
+        u = vals.astype(udt, copy=True)
+        if pa.types.is_signed_integer(t):
+            u ^= udt(1) << udt(t.bit_width - 1)  # flip sign bit → unsigned order
+        return u[:, None].view(np.uint8).reshape(len(u), w)[:, ::-1]  # big-endian
+    if pa.types.is_floating(t):
+        vals = np.asarray(col)
+        if np.isnan(vals).any():
+            # arrow sorts every NaN last regardless of sign; the bit encoding
+            # would order negative NaN first — fall back
+            return None
+        # -0.0 and +0.0 are EQUAL keys but have different bit patterns:
+        # canonicalize so the byte order agrees with value equality
+        vals = np.where(vals == 0.0, 0.0, vals)
+        w = t.bit_width // 8
+        udt = {2: np.uint16, 4: np.uint32, 8: np.uint64}[w]
+        u = vals.view(udt).copy()
+        # IEEE-754 total order: positives flip the sign bit, negatives flip all
+        neg = (u >> udt(t.bit_width - 1)) != 0
+        u[neg] = ~u[neg]
+        u[~neg] ^= udt(1) << udt(t.bit_width - 1)
+        return u[:, None].view(np.uint8).reshape(len(u), w)[:, ::-1]
+    if pa.types.is_date(t) or pa.types.is_timestamp(t) or pa.types.is_time(t):
+        # go through an arrow cast: np.asarray of time32/time64 yields
+        # datetime.time OBJECTS whose astype(int64) raises
+        try:
+            vals = np.asarray(col.cast(pa.int64()))
+        except (pa.lib.ArrowInvalid, pa.lib.ArrowNotImplementedError):
+            return None
+        u = vals.astype(np.uint64) ^ (np.uint64(1) << np.uint64(63))
+        return u[:, None].view(np.uint8).reshape(len(u), 8)[:, ::-1]
+    return None
+
+
+def _runs_sorted_bytes(encoded: np.ndarray, run_offsets: np.ndarray) -> bool:
+    """Each run's encoded rows nondecreasing bytewise (vectorized)."""
+    a = encoded[:-1]
+    b = encoded[1:]
+    neq = a != b
+    any_neq = neq.any(axis=1)
+    first = np.argmax(neq, axis=1)
+    rows = np.arange(len(a))
+    decreasing = any_neq & (b[rows, first] < a[rows, first])
+    if not decreasing.any():
+        return True
+    # a decrease is only a violation INSIDE a run (run boundaries may drop)
+    bad = np.nonzero(decreasing)[0] + 1  # index of the smaller row
+    boundary = set(int(x) for x in run_offsets[1:-1])
+    return all(int(i) in boundary for i in bad)
 
 
 def _arrow_bytes_layout(chunk: pa.Array):

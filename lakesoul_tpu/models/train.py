@@ -55,18 +55,20 @@ def make_bert_train_step(
     "ulysses" (two all-to-alls + one fused full attention, better MXU
     utilization when heads % sp == 0) — see parallel/ulysses.py for the
     trade-off."""
+    if sequence_parallel not in ("ring", "ulysses"):
+        # validate regardless of sp: a typo must fail on the dev box, not
+        # first surface when the script scales onto an sp>1 mesh
+        raise ValueError(
+            f"unknown sequence_parallel {sequence_parallel!r} (ring|ulysses)"
+        )
     attention_fn = None
     if plan.sp > 1:
         if sequence_parallel == "ring":
             attention_fn = make_ring_attention(plan.mesh)
-        elif sequence_parallel == "ulysses":
+        else:
             from lakesoul_tpu.parallel.ulysses import make_ulysses_attention
 
             attention_fn = make_ulysses_attention(plan.mesh)
-        else:
-            raise ValueError(
-                f"unknown sequence_parallel {sequence_parallel!r} (ring|ulysses)"
-            )
     batch_sharding = NamedSharding(plan.mesh, P("dp", "sp"))
     loss_fn = functools.partial(bert_mlm_loss, cfg=cfg, attention_fn=attention_fn)
 

@@ -81,17 +81,10 @@ def count_data_assets(catalog) -> AssetsReport:
                 t.partitions += 1
                 t.total_commits += head.version + 1
                 t.latest_commit_ts = max(t.latest_commit_ts, head.timestamp)
-                commits = client.store.get_data_commit_info(
-                    info.table_id, head.partition_desc, head.snapshot
-                )
-                files: dict[str, int] = {}
-                for c in commits:
-                    for op in c.file_ops:
-                        if op.file_op.value == "del":
-                            files.pop(op.path, None)
-                        else:
-                            files[op.path] = op.size
-                t.live_files += len(files)
-                t.live_bytes += sum(files.values())
+                # the same add/del fold scan planning uses — one definition
+                # of "live" (meta/client.py _files_for_partition)
+                live = client._files_for_partition(head)
+                t.live_files += len(live)
+                t.live_bytes += sum(f.size for f in live)
             report.tables.append(t)
     return report

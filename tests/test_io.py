@@ -205,3 +205,37 @@ class TestFilters:
         # non-PK column breaks pruning
         assert extract_pk_equalities((col("id") == 1) | (col("v") == 2), ["id"]) == []
         assert extract_pk_equalities(col("id") > 5, ["id"]) == []
+
+
+class TestWriterByteBudget:
+    def test_byte_budget_triggers_flush(self, tmp_path):
+        """The writer's byte budget is the spill mechanism (mem/pool.rs +
+        spill.rs roles): crossing it stages sorted runs to disk mid-stream."""
+        import numpy as np
+        import pyarrow as pa
+
+        from lakesoul_tpu.io.config import IOConfig
+        from lakesoul_tpu.io.writer import TableWriter
+
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        cfg = IOConfig(schema=schema, primary_keys=["id"], hash_bucket_num=1)
+        cfg.memory_budget_bytes = 64 << 10  # tiny budget → frequent spills
+        w = TableWriter(cfg, str(tmp_path / "t"))
+        rng = np.random.default_rng(0)
+        for wave in range(4):
+            n = 4096  # ~64KB per batch ≥ budget
+            w.write_batch(pa.table({
+                "id": rng.permutation(n).astype(np.int64),
+                "v": rng.normal(size=n),
+            }))
+        # spills happened before close: multiple sorted runs already staged
+        assert len(w._staged) >= 3
+        outs = w.close()
+        assert sum(o.row_count for o in outs) == 4 * 4096
+        # every staged run is internally sorted (they're the spill runs the
+        # streaming merger recombines)
+        import pyarrow.parquet as pq
+
+        for o in outs:
+            ids = pq.read_table(o.path).column("id").to_numpy()
+            assert (ids[1:] >= ids[:-1]).all()

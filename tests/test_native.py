@@ -244,3 +244,84 @@ class TestNativeBytesMerge:
         assert got.num_rows == 100
         vals = dict(zip(got.column("name").to_pylist(), got.column("v").to_pylist()))
         assert vals["u3"] == 300.0 and vals["u42"] == 420.0 and vals["u50"] == 50.0
+
+
+class TestCompositeMerge:
+    """Composite fixed-width PKs through the byte loser tree (memcomparable
+    encoding: big-endian, sign-flip ints, IEEE order-flip floats)."""
+
+    def _merged_pair(self, tables, pks, monkeypatch):
+        from lakesoul_tpu.io.merge import merge_sorted_tables
+
+        fast = merge_sorted_tables(tables, pks)
+        monkeypatch.setenv("LAKESOUL_TPU_DISABLE_NATIVE", "1")
+        slow = merge_sorted_tables(tables, pks)
+        monkeypatch.delenv("LAKESOUL_TPU_DISABLE_NATIVE")
+        return fast, slow
+
+    def test_int_float_composite_equals_fallback(self, monkeypatch):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        rng = np.random.default_rng(0)
+        tables = []
+        for _ in range(4):
+            n = 300
+            t = pa.table(
+                {
+                    "a": rng.integers(-20, 20, n).astype(np.int32),
+                    "b": np.round(rng.normal(size=n), 1),  # dup-friendly
+                    "v": rng.integers(0, 9, n),
+                }
+            )
+            idx = pc.sort_indices(t, sort_keys=[("a", "ascending"), ("b", "ascending")])
+            tables.append(t.take(idx))
+        fast, slow = self._merged_pair(tables, ["a", "b"], monkeypatch)
+        assert fast.equals(slow)
+
+    def test_negative_floats_and_sign_flip(self, monkeypatch):
+        import pyarrow as pa
+
+        t1 = pa.table({"x": pa.array([-3.5, -1.0, 0.0, 2.5]),
+                       "y": pa.array([1, 2, 3, 4], type=pa.int16()), "v": [1, 2, 3, 4]})
+        t2 = pa.table({"x": pa.array([-3.5, 2.5]),
+                       "y": pa.array([1, 4], type=pa.int16()), "v": [10, 40]})
+        fast, slow = self._merged_pair([t1, t2], ["x", "y"], monkeypatch)
+        assert fast.equals(slow)
+        assert fast.column("v").to_pylist() == [10, 2, 3, 40]  # newest wins
+
+    def test_nan_keys_fall_back(self, monkeypatch):
+        import numpy as np
+        import pyarrow as pa
+
+        t1 = pa.table({"x": pa.array([1.0, float("nan")]), "y": [1, 2], "v": [1, 2]})
+        fast, slow = self._merged_pair([t1], ["x", "y"], monkeypatch)
+        # NaN != NaN defeats Table.equals; compare arrays NaN-aware
+        np.testing.assert_array_equal(
+            fast.column("x").to_numpy(), slow.column("x").to_numpy()
+        )
+        assert fast.column("v").to_pylist() == slow.column("v").to_pylist()
+
+    def test_composite_through_table_api(self, tmp_warehouse):
+        import numpy as np
+        import pyarrow as pa
+
+        from lakesoul_tpu import LakeSoulCatalog
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("day", pa.int32()), ("slot", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table("cpk", schema, primary_keys=["day", "slot"], hash_bucket_num=2)
+        t.write_arrow(pa.table({
+            "day": np.repeat(np.arange(5, dtype=np.int32), 20),
+            "slot": np.tile(np.arange(20, dtype=np.int64), 5),
+            "v": np.zeros(100),
+        }))
+        t.upsert(pa.table({"day": pa.array([2], type=pa.int32()),
+                           "slot": pa.array([7], type=pa.int64()), "v": [9.0]}))
+        import pyarrow.compute as pc
+
+        got = t.to_arrow()
+        assert got.num_rows == 100
+        sel = got.filter(pc.and_(pc.equal(got["day"], 2), pc.equal(got["slot"], 7)))
+        assert sel.column("v").to_pylist() == [9.0]
