@@ -915,6 +915,7 @@ class LakeSoulScan:
         poll_interval: float = 1.0,
         stop_event=None,
         settle_ms: int = 250,  # retained for API compat; unused (see below)
+        cursors: dict | None = None,
     ) -> Iterator[pa.RecordBatch]:
         """Unbounded incremental source: yield batches for every commit after
         ``start_timestamp_ms`` (default: now), then keep polling for new
@@ -927,7 +928,14 @@ class LakeSoulScan:
         O(new commits) — unchanged partitions are skipped without touching
         version history.  Version cursors are exact, so the old timestamp
         settle window (``settle_ms``) is no longer needed: a commit is either
-        visible with a new version number or it is not."""
+        visible with a new version number or it is not.
+
+        Pass ``cursors`` (a dict the stream mutates in place; serialize with
+        meta.client.follow_cursors_to_json) to make the stream RESUMABLE:
+        persist it with your checkpoint and a restarted consumer continues
+        exactly after the last delivered commit — the pending-splits
+        checkpointing the reference's Flink source gets from
+        SimpleLakeSoulPendingSplitsSerializer."""
         from lakesoul_tpu.meta.entity import now_millis
 
         import time as _time
@@ -935,10 +943,11 @@ class LakeSoulScan:
         info = self._table.info
         client = self._table.catalog.client
         budget = self._table.io_config().memory_budget_bytes
-        start = start_timestamp_ms if start_timestamp_ms is not None else now_millis()
-        cursors = client.init_follow_cursors(
-            info.table_name, start, info.table_namespace
-        )
+        if cursors is None:
+            start = start_timestamp_ms if start_timestamp_ms is not None else now_millis()
+            cursors = client.init_follow_cursors(
+                info.table_name, start, info.table_namespace
+            )
         while stop_event is None or not stop_event.is_set():
             units = client.poll_scan_plan(
                 info.table_name, cursors, info.table_namespace

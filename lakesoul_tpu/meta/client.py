@@ -79,6 +79,27 @@ class PartitionCursor:
     snapshot: set[str] = field(default_factory=set)
 
 
+def follow_cursors_to_json(cursors: dict[str, "PartitionCursor"]) -> str:
+    """Serialize a follow stream's position (the role of the reference's
+    Flink pending-splits serializer, SimpleLakeSoulPendingSplitsSerializer):
+    persist alongside the consumer's checkpoint, restore with
+    follow_cursors_from_json, and resume exactly where the stream left off."""
+    import json
+
+    return json.dumps(
+        {desc: {"version": c.version, "snapshot": sorted(c.snapshot)} for desc, c in cursors.items()}
+    )
+
+
+def follow_cursors_from_json(s: str) -> dict[str, "PartitionCursor"]:
+    import json
+
+    return {
+        desc: PartitionCursor(version=d["version"], snapshot=set(d["snapshot"]))
+        for desc, d in json.loads(s).items()
+    }
+
+
 @dataclass
 class ScanPlanPartition:
     """One independently-readable scan unit: the files of a single

@@ -722,6 +722,23 @@ class SqlSession:
     def _insert(self, stmt: ast.Insert) -> pa.Table:
         t = self.catalog.table(stmt.table, self.namespace)
         schema = t.schema
+        if stmt.select is not None:
+            src = self._select(stmt.select)
+            names = stmt.columns or list(src.column_names)
+            if len(names) != src.num_columns:
+                raise SqlError(
+                    f"INSERT column list has {len(names)} names but the"
+                    f" SELECT produces {src.num_columns} columns"
+                )
+            cols = {}
+            for i, name in enumerate(names):
+                if name not in schema.names:
+                    raise SqlError(f"unknown column {name!r} in INSERT target")
+                cols[name] = src.column(i).cast(schema.field(name).type)
+            t.write_arrow(
+                pa.table(cols, schema=pa.schema([schema.field(n) for n in names]))
+            )
+            return pa.table({"inserted": pa.array([len(src)], type=pa.int64())})
         columns = stmt.columns or [f.name for f in schema]
         if any(len(r) != len(columns) for r in stmt.rows):
             raise SqlError("VALUES row arity does not match column list")

@@ -220,6 +220,7 @@ class Insert:
     table: str
     columns: list[str]
     rows: list[list]
+    select: "Select | None" = None  # INSERT INTO ... SELECT ...
 
 
 @dataclass
@@ -704,6 +705,9 @@ class Parser:
             while self.accept("op", ","):
                 columns.append(self.ident())
             self.expect("op", ")")
+        nxt = self.peek()
+        if nxt is not None and nxt.kind == "kw" and nxt.value == "select":
+            return Insert(table, columns, [], select=self.parse_select())
         self.expect("kw", "values")
         rows = [self._value_list()]
         while self.accept("op", ","):

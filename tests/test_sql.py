@@ -587,3 +587,27 @@ class TestSqlSurfaceR2:
         assert out.column("col").to_pylist() == [2]
         with pytest.raises(SqlError, match="division by zero"):
             session.execute("SELECT 1 / 0 FROM users")
+
+
+class TestInsertSelect:
+    def test_insert_from_select(self, session):
+        session.execute(
+            "CREATE TABLE seniors (id bigint PRIMARY KEY, name string)"
+        )
+        out = session.execute(
+            "INSERT INTO seniors SELECT id, name FROM users WHERE age >= 30"
+        )
+        assert out.column("inserted").to_pylist() == [2]
+        got = session.execute("SELECT name FROM seniors ORDER BY id")
+        assert got.column("name").to_pylist() == ["alice", "carol"]
+
+    def test_insert_select_with_column_list_and_cast(self, session):
+        session.execute("CREATE TABLE agecopy (id bigint PRIMARY KEY, age double)")
+        session.execute("INSERT INTO agecopy (id, age) SELECT id, age FROM users")
+        got = session.execute("SELECT age FROM agecopy ORDER BY id")
+        assert got.column("age").to_pylist() == [30.0, 25.0, 35.0, 28.0]
+
+    def test_arity_mismatch_rejected(self, session):
+        session.execute("CREATE TABLE x2 (id bigint PRIMARY KEY, name string)")
+        with pytest.raises(SqlError, match="column list"):
+            session.execute("INSERT INTO x2 (id) SELECT id, name FROM users")
