@@ -71,6 +71,34 @@ def write_checkpointed(catalog, name):
     w.checkpoint(2)
 
 
+def write_arrow_ipc_format(catalog, name):
+    """Same logical writes through the second physical format: ipc files in
+    the first commit, parquet in the upsert → a MIXED-format partition."""
+    t = catalog.create_table(name, SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+    orig = t.io_config
+
+    def ipc_cfg(**overrides):
+        cfg = orig(**overrides)
+        cfg.file_format = "arrow"
+        return cfg
+
+    t.io_config = ipc_cfg
+    t.write_arrow(to_table(ROWS))
+    t.io_config = orig
+    t.upsert(to_table(UPSERT_ROWS))
+
+
+def write_debezium(catalog, name):
+    from lakesoul_tpu.streaming import DebeziumJsonConsumer
+
+    c = DebeziumJsonConsumer(catalog, primary_keys={name: ["id"]})
+    for r in ROWS:
+        c.consume({"op": "c", "after": r, "source": {"table": name}})
+    for r in UPSERT_ROWS:
+        c.consume({"op": "u", "after": r, "source": {"table": name}})
+    c.checkpoint(1)
+
+
 def write_flight(catalog, name, server_port, token):
     from lakesoul_tpu.service.flight import LakeSoulFlightClient
 
@@ -119,11 +147,28 @@ def normalize(table: pa.Table):
     return table.to_pylist()
 
 
+def read_substrait_scan(catalog, name, **_):
+    """Scan with a substrait-serialized always-true predicate: exercises the
+    external-engine filter wire without changing the result set."""
+    import pyarrow.dataset as pads
+
+    from lakesoul_tpu.io.filters import Filter
+
+    t = catalog.table(name)
+    import pyarrow.substrait as ps
+
+    expr = pads.field("id") >= -(10**9)
+    data = bytes(ps.serialize_expressions([expr], ["f"], t.schema))
+    return t.scan().filter(Filter.from_substrait(data)).to_arrow()
+
+
 WRITERS = {
     "catalog": write_catalog,
     "sql": write_sql,
     "checkpointed": write_checkpointed,
     "flight": write_flight,
+    "ipc_format": write_arrow_ipc_format,
+    "debezium": write_debezium,
 }
 READERS = {
     "scan": read_scan,
@@ -131,6 +176,7 @@ READERS = {
     "batches": read_batches,
     "flight": read_flight,
     "torch": read_torch,
+    "substrait": read_substrait_scan,
 }
 
 
