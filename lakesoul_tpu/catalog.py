@@ -906,6 +906,22 @@ class LakeSoulScan:
             ex.shutdown(wait=False, cancel_futures=True)
 
     def count_rows(self) -> int:
+        """Row count; metadata-only when no decode is needed (reference:
+        EmptyScanCountExec shortcut, session.rs:1036).  The shortcut applies
+        when there is no filter/vector search and no unit needs a PK merge —
+        merge can collapse duplicate keys, so merged units must be counted
+        the slow way (a single PK file may itself hold duplicates)."""
+        if self._filter is None and self._vector_search is None and not self._cache:
+            units = self.scan_plan()
+            if all(not u.primary_keys for u in units):
+                from lakesoul_tpu.io.formats import format_for
+
+                opts = self._table.catalog.storage_options
+                return sum(
+                    format_for(f).count_rows(f, opts)
+                    for u in units
+                    for f in u.data_files
+                )
         return sum(len(b) for b in self.to_batches())
 
     def follow(

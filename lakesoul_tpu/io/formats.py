@@ -123,6 +123,12 @@ class PhysicalFormat:
         fs, p = filesystem_for(path, storage_options)
         return self._dataset(fs, p).schema
 
+    def count_rows(self, path: str, storage_options: dict | None = None) -> int:
+        """Row count WITHOUT decoding data (count-only scans — the role of
+        the reference's EmptyScanCountExec shortcut, session.rs:1036)."""
+        fs, p = filesystem_for(path, storage_options)
+        return self._dataset(fs, p).count_rows()
+
 
 class ParquetFormat(PhysicalFormat):
     """Parquet via pyarrow: row-group filter pushdown on scan, mmap decode for
@@ -163,6 +169,15 @@ class ParquetFormat(PhysicalFormat):
             if local:
                 return pq.read_table(p, columns=cols, memory_map=True)
             return pq.read_table(p, columns=cols, filesystem=fs)
+
+    def count_rows(self, path, storage_options=None):
+        import pyarrow.parquet as pq
+
+        fs, p = filesystem_for(path, storage_options)
+        local = _is_local(fs)
+        # footer-only read: no column data touched
+        meta = pq.read_metadata(p, filesystem=None if local else fs, memory_map=local)
+        return meta.num_rows
 
     def write_table(self, table, path, *, config=None):
         import pyarrow.parquet as pq

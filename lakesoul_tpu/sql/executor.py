@@ -372,6 +372,25 @@ class SqlSession:
 
     # ------------------------------------------------------------------- DQL
     def _select(self, stmt: ast.Select) -> pa.Table:
+        # bare `SELECT count(*) FROM t`: metadata-only count, no decode
+        # (reference: EmptyScanCountExec shortcut)
+        if (
+            len(stmt.items) == 1
+            and isinstance(stmt.items[0].expr, ast.Agg)
+            and stmt.items[0].expr.fn == "count"
+            and stmt.items[0].expr.arg is None
+            and stmt.where is None
+            and not stmt.joins
+            and not stmt.group_by
+            and stmt.having is None
+            and stmt.from_subquery is None
+            and not stmt.distinct
+            and not stmt.star
+        ):
+            n = self.catalog.table(stmt.table, self.namespace).scan().count_rows()
+            label = stmt.items[0].alias or "count(*)"
+            return pa.table({label: pa.array([n], type=pa.int64())})
+
         has_aggs = bool(stmt.group_by) or stmt.having is not None or any(
             _contains_agg(it.expr) for it in stmt.items
         )

@@ -343,3 +343,48 @@ class TestScanCache:
         assert rows == 4
         rows2 = sum(len(b) for b in t.scan().cache().batch_size(2).to_batches())
         assert rows2 == 4  # second epoch from cache
+
+
+class TestCountShortcut:
+    def test_metadata_only_count_after_compaction(self, catalog, monkeypatch):
+        t = seed_pk_table(catalog, name="cnt1")
+        assert t.scan().count_rows() == 4  # PK units → slow path (correct)
+        t.compact()
+        # post-compaction: PKs dropped → footer-only count; prove no decode
+        import lakesoul_tpu.io.formats as fmts
+
+        called = {"n": 0}
+        orig = fmts.ParquetFormat.read_table
+
+        def counting(self, *a, **k):
+            called["n"] += 1
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(fmts.ParquetFormat, "read_table", counting)
+        monkeypatch.setattr(
+            fmts.ParquetFormat, "iter_batches", lambda *a, **k: (_ for _ in ()).throw(AssertionError("decoded!"))
+        )
+        assert t.scan().count_rows() == 4
+        assert called["n"] == 0
+
+    def test_merge_units_count_correctly(self, catalog):
+        # duplicate PKs inside one file: metadata count would be wrong, the
+        # slow path must be taken
+        t = catalog.create_table(
+            "cnt2",
+            pa.schema([("id", pa.int64()), ("v", pa.float64())]),
+            primary_keys=["id"], hash_bucket_num=1,
+        )
+        t.write_arrow(pa.table({"id": [1, 1, 2], "v": [1.0, 2.0, 3.0]}))
+        assert t.scan().count_rows() == 2  # dup id=1 merges
+
+    def test_sql_count_star_uses_shortcut(self, catalog):
+        from lakesoul_tpu.sql import SqlSession
+
+        t = seed_pk_table(catalog, name="cnt3")
+        t.compact()
+        out = SqlSession(catalog).execute("SELECT count(*) AS n FROM cnt3")
+        assert out.column("n").to_pylist() == [4]
+        # filtered counts still go the exact way
+        out2 = SqlSession(catalog).execute("SELECT count(*) AS n FROM cnt3 WHERE id > 1")
+        assert out2.column("n").to_pylist() == [3]
