@@ -97,12 +97,17 @@ class LakeSoulCatalog:
         cdc: bool = False,
         cdc_column: str | None = None,
         properties: dict | None = None,
+        merge_operators: dict[str, str] | None = None,
         namespace: str = "default",
         table_path: str | None = None,
     ) -> "LakeSoulTable":
         props = dict(properties or {})
         if hash_bucket_num is not None:
             props[PROP_HASH_BUCKET_NUM] = str(hash_bucket_num)
+        for colname, op in (merge_operators or {}).items():
+            # persisted in table properties → every surface (table API, SQL
+            # WITH(...), Flight) reads back the same per-column operators
+            props[IOConfig.PROP_MERGE_OP_PREFIX + colname] = op
         if cdc or cdc_column:
             cdc_column = cdc_column or CDC_DEFAULT_COLUMN
             props[PROP_CDC_CHANGE_COLUMN] = cdc_column

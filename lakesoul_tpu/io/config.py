@@ -97,9 +97,24 @@ class IOConfig:
         keep = [f for f in self.schema if f.name not in self.range_partitions]
         return pa.schema(keep, metadata=self.schema.metadata)
 
+    # table-property keys that tune per-table IO (reference: table-level
+    # knobs live in table_info.properties JSON — hash bucket num, CDC column,
+    # TTLs, per-column merge operators)
+    PROP_COMPRESSION = "lakesoul.compression"
+    PROP_COMPRESSION_LEVEL = "lakesoul.compression_level"
+    PROP_FILE_FORMAT = "lakesoul.file_format"
+    PROP_MEMORY_BUDGET = "lakesoul.memory_budget_bytes"
+    PROP_MAX_ROW_GROUP = "lakesoul.max_row_group_size"
+    PROP_MERGE_OP_PREFIX = "mergeOperator."
+
     @classmethod
     def for_table(cls, table_info, **overrides) -> "IOConfig":
-        """Build a config from a TableInfo (lakesoul_tpu.meta.entity)."""
+        """Build a config from a TableInfo.  Per-table IO knobs and
+        per-column merge operators come from ``table_info.properties``
+        (``lakesoul.compression``, ``lakesoul.file_format``,
+        ``lakesoul.memory_budget_bytes``, ``mergeOperator.<col>`` …), so
+        every surface — table API, SQL WITH(...), Flight — configures them
+        the same way."""
         cfg = cls(
             schema=table_info.arrow_schema,
             primary_keys=table_info.primary_keys,
@@ -107,6 +122,20 @@ class IOConfig:
             hash_bucket_num=table_info.hash_bucket_num,
             cdc_column=table_info.cdc_column,
         )
+        props = dict(table_info.properties or {})
+        if cls.PROP_COMPRESSION in props:
+            cfg.compression = str(props[cls.PROP_COMPRESSION])
+        if cls.PROP_COMPRESSION_LEVEL in props:
+            cfg.compression_level = int(props[cls.PROP_COMPRESSION_LEVEL])
+        if cls.PROP_FILE_FORMAT in props:
+            cfg.file_format = str(props[cls.PROP_FILE_FORMAT])
+        if cls.PROP_MEMORY_BUDGET in props:
+            cfg.memory_budget_bytes = int(props[cls.PROP_MEMORY_BUDGET])
+        if cls.PROP_MAX_ROW_GROUP in props:
+            cfg.max_row_group_size = int(props[cls.PROP_MAX_ROW_GROUP])
+        for key, value in props.items():
+            if key.startswith(cls.PROP_MERGE_OP_PREFIX):
+                cfg.merge_operators[key[len(cls.PROP_MERGE_OP_PREFIX):]] = str(value)
         for k, v in overrides.items():
             setattr(cfg, k, v)
         return cfg

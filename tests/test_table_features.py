@@ -111,3 +111,56 @@ class TestPageCacheWiring:
         opts = {"lakesoul.cache_dir": str(tmp_path / "cache")}
         fs, p = filesystem_for("memory://bucket/x.bin", opts)
         assert type(fs).__name__ == "CachedReadFileSystem"
+
+
+class TestTableProperties:
+    """Per-table IO knobs + merge operators persisted in table_info.properties
+    (reference: table-level properties JSON) flow into every surface."""
+
+    def test_merge_operators_from_table_properties(self, catalog):
+        schema = pa.schema([("id", pa.int64()), ("clicks", pa.int64()), ("tag", pa.string())])
+        t = catalog.create_table(
+            "agg", schema, primary_keys=["id"], hash_bucket_num=1,
+            merge_operators={"clicks": "SumAll", "tag": "JoinedAllByComma"},
+        )
+        t.write_arrow(pa.table({"id": [1, 2], "clicks": [5, 7], "tag": ["a", "b"]}))
+        t.upsert(pa.table({"id": [1], "clicks": [3], "tag": ["c"]}))
+        got = t.to_arrow().sort_by("id")
+        assert got.column("clicks").to_pylist() == [8, 7]  # SumAll merged
+        assert got.column("tag").to_pylist() == ["a,c", "b"]
+        # and the config round-trips through a fresh catalog handle
+        cfg = catalog.table("agg").io_config()
+        assert cfg.merge_operators == {"clicks": "SumAll", "tag": "JoinedAllByComma"}
+
+    def test_merge_operators_via_sql_with_properties(self, catalog):
+        from lakesoul_tpu.sql import SqlSession
+
+        sql = SqlSession(catalog)
+        sql.execute(
+            "CREATE TABLE hits (id bigint PRIMARY KEY, n bigint)"
+            " WITH (hashBucketNum = '1', 'mergeOperator.n' = 'SumAll')"
+        )
+        sql.execute("INSERT INTO hits VALUES (1, 10)")
+        sql.execute("INSERT INTO hits VALUES (1, 5)")
+        out = sql.execute("SELECT n FROM hits")
+        assert out.column("n").to_pylist() == [15]
+
+    def test_io_knobs_from_properties(self, catalog):
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table(
+            "knobs", schema, primary_keys=["id"], hash_bucket_num=1,
+            properties={
+                "lakesoul.compression": "zstd",
+                "lakesoul.compression_level": "3",
+                "lakesoul.file_format": "arrow",
+                "lakesoul.memory_budget_bytes": str(64 << 20),
+            },
+        )
+        cfg = t.io_config()
+        assert cfg.compression == "zstd" and cfg.compression_level == 3
+        assert cfg.file_format == "arrow"
+        assert cfg.memory_budget_bytes == 64 << 20
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        files = [f for u in t.scan().scan_plan() for f in u.data_files]
+        assert files[0].endswith(".arrow")  # the format knob took effect
+        assert t.to_arrow().column("v").to_pylist() == [1.0]
