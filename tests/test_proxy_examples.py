@@ -175,3 +175,15 @@ class TestParseRange:
         for bad in ("bytes=100-", "bytes=5-2", "bytes=-0", "items=0-1", "bytes=0-1,5-6"):
             with pytest.raises(ValueError):
                 parse_range(bad, 100)
+
+
+class TestOnlineFeaturesExample:
+    def test_online_features_example(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "examples/online_features.py", "--warehouse",
+             str(tmp_path / "wh")],
+            capture_output=True, text=True, timeout=300,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "online features updated" in out.stdout
