@@ -62,12 +62,17 @@ def main() -> None:
     stop = threading.Event()
     updates = {"rows": 0}
 
+    cdc_col = table.info.cdc_column
+
     def serve():
         nonlocal feature_bank
-        for batch in table.scan().follow(
+        # with_cdc_deletes: consume row KINDS, not just surviving rows — a
+        # delete must CLEAR its uid's features, not leave them stale
+        for batch in table.scan().with_cdc_deletes().follow(
             poll_interval=0.05, stop_event=stop, cursors=cursors
         ):
             uids = np.asarray(batch.column("uid"))
+            kinds = np.asarray(batch.column(cdc_col).to_pylist(), dtype=object)
             feats = np.stack(
                 [
                     np.asarray(batch.column("clicks"), dtype=np.float32),
@@ -81,19 +86,27 @@ def main() -> None:
             if top > feature_bank.shape[0]:
                 pad = jnp.zeros((top - feature_bank.shape[0], 2))
                 feature_bank = jnp.concatenate([feature_bank, pad])
-            feature_bank = feature_bank.at[uids].set(jnp.asarray(feats))
+            live = kinds != "delete"
+            if live.any():
+                feature_bank = feature_bank.at[uids[live]].set(jnp.asarray(feats[live]))
+            if (~live).any():
+                feature_bank = feature_bank.at[uids[~live]].set(0.0)
             updates["rows"] += len(uids)
-            if updates["rows"] >= 8:
+            if updates["rows"] >= 9:
                 stop.set()
 
     t = threading.Thread(target=serve, daemon=True)
     t.start()
 
-    # epoch 2: live updates arrive while the consumer runs
+    # epoch 2: live updates + a delete arrive while the consumer runs
     for uid in (3, 7, 11, 19):
         consumer.consume(ev("u", {"uid": uid, "clicks": 999, "spend": 123.45}))
     for uid in (40, 41, 42, 43):
         consumer.consume(ev("c", {"uid": uid, "clicks": 1, "spend": 1.0}))
+    consumer.consume(
+        {"op": "d", "before": {"uid": 5, "clicks": 0, "spend": 0.0},
+         "source": {"table": "user_features"}}
+    )
     consumer.checkpoint(2)
     t.join(timeout=20)
     stop.set()
@@ -103,9 +116,11 @@ def main() -> None:
     assert follow_cursors_from_json(state).keys() == cursors.keys()
 
     hot = float(feature_bank[3, 0])
+    gone = float(feature_bank[5, 0])
     print(f"online features updated: {updates['rows']} rows streamed,"
-          f" uid=3 clicks={hot:.0f}")
+          f" uid=3 clicks={hot:.0f}, deleted uid=5 clicks={gone:.0f}")
     assert hot == 999.0, "live update did not reach the feature bank"
+    assert gone == 0.0, "delete did not clear the feature bank"
 
 
 if __name__ == "__main__":
