@@ -26,6 +26,8 @@ read/compaction time, bounded, instead of an ad-hoc spill file format.
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Iterator
 
 import pyarrow as pa
@@ -36,6 +38,58 @@ from lakesoul_tpu.io.merge import merge_sorted_tables, uniform_table
 # rows per load step per stream; the byte budget divides down from this
 DEFAULT_STREAM_BATCH_ROWS = 65_536
 MIN_STREAM_BATCH_ROWS = 4_096
+
+_DONE = object()
+
+
+class _PrefetchIterator:
+    """One-slot background prefetch over an iterator: while the merge works
+    on batch k, batch k+1 decodes on a thread (IO/decode overlap the
+    synchronous scanner gives up).  Memory bound: ONE extra batch in
+    flight."""
+
+    def __init__(self, it):
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, args=(it,), daemon=True)
+        self._thread.start()
+
+    def _run(self, it) -> None:
+        try:
+            for item in it:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+            self._put(_DONE)
+        except BaseException as e:  # surface decode errors to the consumer
+            self._put(e)
+
+    def _put(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
 
 
 def _key_tuple(table: pa.Table, primary_keys: list[str], row: int) -> tuple:
@@ -91,7 +145,7 @@ class _SortedFileStream:
 
         self._file_schema = file_schema
         self._defaults = defaults
-        self._batches = iter(
+        self._batches = _PrefetchIterator(
             format_for(path).iter_batches(
                 path,
                 columns=columns,
@@ -142,6 +196,9 @@ class _SortedFileStream:
         out, self.buffer = self.buffer, self.buffer.schema.empty_table()
         return out
 
+    def close(self) -> None:
+        self._batches.close()
+
 
 def iter_merged_windows(
     files: list[str],
@@ -174,7 +231,17 @@ def iter_merged_windows(
         )
         for p in files
     ]
+    try:
+        yield from _merge_loop(
+            streams, primary_keys, file_schema, merge_operators, defaults
+        )
+    finally:
+        # abandoned or finished: stop every prefetch thread
+        for s in streams:
+            s.close()
 
+
+def _merge_loop(streams, primary_keys, file_schema, merge_operators, defaults):
     while True:
         for s in streams:
             # loop, not a single load: a pushed-down filter can produce empty
