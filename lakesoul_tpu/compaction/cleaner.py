@@ -20,19 +20,37 @@ logger = logging.getLogger(__name__)
 
 
 class Cleaner:
+    # per-table retention override, days, in table_info.properties — the
+    # reference keeps its TTLs ("partition.ttl") in table properties too
+    PROP_PARTITION_TTL_DAYS = "partition.ttl"
+
     def __init__(self, catalog, *, retention_ms: int = 7 * 24 * 3600 * 1000,
                  discard_grace_ms: int = 3600 * 1000):
         self.catalog = catalog
         self.retention_ms = retention_ms
         self.discard_grace_ms = discard_grace_ms
 
+    def _retention_for(self, info) -> int:
+        """Table property beats the cleaner default."""
+        props = info.properties or {}
+        ttl = props.get(self.PROP_PARTITION_TTL_DAYS)
+        if ttl is not None:
+            try:
+                return int(float(ttl) * 24 * 3600 * 1000)
+            except (TypeError, ValueError):
+                logger.warning(
+                    "table %s has invalid %s=%r; using cleaner default",
+                    info.table_name, self.PROP_PARTITION_TTL_DAYS, ttl,
+                )
+        return self.retention_ms
+
     def clean_table(self, table_name: str, namespace: str = "default",
                     *, now_ms: int | None = None) -> dict:
         """Returns {"versions_dropped": n, "files_deleted": n}."""
         now_ms = now_ms or now_millis()
-        cutoff = now_ms - self.retention_ms
         client = self.catalog.client
         info = client.get_table_info_by_name(table_name, namespace)
+        cutoff = now_ms - self._retention_for(info)
         store = client.store
         versions_dropped = 0
         files_deleted = 0

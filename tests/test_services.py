@@ -416,3 +416,37 @@ class TestFollowResume:
         t.write_arrow(pa.table({"id": [3], "v": [3.0]}))
         second = drain(restored)
         assert second == [3]  # no replay of 2, no loss of 3
+
+
+class TestCleanerTtlProperty:
+    def test_partition_ttl_property_overrides_default(self, catalog):
+        """partition.ttl in table properties drives per-table retention
+        (reference: TTLs live in table_info.properties)."""
+        t = catalog.create_table(
+            "ttl0", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
+            properties={"partition.ttl": "0"},  # expire immediately
+        )
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        t.write_arrow(pa.table({"id": [2], "v": [2.0]}))
+        t.compact()
+        # default retention (7 days) would keep everything; the property wins
+        cleaner = Cleaner(catalog, discard_grace_ms=0)
+        import time
+
+        time.sleep(0.002)
+        result = cleaner.clean_table("ttl0")
+        assert result["versions_dropped"] >= 2
+        assert t.to_arrow().sort_by("id").column("id").to_pylist() == [1, 2]
+
+    def test_invalid_ttl_falls_back_to_default(self, catalog, caplog):
+        import logging
+
+        t = catalog.create_table(
+            "ttlbad", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
+            properties={"partition.ttl": "soon"},
+        )
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        with caplog.at_level(logging.WARNING, logger="lakesoul_tpu.compaction.cleaner"):
+            result = Cleaner(catalog).clean_table("ttlbad")
+        assert result == {"versions_dropped": 0, "files_deleted": 0}
+        assert any("partition.ttl" in r.getMessage() for r in caplog.records)
