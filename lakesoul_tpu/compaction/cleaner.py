@@ -20,29 +20,66 @@ logger = logging.getLogger(__name__)
 
 
 class Cleaner:
-    # per-table retention override, days, in table_info.properties — the
-    # reference keeps its TTLs ("partition.ttl") in table properties too
-    PROP_PARTITION_TTL_DAYS = "partition.ttl"
-
     def __init__(self, catalog, *, retention_ms: int = 7 * 24 * 3600 * 1000,
                  discard_grace_ms: int = 3600 * 1000):
         self.catalog = catalog
         self.retention_ms = retention_ms
         self.discard_grace_ms = discard_grace_ms
 
-    def _retention_for(self, info) -> int:
-        """Table property beats the cleaner default."""
-        props = info.properties or {}
-        ttl = props.get(self.PROP_PARTITION_TTL_DAYS)
-        if ttl is not None:
-            try:
-                return int(float(ttl) * 24 * 3600 * 1000)
-            except (TypeError, ValueError):
+    def _version_retention_for(self, info) -> int:
+        """``lakesoul.version.retention`` (days) beats the cleaner default;
+        absent/invalid values fall back (logged in TableInfo parsing terms:
+        accessor returns None)."""
+        days = info.version_retention_days
+        if days is None and "lakesoul.version.retention" in (info.properties or {}):
+            logger.warning(
+                "table %s has invalid lakesoul.version.retention=%r; using default",
+                info.table_name, info.properties.get("lakesoul.version.retention"),
+            )
+        if days is None:
+            return self.retention_ms
+        return int(days * 24 * 3600 * 1000)
+
+    def expire_partitions(self, table_name: str, namespace: str = "default",
+                          *, now_ms: int | None = None) -> int:
+        """``partition.ttl`` (days) = partition DATA lifetime, matching the
+        reference's semantics: a partition whose NEWEST commit is older than
+        the ttl is deleted outright (DeleteCommit + live files removed).
+        Returns the number of partitions expired."""
+        now_ms = now_ms or now_millis()
+        client = self.catalog.client
+        info = client.get_table_info_by_name(table_name, namespace)
+        days = info.partition_ttl_days
+        if days is None:
+            if "partition.ttl" in (info.properties or {}):
                 logger.warning(
-                    "table %s has invalid %s=%r; using cleaner default",
-                    info.table_name, self.PROP_PARTITION_TTL_DAYS, ttl,
+                    "table %s has invalid partition.ttl=%r; skipping expiry",
+                    info.table_name, info.properties.get("partition.ttl"),
                 )
-        return self.retention_ms
+            return 0
+        cutoff = now_ms - int(days * 24 * 3600 * 1000)
+        from lakesoul_tpu.meta.entity import CommitOp, MetaInfo, PartitionInfo
+
+        expired = 0
+        for head in client.store.get_all_latest_partition_info(info.table_id):
+            if head.timestamp > cutoff or not head.snapshot:
+                continue
+            live = client._files_for_partition(head)
+            client.commit_data(
+                MetaInfo(
+                    table_info=info,
+                    list_partition=[PartitionInfo(info.table_id, head.partition_desc)],
+                ),
+                CommitOp.DELETE,
+            )
+            for f in live:
+                delete_file(f.path, self.catalog.storage_options, missing_ok=True)
+            logger.info(
+                "expired partition %s of %s (%d files)",
+                head.partition_desc, table_name, len(live),
+            )
+            expired += 1
+        return expired
 
     def clean_table(self, table_name: str, namespace: str = "default",
                     *, now_ms: int | None = None) -> dict:
@@ -50,7 +87,7 @@ class Cleaner:
         now_ms = now_ms or now_millis()
         client = self.catalog.client
         info = client.get_table_info_by_name(table_name, namespace)
-        cutoff = now_ms - self._retention_for(info)
+        cutoff = now_ms - self._version_retention_for(info)
         store = client.store
         versions_dropped = 0
         files_deleted = 0
@@ -103,9 +140,15 @@ class Cleaner:
         return len(deleted)
 
     def clean_all(self, *, now_ms: int | None = None) -> dict:
-        out = {"versions_dropped": 0, "files_deleted": 0, "discarded_deleted": 0}
+        out = {
+            "versions_dropped": 0,
+            "files_deleted": 0,
+            "discarded_deleted": 0,
+            "partitions_expired": 0,
+        }
         for ns in self.catalog.list_namespaces():
             for name in self.catalog.list_tables(ns):
+                out["partitions_expired"] += self.expire_partitions(name, ns, now_ms=now_ms)
                 r = self.clean_table(name, ns, now_ms=now_ms)
                 out["versions_dropped"] += r["versions_dropped"]
                 out["files_deleted"] += r["files_deleted"]

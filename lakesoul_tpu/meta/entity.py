@@ -168,6 +168,34 @@ class TableInfo:
     def cdc_column(self) -> str | None:
         return self.properties.get(PROP_CDC_CHANGE_COLUMN)
 
+    def _ttl_days(self, key: str) -> float | None:
+        """Parse a days-valued TTL property; None when absent or invalid
+        (consumers log and fall back — a bad property must never crash a
+        maintenance sweep)."""
+        raw = self.properties.get(key)
+        if raw is None:
+            return None
+        try:
+            days = float(raw)
+        except (TypeError, ValueError):
+            return None
+        if not (days >= 0) or days != days or days == float("inf"):
+            return None  # negative / NaN / inf: a typo'd sign must not wipe history
+        return days
+
+    @property
+    def partition_ttl_days(self) -> float | None:
+        """``partition.ttl``: the LIFETIME of partition data, matching the
+        reference's semantics — partitions whose newest commit is older than
+        this are deleted outright by the cleaner."""
+        return self._ttl_days("partition.ttl")
+
+    @property
+    def version_retention_days(self) -> float | None:
+        """``lakesoul.version.retention``: how long superseded snapshot
+        versions stay time-travelable (overrides the cleaner default)."""
+        return self._ttl_days("lakesoul.version.retention")
+
 
 @dataclass
 class MetaInfo:

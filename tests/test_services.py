@@ -418,35 +418,69 @@ class TestFollowResume:
         assert second == [3]  # no replay of 2, no loss of 3
 
 
-class TestCleanerTtlProperty:
-    def test_partition_ttl_property_overrides_default(self, catalog):
-        """partition.ttl in table properties drives per-table retention
-        (reference: TTLs live in table_info.properties)."""
+class TestCleanerTtlProperties:
+    """partition.ttl = partition DATA lifetime (the reference's semantics:
+    expired partitions are deleted outright); lakesoul.version.retention =
+    snapshot-history retention override."""
+
+    def test_version_retention_property_overrides_default(self, catalog):
         t = catalog.create_table(
-            "ttl0", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
-            properties={"partition.ttl": "0"},  # expire immediately
+            "vr0", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
+            properties={"lakesoul.version.retention": "0"},
         )
         t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
         t.write_arrow(pa.table({"id": [2], "v": [2.0]}))
         t.compact()
-        # default retention (7 days) would keep everything; the property wins
-        cleaner = Cleaner(catalog, discard_grace_ms=0)
         import time
 
         time.sleep(0.002)
-        result = cleaner.clean_table("ttl0")
+        # default retention (7 days) would keep everything; the property wins
+        result = Cleaner(catalog).clean_table("vr0")
         assert result["versions_dropped"] >= 2
+        # history trimmed, data intact
         assert t.to_arrow().sort_by("id").column("id").to_pylist() == [1, 2]
 
-    def test_invalid_ttl_falls_back_to_default(self, catalog, caplog):
-        import logging
+    def test_partition_ttl_expires_data(self, catalog):
+        import os
+        import time
 
         t = catalog.create_table(
-            "ttlbad", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
-            properties={"partition.ttl": "soon"},
+            "pttl", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
+            properties={"partition.ttl": "0"},
+        )
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        files = [f for u in t.scan().scan_plan() for f in u.data_files]
+        time.sleep(0.002)
+        n = Cleaner(catalog).expire_partitions("pttl")
+        assert n == 1
+        assert t.to_arrow().num_rows == 0  # partition data gone
+        for f in files:
+            assert not os.path.exists(f)
+
+    def test_fresh_partitions_survive_ttl(self, catalog):
+        t = catalog.create_table(
+            "pttl2", SCHEMA, primary_keys=["id"], hash_bucket_num=1,
+            properties={"partition.ttl": "7"},  # a week: nothing expires now
+        )
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        assert Cleaner(catalog).expire_partitions("pttl2") == 0
+        assert t.to_arrow().num_rows == 1
+
+    @pytest.mark.parametrize("bad", ["soon", "-1", "inf", "nan"])
+    def test_invalid_ttl_values_never_destroy_data(self, catalog, bad, caplog):
+        import logging
+
+        name = f"ttlbad_{bad}"
+        t = catalog.create_table(
+            name, SCHEMA, primary_keys=["id"], hash_bucket_num=1,
+            properties={"partition.ttl": bad, "lakesoul.version.retention": bad},
         )
         t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
         with caplog.at_level(logging.WARNING, logger="lakesoul_tpu.compaction.cleaner"):
-            result = Cleaner(catalog).clean_table("ttlbad")
+            cleaner = Cleaner(catalog)
+            assert cleaner.expire_partitions(name) == 0
+            result = cleaner.clean_table(name)
         assert result == {"versions_dropped": 0, "files_deleted": 0}
-        assert any("partition.ttl" in r.getMessage() for r in caplog.records)
+        assert t.to_arrow().num_rows == 1
+        assert any("ttl" in r.getMessage() or "retention" in r.getMessage()
+                   for r in caplog.records)
