@@ -98,8 +98,17 @@ def test_random_dml_sequences_match_model(tmp_warehouse, seed):
             n = t.delete_where(col("v") > thr)
             expected_n = model.delete_where_v_gt(thr)
             assert n == expected_n, f"step {step}: deleted {n} != model {expected_n}"
-        elif roll < 0.9:
+        elif roll < 0.87:
             t.compact()
+        elif roll < 0.93 and time_points and rng.random() < 0.5:
+            # rollback to a remembered instant: table AND model rewind
+            ts, past = time_points[int(rng.integers(0, len(time_points)))]
+            t.rollback(to_timestamp_ms=ts)
+            model.rows = {r["id"]: dict(r) for r in past}
+            # older remembered instants stay valid; drop the later ones
+            # (their history is now shadowed by the rollback commit)
+            time_points = [(p_ts, p) for p_ts, p in time_points if p_ts <= ts]
+            time.sleep(0.002)
         else:
             # remember a consistent point for time travel
             heads = catalog.client.store.get_all_latest_partition_info(t.info.table_id)

@@ -142,3 +142,66 @@ class TestCachedTableScan:
         )
         after = cache_stats(opts)
         assert after["misses"] == before["misses"]  # no read-through on write
+
+
+class TestCacheConcurrency:
+    def test_threads_share_one_cache_safely(self, tmp_path, mem_fs):
+        """Concurrent readers over one DiskPageCache: every read returns
+        correct bytes, accounting stays consistent, no deadlock."""
+        import threading
+
+        data = bytes(range(256)) * 512  # 128 KiB
+        mem_fs.pipe_file("/pc/conc", data)
+        cache = DiskPageCache(str(tmp_path / "c"), page_bytes=8 << 10)
+        errors = []
+
+        def reader(seed):
+            rng = __import__("numpy").random.default_rng(seed)
+            try:
+                for _ in range(40):
+                    a = int(rng.integers(0, len(data) - 1))
+                    b = int(rng.integers(a + 1, len(data) + 1))
+                    got = cache.read_range(mem_fs, "/pc/conc", a, b)
+                    if got != data[a:b]:
+                        errors.append((a, b))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        s = cache.snapshot()
+        assert s["bytes"] == sum(
+            v for v in cache._index.values()
+        )
+        assert s["hits"] > 0
+
+    def test_eviction_under_concurrency_keeps_bound(self, tmp_path, mem_fs):
+        import threading
+
+        blobs = {}
+        for i in range(4):
+            blobs[i] = bytes([i]) * (64 << 10)
+            mem_fs.pipe_file(f"/pc/c{i}", blobs[i])
+        cache = DiskPageCache(str(tmp_path / "c"), page_bytes=8 << 10, max_bytes=48 << 10)
+        errors = []
+
+        def reader(i):
+            try:
+                for _ in range(20):
+                    got = cache.read_range(mem_fs, f"/pc/c{i}", 0, 64 << 10)
+                    if got != blobs[i]:
+                        errors.append(i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        assert cache.current_bytes() <= 48 << 10
