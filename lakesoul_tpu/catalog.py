@@ -577,6 +577,7 @@ class LakeSoulScan:
         self._keep_cdc_deletes = False
         self._vector_search: tuple | None = None
         self._cache = False
+        self._limit: int | None = None
 
     def _replace(self, **kw) -> "LakeSoulScan":
         s = copy.copy(self)
@@ -613,6 +614,13 @@ class LakeSoulScan:
 
     def batch_size(self, n: int) -> "LakeSoulScan":
         return self._replace(_batch_size=int(n))
+
+    def limit(self, n: int) -> "LakeSoulScan":
+        """Stop after ``n`` rows (arbitrary subset, like SQL LIMIT without
+        ORDER BY): batch iteration ends early, skipping unread units."""
+        if n < 0:
+            raise ConfigError(f"limit must be non-negative, got {n}")
+        return self._replace(_limit=int(n))
 
     def snapshot_at(self, timestamp_ms: int) -> "LakeSoulScan":
         return self._replace(_snapshot_ts=int(timestamp_ms))
@@ -652,6 +660,7 @@ class LakeSoulScan:
             self._snapshot_ts,
             self._incremental,
             self._keep_cdc_deletes,
+            self._limit,
         )
 
     def vector_search(self, column: str, query, *, top_k: int = 10, nprobe: int = 8) -> "LakeSoulScan":
@@ -775,6 +784,14 @@ class LakeSoulScan:
         )
 
     def to_arrow(self) -> pa.Table:
+        if self._limit is not None:
+            batches = list(self.to_batches())
+            if batches:
+                return pa.Table.from_batches(batches)
+            base = self._table.info.arrow_schema
+            if self._columns is not None:
+                base = pa.schema([base.field(c) for c in self._columns])
+            return base.empty_table()
         if self._vector_search is not None:
             return self._resolve_vector_search().to_arrow()
         if self._cache:
@@ -802,6 +819,22 @@ class LakeSoulScan:
         thread pool (unit order preserved, bounded in-flight window) — parquet
         decode and the numpy merge release the GIL, so multi-core hosts
         overlap unit decodes like the reference's per-bucket tokio readers."""
+        if self._limit is not None:
+            inner = self._replace(_limit=None).to_batches(num_threads)
+            remaining = self._limit
+            try:
+                for b in inner:
+                    if remaining <= 0:
+                        break
+                    if len(b) > remaining:
+                        yield b.slice(0, remaining)
+                        remaining = 0
+                        break
+                    remaining -= len(b)
+                    yield b
+            finally:
+                inner.close()  # stop producer threads on early exit
+            return
         if self._vector_search is not None:
             yield from self._resolve_vector_search().to_batches(num_threads)
             return
@@ -922,11 +955,12 @@ class LakeSoulScan:
                 from lakesoul_tpu.io.formats import format_for
 
                 opts = self._table.catalog.storage_options
-                return sum(
+                n = sum(
                     format_for(f).count_rows(f, opts)
                     for u in units
                     for f in u.data_files
                 )
+                return n if self._limit is None else min(n, self._limit)
         return sum(len(b) for b in self.to_batches())
 
     def follow(

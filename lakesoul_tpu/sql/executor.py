@@ -428,6 +428,17 @@ class SqlSession:
                 if refs:
                     scan = scan.select(refs)
                 # no refs → full scan keeps the row count for literal selects
+            if (
+                stmt.limit is not None
+                and not stmt.joins
+                and not residual_nodes
+                and not stmt.order_by
+                and not has_aggs
+                and not stmt.distinct
+            ):
+                # LIMIT without ORDER BY returns arbitrary rows, so the scan
+                # can stop early (unread units are skipped entirely)
+                scan = scan.limit(stmt.limit)
             table = scan.to_arrow()
 
         # ---- joins (hash joins on Arrow compute; right side may be derived)

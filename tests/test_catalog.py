@@ -388,3 +388,52 @@ class TestCountShortcut:
         # filtered counts still go the exact way
         out2 = SqlSession(catalog).execute("SELECT count(*) AS n FROM cnt3 WHERE id > 1")
         assert out2.column("n").to_pylist() == [3]
+
+
+class TestScanLimit:
+    def test_limit_truncates_and_stops_early(self, catalog, monkeypatch):
+        schema = pa.schema(
+            [("id", pa.int64()), ("v", pa.float64()), ("part", pa.string())]
+        )
+        t = catalog.create_table("lim", schema, range_partitions=["part"])
+        for wave in range(4):
+            t.write_arrow(pa.table({
+                "id": np.arange(wave * 100, (wave + 1) * 100),
+                "v": np.zeros(100), "part": [f"p{wave}"] * 100,
+            }))
+        got = t.scan().limit(150).to_arrow()
+        assert got.num_rows == 150
+        assert t.scan().limit(0).to_arrow().num_rows == 0
+        assert t.scan().limit(10**9).to_arrow().num_rows == 400
+
+        # early stop is UNIT-granular: limit 50 decodes one partition's unit,
+        # the other three partitions' files are never read
+        import lakesoul_tpu.io.formats as fmts
+
+        calls = {"n": 0}
+        orig = fmts.ParquetFormat.read_table
+
+        def counting(self, *a, **k):
+            calls["n"] += 1
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(fmts.ParquetFormat, "read_table", counting)
+        assert t.scan().limit(50).to_arrow().num_rows == 50
+        assert calls["n"] <= 2  # not all 4 units
+
+    def test_count_rows_respects_limit(self, catalog):
+        t = catalog.create_table("lim2", SCHEMA, hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": np.arange(100), "v": np.zeros(100), "name": ["x"] * 100}))
+        assert t.scan().limit(7).count_rows() == 7
+        assert t.scan().count_rows() == 100
+
+    def test_sql_limit_pushes_into_scan(self, catalog):
+        from lakesoul_tpu.sql import SqlSession
+
+        t = catalog.create_table("lim3", SCHEMA, hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": np.arange(50), "v": np.zeros(50), "name": ["x"] * 50}))
+        out = SqlSession(catalog).execute("SELECT id FROM lim3 LIMIT 5")
+        assert out.num_rows == 5
+        # ordered LIMIT still exact: full sort then slice
+        out2 = SqlSession(catalog).execute("SELECT id FROM lim3 ORDER BY id DESC LIMIT 3")
+        assert out2.column("id").to_pylist() == [49, 48, 47]
