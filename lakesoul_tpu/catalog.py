@@ -660,7 +660,8 @@ class LakeSoulScan:
             self._snapshot_ts,
             self._incremental,
             self._keep_cdc_deletes,
-            self._limit,
+            # _limit intentionally absent: limited reads recurse through the
+            # unlimited scan, so the cache holds (and shares) the full result
         )
 
     def vector_search(self, column: str, query, *, top_k: int = 10, nprobe: int = 8) -> "LakeSoulScan":
@@ -783,15 +784,18 @@ class LakeSoulScan:
             storage_options=self._table.catalog.storage_options,
         )
 
+    def _projected_empty_table(self) -> pa.Table:
+        base = self._table.info.arrow_schema
+        if self._columns is not None:
+            base = pa.schema([base.field(c) for c in self._columns])
+        return base.empty_table()
+
     def to_arrow(self) -> pa.Table:
         if self._limit is not None:
             batches = list(self.to_batches())
             if batches:
                 return pa.Table.from_batches(batches)
-            base = self._table.info.arrow_schema
-            if self._columns is not None:
-                base = pa.schema([base.field(c) for c in self._columns])
-            return base.empty_table()
+            return self._projected_empty_table()
         if self._vector_search is not None:
             return self._resolve_vector_search().to_arrow()
         if self._cache:
@@ -808,10 +812,7 @@ class LakeSoulScan:
             if len(t):
                 tables.append(t)
         if not tables:
-            schema = self._table.info.arrow_schema
-            if self._columns is not None:
-                schema = pa.schema([schema.field(c) for c in self._columns])
-            return schema.empty_table()
+            return self._projected_empty_table()
         return pa.concat_tables(tables, promote_options="default").combine_chunks()
 
     def to_batches(self, num_threads: int | None = None) -> Iterator[pa.RecordBatch]:
@@ -823,8 +824,11 @@ class LakeSoulScan:
             inner = self._replace(_limit=None).to_batches(num_threads)
             remaining = self._limit
             try:
-                for b in inner:
-                    if remaining <= 0:
+                # check BEFORE pulling: advancing the iterator decodes the
+                # next unit, which must not happen once the limit is met
+                while remaining > 0:
+                    b = next(inner, None)
+                    if b is None:
                         break
                     if len(b) > remaining:
                         yield b.slice(0, remaining)
