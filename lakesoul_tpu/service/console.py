@@ -41,7 +41,7 @@ class Console:
             if is_sql:
                 return self.sql.execute(stripped).to_pandas().to_string()
             toks = shlex.split(stripped)
-            cmd, args = toks[0].lower(), toks[1:]
+            cmd, args = toks[0].lower().replace("-", "_"), toks[1:]
             handler = getattr(self, f"cmd_{cmd}", None)
             if handler is None:
                 return f"unknown command: {cmd!r} (try 'help')"
@@ -61,6 +61,9 @@ class Console:
             "  write <table> <parquet>      append a parquet file's rows\n"
             "  compact <table>              compact all partitions\n"
             "  versions <table>             partition version chains\n"
+            "  assets                       per-table data-asset statistics\n"
+            "  clean                        run the cleaner (TTLs, discard list)\n"
+            "  cache-stats                  page cache counters\n"
             "  drop <table>                 drop a table\n"
             "  quit"
         )
@@ -121,6 +124,23 @@ class Console:
                     f" commits={len(v.snapshot)} ts={v.timestamp}"
                 )
         return "\n".join(lines) or "(empty)"
+
+    def cmd_assets(self, args) -> str:
+        from lakesoul_tpu.service.assets import count_data_assets
+
+        return count_data_assets(self.catalog).to_arrow().to_pandas().to_string()
+
+    def cmd_clean(self, args) -> str:
+        from lakesoul_tpu.compaction import Cleaner
+
+        result = Cleaner(self.catalog).clean_all()
+        return " ".join(f"{k}={v}" for k, v in result.items())
+
+    def cmd_cache_stats(self, args) -> str:
+        from lakesoul_tpu.io.object_store import cache_stats
+
+        stats = cache_stats(self.catalog.storage_options)
+        return " ".join(f"{k}={v}" for k, v in stats.items())
 
     def cmd_drop(self, args) -> str:
         self.catalog.drop_table(args[0])

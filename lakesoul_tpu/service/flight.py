@@ -174,6 +174,8 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             scan = scan.incremental(req["incremental_start_ms"], req.get("incremental_end_ms"))
         if req.get("batch_size"):
             scan = scan.batch_size(req["batch_size"])
+        if req.get("limit") is not None:
+            scan = scan.limit(int(req["limit"]))
 
         metrics = self.metrics
         metrics.add(active_get_streams=1, total_get_streams=1)

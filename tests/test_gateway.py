@@ -143,3 +143,24 @@ class TestConsole:
         assert "error:" in c.execute("show nope")
         c.execute("drop t")
         assert c.execute("tables") == "(no tables)"
+
+
+class TestFlightLimit:
+    def test_limit_in_ticket(self, tmp_warehouse):
+        import numpy as np
+
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.service.flight import LakeSoulFlightClient, LakeSoulFlightServer
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table(
+            "fl", pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        )
+        t.write_arrow(pa.table({"id": np.arange(100), "v": np.zeros(100)}))
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0")
+        try:
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            got = client.scan("fl", limit=7)
+            assert got.num_rows == 7
+        finally:
+            server.shutdown()

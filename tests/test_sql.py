@@ -611,3 +611,18 @@ class TestInsertSelect:
         session.execute("CREATE TABLE x2 (id bigint PRIMARY KEY, name string)")
         with pytest.raises(SqlError, match="column list"):
             session.execute("INSERT INTO x2 (id) SELECT id, name FROM users")
+
+
+class TestConsoleManagement:
+    def test_assets_clean_cache_commands(self, tmp_warehouse):
+        from lakesoul_tpu.service.console import Console
+
+        c = Console(LakeSoulCatalog(str(tmp_warehouse)))
+        c.execute("CREATE TABLE m (id bigint, v double)")
+        c.execute("INSERT INTO m VALUES (1, 1.0)")
+        assets = c.execute("assets")
+        assert "m" in assets and "live_files" in assets
+        cleaned = c.execute("clean")
+        assert "versions_dropped=" in cleaned
+        stats = c.execute("cache-stats")
+        assert "hits=" in stats
