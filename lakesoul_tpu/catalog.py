@@ -186,6 +186,29 @@ class LakeSoulTable:
             setattr(cfg, k, v)
         return cfg
 
+    def set_properties(self, props: dict[str, str]) -> "LakeSoulTable":
+        """Merge properties into the table (ALTER TABLE SET TBLPROPERTIES
+        role): per-table IO knobs, TTLs, and mergeOperator.* entries become
+        effective for subsequent reads/writes.  A value of None removes the
+        key.  Structural properties (hashBucketNum, the CDC column) are
+        immutable — existing files were written under them."""
+        immutable = {PROP_HASH_BUCKET_NUM, PROP_CDC_CHANGE_COLUMN}
+        bad = immutable & set(props)
+        if bad:
+            raise MetadataError(
+                f"properties {sorted(bad)} are structural and cannot change"
+            )
+        merged = dict(self._info.properties or {})
+        for k, v in props.items():
+            if v is None:
+                merged.pop(k, None)
+            else:
+                merged[k] = str(v)
+        self.catalog.client.store.update_table_properties(
+            self._info.table_id, merged
+        )
+        return self.refresh()
+
     # ---------------------------------------------------------------- writes
     def write_arrow(
         self,

@@ -319,6 +319,11 @@ class SqlSession:
                 pa.field(stmt.column, _TYPE_MAP[stmt.type_name])
             )
             return pa.table({"status": ["ok"]})
+        if isinstance(stmt, ast.AlterSetProperties):
+            self.catalog.table(stmt.table, self.namespace).set_properties(
+                stmt.properties
+            )
+            return pa.table({"status": ["ok"]})
         if isinstance(stmt, ast.Call):
             return self._call(stmt)
         if isinstance(stmt, ast.Update):

@@ -263,6 +263,12 @@ class AlterAddColumn:
 
 
 @dataclass
+class AlterSetProperties:
+    table: str
+    properties: dict
+
+
+@dataclass
 class Call:
     procedure: str  # compact | rollback | clean | build_vector_index
     args: list
@@ -764,10 +770,22 @@ class Parser:
             if_exists = True
         return DropTable(self.ident(), if_exists)
 
-    def parse_alter(self) -> AlterAddColumn:
+    def parse_alter(self):
         self.expect("kw", "alter")
         self.expect("kw", "table")
         table = self.ident()
+        if self.accept("kw", "set"):
+            # ALTER TABLE t SET ('k' = 'v', ...) — TBLPROPERTIES role
+            self.expect("op", "(")
+            props = {}
+            while True:
+                key = self._value() if self.peek().kind == "string" else self.ident()
+                self.expect("op", "=")
+                props[str(key)] = self._value()
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+            return AlterSetProperties(table, props)
         self.expect("kw", "add")
         self.expect("kw", "column")
         name = self.ident()

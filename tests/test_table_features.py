@@ -164,3 +164,34 @@ class TestTableProperties:
         files = [f for u in t.scan().scan_plan() for f in u.data_files]
         assert files[0].endswith(".arrow")  # the format knob took effect
         assert t.to_arrow().column("v").to_pylist() == [1.0]
+
+
+class TestSetProperties:
+    def test_set_properties_takes_effect(self, catalog):
+        t = catalog.create_table("sp1", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        t.write_arrow(pa.table({"id": [1], "v": [5.0]}))
+        t.set_properties({"mergeOperator.v": "SumAll"})
+        assert t.io_config().merge_operators == {"v": "SumAll"}
+        t.upsert(pa.table({"id": [1], "v": [3.0]}))
+        assert t.to_arrow().column("v").to_pylist() == [8.0]  # SumAll now active
+        # removal via None
+        t.set_properties({"mergeOperator.v": None})
+        assert t.io_config().merge_operators == {}
+
+    def test_structural_properties_immutable(self, catalog):
+        t = catalog.create_table("sp2", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+        with pytest.raises(MetadataError, match="structural"):
+            t.set_properties({"hashBucketNum": "8"})
+
+    def test_alter_set_via_sql(self, catalog):
+        from lakesoul_tpu.sql import SqlSession
+
+        sql = SqlSession(catalog)
+        sql.execute("CREATE TABLE sp3 (id bigint PRIMARY KEY, n bigint)"
+                    " WITH (hashBucketNum = '1')")
+        sql.execute("ALTER TABLE sp3 SET ('partition.ttl' = '30', 'mergeOperator.n' = 'SumAll')")
+        t = catalog.table("sp3")
+        assert t.info.properties["partition.ttl"] == "30"
+        sql.execute("INSERT INTO sp3 VALUES (1, 2)")
+        sql.execute("INSERT INTO sp3 VALUES (1, 3)")
+        assert sql.execute("SELECT n FROM sp3").column("n").to_pylist() == [5]
