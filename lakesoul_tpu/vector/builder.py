@@ -103,19 +103,61 @@ class VectorShardIndexBuilder:
                 index.insert_batch(vectors, ids)
                 store.write_index(index, indexed_files=sorted(already | set(new_files)))
                 return len(ids)
-        table = read_scan_unit(
+        # full (re)build with bounded memory: stream the unit, train
+        # centroids on the first TRAIN_SAMPLE_ROWS vectors (standard IVF
+        # practice — k-means needs a sample, not the corpus), then insert the
+        # remaining batches incrementally and fold the deltas once
+        TRAIN_SAMPLE_ROWS = 200_000
+        from lakesoul_tpu.io.reader import iter_scan_unit_batches
+
+        batches = iter_scan_unit_batches(
             unit.data_files,
             unit.primary_keys,
+            batch_size=65_536,
+            file_sizes=getattr(unit, "file_sizes", None),
             schema=schema,
             partition_values=unit.partition_values,
             columns=[self.config.column, self.id_column],
         )
-        if len(table) == 0:
-            return 0
-        vectors, ids = extract_vectors(table, self.config.column, self.id_column, self.config.dim)
-        index = IvfRabitqIndex.train(vectors, ids, self.config, keep_raw=keep_raw)
+        sample_v: list[np.ndarray] = []
+        sample_i: list[np.ndarray] = []
+        sampled = 0
+        index = None
+        total = 0
+        for batch in batches:
+            t = pa.Table.from_batches([batch])
+            if len(t) == 0:
+                continue
+            vectors, ids = extract_vectors(
+                t, self.config.column, self.id_column, self.config.dim
+            )
+            total += len(ids)
+            if index is None:
+                sample_v.append(vectors)
+                sample_i.append(ids)
+                sampled += len(ids)
+                if sampled >= TRAIN_SAMPLE_ROWS:
+                    index = IvfRabitqIndex.train(
+                        np.concatenate(sample_v),
+                        np.concatenate(sample_i),
+                        self.config,
+                        keep_raw=keep_raw,
+                    )
+                    sample_v, sample_i = [], []
+            else:
+                index.insert_batch(vectors, ids)
+        if index is None:
+            if not sample_v:
+                return 0
+            index = IvfRabitqIndex.train(
+                np.concatenate(sample_v),
+                np.concatenate(sample_i),
+                self.config,
+                keep_raw=keep_raw,
+            )
+        index.merge_deltas()
         store.write_index(index, indexed_files=unit.data_files)
-        return len(ids)
+        return total
 
 
 def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | None = None,
