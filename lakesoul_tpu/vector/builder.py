@@ -13,10 +13,15 @@ import numpy as np
 import pyarrow as pa
 
 from lakesoul_tpu.errors import VectorIndexError
-from lakesoul_tpu.io.reader import read_scan_unit
+from lakesoul_tpu.io.reader import iter_scan_unit_batches, read_scan_unit
 from lakesoul_tpu.vector.config import VectorIndexConfig
 from lakesoul_tpu.vector.index import IvfRabitqIndex, SearchParams
 from lakesoul_tpu.vector.manifest import ManifestStore
+
+# k-means needs a sample, not the corpus: shards up to this many rows train
+# on everything in one pass; larger shards reservoir-sample for training and
+# take a second streaming pass to insert
+DEFAULT_TRAIN_SAMPLE_ROWS = 200_000
 
 
 def _shard_root(table_path: str, column: str, partition_desc: str, bucket_id: int) -> str:
@@ -57,11 +62,21 @@ class VectorShardIndexBuilder:
         id_column: str,
         *,
         storage_options: dict | None = None,
+        batch_size: int = 65_536,
+        memory_budget_bytes: int | None = None,
+        train_sample_rows: int = DEFAULT_TRAIN_SAMPLE_ROWS,
     ):
         self.table_path = table_path
         self.config = config
         self.id_column = id_column
         self.storage_options = storage_options or {}
+        self.batch_size = batch_size
+        from lakesoul_tpu.io.config import DEFAULT_MEMORY_BUDGET
+
+        self.memory_budget_bytes = (
+            memory_budget_bytes if memory_budget_bytes is not None else DEFAULT_MEMORY_BUDGET
+        )
+        self.train_sample_rows = train_sample_rows
 
     def build(self, unit, schema: pa.Schema, *, keep_raw: bool = True,
               incremental: bool = False) -> int:
@@ -93,6 +108,7 @@ class VectorShardIndexBuilder:
                     schema=schema,
                     partition_values=unit.partition_values,
                     columns=[self.config.column, self.id_column],
+                    storage_options=self.storage_options,
                 )
                 if len(table) == 0:
                     return 0
@@ -103,61 +119,96 @@ class VectorShardIndexBuilder:
                 index.insert_batch(vectors, ids)
                 store.write_index(index, indexed_files=sorted(already | set(new_files)))
                 return len(ids)
-        # full (re)build with bounded memory: stream the unit, train
-        # centroids on the first TRAIN_SAMPLE_ROWS vectors (standard IVF
-        # practice — k-means needs a sample, not the corpus), then insert the
-        # remaining batches incrementally and fold the deltas once
-        TRAIN_SAMPLE_ROWS = 200_000
-        from lakesoul_tpu.io.reader import iter_scan_unit_batches
+        # full (re)build with bounded memory.  Pass 1 streams the unit,
+        # buffering everything up to train_sample_rows and RESERVOIR-sampling
+        # beyond it (an unbiased training sample — first-N would bias
+        # centroids toward PK-ordered drift).  Small shards finish in that
+        # single pass; oversized shards train on the reservoir and take a
+        # second streaming pass to insert every vector.
+        cap = self.train_sample_rows
+        rng = np.random.default_rng(0xC0FFEE)
+        reservoir_v: np.ndarray | None = None
+        reservoir_i: np.ndarray | None = None
+        buffered: list[tuple[np.ndarray, np.ndarray]] = []  # exact rows (small path)
+        seen = 0
+        for vectors, ids in self._stream_vectors(unit, schema):
+            if seen < cap and seen + len(ids) <= cap:
+                buffered.append((vectors, ids))
+                seen += len(ids)
+                continue
+            if reservoir_v is None:
+                # crossing the cap: seed the reservoir from the exact buffer
+                parts_v = [v for v, _ in buffered] or [
+                    np.zeros((0, self.config.dim), np.float32)
+                ]
+                parts_i = [i for _, i in buffered] or [np.zeros(0, np.uint64)]
+                reservoir_v = np.concatenate(parts_v)
+                reservoir_i = np.concatenate(parts_i)
+                buffered = []
+                if len(reservoir_v) < cap:  # top up from the current batch
+                    take = cap - len(reservoir_v)
+                    reservoir_v = np.concatenate([reservoir_v, vectors[:take]])
+                    reservoir_i = np.concatenate([reservoir_i, ids[:take]])
+                    vectors, ids = vectors[take:], ids[take:]
+                    seen = cap
+            # algorithm-R style vectorized replacement for the remainder
+            m = len(ids)
+            if m:
+                positions = seen + np.arange(m)
+                accept = rng.random(m) < cap / (positions + 1)
+                idx = np.nonzero(accept)[0]
+                slots = rng.integers(0, cap, len(idx))
+                reservoir_v[slots] = vectors[idx]
+                reservoir_i[slots] = ids[idx]
+                seen += m
 
-        batches = iter_scan_unit_batches(
+        if reservoir_v is None:
+            # single pass: the whole shard fit in the sample window
+            if not buffered:
+                return 0
+            vectors = np.concatenate([v for v, _ in buffered])
+            ids = np.concatenate([i for _, i in buffered])
+            index = IvfRabitqIndex.train(vectors, ids, self.config, keep_raw=keep_raw)
+            store.write_index(index, indexed_files=unit.data_files)
+            return len(ids)
+
+        # oversized shard: train on the unbiased sample, then pass 2 inserts
+        # EVERY vector (the reservoir was for centroids only)
+        index = IvfRabitqIndex.train(
+            reservoir_v, reservoir_i[: len(reservoir_v)], self.config, keep_raw=keep_raw
+        )
+        index.clusters = [
+            index._make_cluster(
+                np.zeros((0, self.config.dim), np.float32),
+                np.zeros(0, np.uint64),
+                index.centroids[c],
+            )
+            for c in range(len(index.centroids))
+        ]  # drop the sample rows: pass 2 re-inserts them with everything else
+        total = 0
+        for vectors, ids in self._stream_vectors(unit, schema):
+            index.insert_batch(vectors, ids)
+            total += len(ids)
+        index.merge_deltas()
+        store.write_index(index, indexed_files=unit.data_files)
+        return total
+
+    def _stream_vectors(self, unit, schema: pa.Schema):
+        for batch in iter_scan_unit_batches(
             unit.data_files,
             unit.primary_keys,
-            batch_size=65_536,
+            batch_size=self.batch_size,
+            memory_budget_bytes=self.memory_budget_bytes,
             file_sizes=getattr(unit, "file_sizes", None),
             schema=schema,
             partition_values=unit.partition_values,
             columns=[self.config.column, self.id_column],
-        )
-        sample_v: list[np.ndarray] = []
-        sample_i: list[np.ndarray] = []
-        sampled = 0
-        index = None
-        total = 0
-        for batch in batches:
+            storage_options=self.storage_options,
+        ):
             t = pa.Table.from_batches([batch])
             if len(t) == 0:
                 continue
-            vectors, ids = extract_vectors(
-                t, self.config.column, self.id_column, self.config.dim
-            )
-            total += len(ids)
-            if index is None:
-                sample_v.append(vectors)
-                sample_i.append(ids)
-                sampled += len(ids)
-                if sampled >= TRAIN_SAMPLE_ROWS:
-                    index = IvfRabitqIndex.train(
-                        np.concatenate(sample_v),
-                        np.concatenate(sample_i),
-                        self.config,
-                        keep_raw=keep_raw,
-                    )
-                    sample_v, sample_i = [], []
-            else:
-                index.insert_batch(vectors, ids)
-        if index is None:
-            if not sample_v:
-                return 0
-            index = IvfRabitqIndex.train(
-                np.concatenate(sample_v),
-                np.concatenate(sample_i),
-                self.config,
-                keep_raw=keep_raw,
-            )
-        index.merge_deltas()
-        store.write_index(index, indexed_files=unit.data_files)
-        return total
+            yield extract_vectors(t, self.config.column, self.id_column, self.config.dim)
 
 
 def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | None = None,
@@ -184,9 +235,12 @@ def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | 
         else:
             raise VectorIndexError("dim required for non-fixed-size-list columns")
         config = VectorIndexConfig(column=column, dim=dim, **cfg_kw)
+    io_cfg = table.io_config()
     builder = VectorShardIndexBuilder(
         info.table_path, config, info.primary_keys[0],
         storage_options=table.catalog.storage_options,
+        batch_size=io_cfg.batch_size,
+        memory_budget_bytes=io_cfg.memory_budget_bytes,
     )
     total = 0
     for unit in table.scan().scan_plan():

@@ -540,3 +540,47 @@ class TestExResidentBatch:
         idx.enable_device_cache()
         ids, _ = idx.search(vecs[9], SearchParams(top_k=1, nprobe=4))
         assert int(ids[0]) == 9
+
+
+class TestStreamingShardBuild:
+    def test_oversized_shard_two_pass_build(self, tmp_path):
+        """train_sample_rows below the shard size forces the reservoir-train
+        + second-pass-insert path; every vector must land and self-recall
+        must hold."""
+        from lakesoul_tpu.vector.builder import VectorShardIndexBuilder
+        from lakesoul_tpu.vector.manifest import ManifestStore
+        from lakesoul_tpu import LakeSoulCatalog
+
+        catalog = LakeSoulCatalog(str(tmp_path / "wh"))
+        dim = 16
+        schema = pa.schema(
+            [("id", pa.int64()), ("emb", pa.list_(pa.float32(), dim))]
+        )
+        t = catalog.create_table("vs", schema, primary_keys=["id"], hash_bucket_num=1)
+        rng = np.random.default_rng(0)
+        n = 3000
+        vecs = rng.normal(size=(n, dim)).astype(np.float32)
+        t.write_arrow(pa.table({
+            "id": np.arange(n, dtype=np.int64),
+            "emb": pa.FixedSizeListArray.from_arrays(vecs.reshape(-1), dim),
+        }))
+        cfg = VectorIndexConfig(column="emb", dim=dim, nlist=8)
+        builder = VectorShardIndexBuilder(
+            t.info.table_path, cfg, "id",
+            train_sample_rows=500,  # << n → two-pass path
+            batch_size=256,
+        )
+        unit = t.scan().scan_plan()[0]
+        total = builder.build(unit, t.schema)
+        assert total == n
+        from lakesoul_tpu.vector.builder import _shard_root
+
+        store = ManifestStore(_shard_root(t.info.table_path, "emb", unit.partition_desc,
+                                          unit.bucket_id))
+        index = store.read_latest()
+        assert index.num_vectors == n  # pass 2 inserted EVERY vector once
+        hits = 0
+        for i in rng.choice(n, 50, replace=False):
+            ids, _ = index.search(vecs[i], SearchParams(top_k=1, nprobe=8))
+            hits += int(ids[0]) == i
+        assert hits >= 45  # self-recall with exact re-rank
