@@ -142,6 +142,9 @@ class LakeSoulCatalog:
     def create_namespace(self, name: str) -> None:
         self.client.create_namespace(name)
 
+    def drop_namespace(self, name: str) -> None:
+        self.client.drop_namespace(name)
+
     def list_namespaces(self) -> list[str]:
         return self.client.list_namespaces()
 

@@ -193,6 +193,17 @@ class MetaDataClient:
     def list_namespaces(self) -> list[str]:
         return self.store.list_namespaces()
 
+    def drop_namespace(self, name: str) -> None:
+        """Remove an empty namespace (reference: DBManager.deleteNamespace —
+        refusing non-empty namespaces prevents orphaning tables)."""
+        if name == "default":
+            raise MetadataError("the default namespace cannot be dropped")
+        if name not in self.store.list_namespaces():
+            raise MetadataError(f"namespace {name!r} does not exist")
+        if self.store.list_tables(name):
+            raise MetadataError(f"namespace {name!r} is not empty")
+        self.store.delete_namespace(name)
+
     def update_table_schema(self, table_id: str, schema: pa.Schema) -> None:
         self.store.update_table_schema(table_id, schema_to_json(schema), schema_to_ipc(schema))
 

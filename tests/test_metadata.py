@@ -434,3 +434,21 @@ class TestPgLikeConcurrency:
         with pytest.raises(MetadataError):
             make_table(client, name="dup")  # psycopg2.IntegrityError mapped
         fake_psycopg2.reset(dsn)
+
+
+class TestDropNamespace:
+    def test_drop_empty_namespace(self, client):
+        client.create_namespace("tmp_ns")
+        assert "tmp_ns" in client.list_namespaces()
+        client.drop_namespace("tmp_ns")
+        assert "tmp_ns" not in client.list_namespaces()
+
+    def test_drop_guards(self, client):
+        with pytest.raises(MetadataError, match="default"):
+            client.drop_namespace("default")
+        with pytest.raises(MetadataError, match="does not exist"):
+            client.drop_namespace("ghost")
+        client.create_namespace("busy")
+        client.create_table("t_in_ns", "/tmp/wh/busy/t", SCHEMA, namespace="busy")
+        with pytest.raises(MetadataError, match="not empty"):
+            client.drop_namespace("busy")
