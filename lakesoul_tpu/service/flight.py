@@ -71,8 +71,9 @@ class StreamMetrics:
 
 
 class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
-    def __init__(self, jwt_server: JwtServer | None):
+    def __init__(self, jwt_server: JwtServer | None, user_registry=None):
         self.jwt_server = jwt_server
+        self.user_registry = user_registry
 
     def start_call(self, info, headers):
         if self.jwt_server is None:
@@ -81,6 +82,19 @@ class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
         if not auth:
             raise flight.FlightUnauthenticatedError("missing authorization header")
         token = auth[0]
+        if token.lower().startswith("basic ") and self.user_registry is not None:
+            # handshake role: user/password authenticates this call; the
+            # `login` action then mints a bearer token for the session
+            import base64 as _b64
+
+            try:
+                user, _, password = (
+                    _b64.b64decode(token[6:]).decode().partition(":")
+                )
+                claims = self.user_registry.verify(user, password)
+            except (RBACError, ValueError, UnicodeDecodeError) as e:
+                raise flight.FlightUnauthenticatedError(str(e))
+            return _AuthMiddleware(claims.sub, claims.group)
         if token.lower().startswith("bearer "):
             token = token[7:]
         try:
@@ -106,11 +120,16 @@ class LakeSoulFlightServer(flight.FlightServerBase):
     ):
         self.catalog = catalog
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
+        from lakesoul_tpu.service.jwt import UserRegistry
+
+        self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
         self.metrics = StreamMetrics()
         super().__init__(
             location,
-            middleware={"auth": _AuthMiddlewareFactory(self.jwt_server)},
+            middleware={
+                "auth": _AuthMiddlewareFactory(self.jwt_server, self.user_registry)
+            },
         )
 
     # ------------------------------------------------------------------ auth
@@ -265,6 +284,20 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             return [flight.Result(json.dumps({"compacted": n}).encode())]
         if action.type == "metrics":
             return [flight.Result(json.dumps(self.metrics.snapshot()).encode())]
+        if action.type == "login":
+            # token-service role (reference: JWT token gRPC service): the
+            # caller authenticated this call (basic or bearer); mint a fresh
+            # bearer token for the session
+            if self.jwt_server is None:
+                raise flight.FlightServerError("server runs without auth")
+            from lakesoul_tpu.service.jwt import Claims
+
+            user, group = self._identity(context)
+            token = self.jwt_server.create_token(
+                Claims(sub=user, group=group),
+                ttl_seconds=int(body.get("ttl_seconds", 3600)),
+            )
+            return [flight.Result(json.dumps({"token": token}).encode())]
         if action.type == "data_assets":
             # per-table asset statistics as Arrow IPC (reference: the
             # data-assets stats job, entry/assets/CountDataAssets.java)
@@ -313,19 +346,44 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             ("sql", "execute a SQL statement; body: {statement, namespace?}"),
             ("metrics_prometheus", "metrics in Prometheus exposition format"),
             ("data_assets", "per-table asset statistics as Arrow IPC"),
+            ("login", "exchange authenticated identity for a bearer token"),
         ]
 
 
 class LakeSoulFlightClient:
     """Thin convenience client for the gateway."""
 
-    def __init__(self, location: str, *, token: str | None = None):
+    def __init__(
+        self,
+        location: str,
+        *,
+        token: str | None = None,
+        basic_auth: tuple[str, str] | None = None,
+    ):
         self._client = flight.FlightClient(location)
         self._options = None
         if token:
             self._options = flight.FlightCallOptions(
                 headers=[(b"authorization", f"Bearer {token}".encode())]
             )
+        elif basic_auth is not None:
+            import base64 as _b64
+
+            user, password = basic_auth
+            cred = _b64.b64encode(f"{user}:{password}".encode()).decode()
+            self._options = flight.FlightCallOptions(
+                headers=[(b"authorization", f"Basic {cred}".encode())]
+            )
+
+    def login(self, *, ttl_seconds: int = 3600) -> str:
+        """Exchange the current credentials for a bearer token and switch
+        this client to it (the reference's token-service handshake)."""
+        raw = self.action("login", {"ttl_seconds": ttl_seconds})[0]
+        token = json.loads(raw.decode())["token"]
+        self._options = flight.FlightCallOptions(
+            headers=[(b"authorization", f"Bearer {token}".encode())]
+        )
+        return token
 
     def scan(self, table: str, **req) -> pa.Table:
         flt = req.get("filter")

@@ -59,3 +59,41 @@ class JwtServer:
         if payload.get("exp", 0) < time.time():
             raise RBACError("token expired")
         return Claims(sub=payload["sub"], group=payload.get("group", "public"), exp=payload["exp"])
+
+
+USERS_CONFIG_KEY = "lakesoul.users"
+
+
+class UserRegistry:
+    """User/password registry in the metadata ``global_config`` table — the
+    credential store behind the reference's JWT token service (the gRPC
+    handshake that exchanges user/password for a token).  Passwords are
+    stored as salted SHA-256; groups drive RBAC domains."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def _load(self) -> dict:
+        raw = self.client.store.get_global_config(USERS_CONFIG_KEY, "{}")
+        return json.loads(raw or "{}")
+
+    def register(self, user: str, password: str, *, group: str = "public") -> None:
+        import secrets
+
+        users = self._load()
+        salt = secrets.token_hex(8)
+        users[user] = {
+            "salt": salt,
+            "password_sha256": hashlib.sha256((salt + password).encode()).hexdigest(),
+            "group": group,
+        }
+        self.client.store.set_global_config(USERS_CONFIG_KEY, json.dumps(users))
+
+    def verify(self, user: str, password: str) -> Claims:
+        entry = self._load().get(user)
+        if entry is None:
+            raise RBACError(f"unknown user {user!r}")
+        digest = hashlib.sha256((entry["salt"] + password).encode()).hexdigest()
+        if not hmac.compare_digest(digest, entry["password_sha256"]):
+            raise RBACError("invalid credentials")
+        return Claims(sub=user, group=entry.get("group", "public"))

@@ -164,3 +164,58 @@ class TestFlightLimit:
             assert got.num_rows == 7
         finally:
             server.shutdown()
+
+
+class TestLoginHandshake:
+    """Token-service role: basic credentials → login action → bearer token
+    (reference: the JWT token gRPC service beside the Flight server)."""
+
+    def test_basic_auth_login_then_bearer(self, tmp_warehouse):
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.service.flight import (
+            LakeSoulFlightClient,
+            LakeSoulFlightServer,
+        )
+        from lakesoul_tpu.service.jwt import UserRegistry
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table("lg", pa.schema([("id", pa.int64())]))
+        t.write_arrow(pa.table({"id": [1, 2]}))
+        UserRegistry(catalog.client).register("alice", "s3cret", group="public")
+
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0", jwt_secret="k")
+        try:
+            port = server.port
+            # basic credentials authenticate the login call
+            client = LakeSoulFlightClient(
+                f"grpc://127.0.0.1:{port}", basic_auth=("alice", "s3cret")
+            )
+            token = client.login()
+            assert token.count(".") == 2
+            # the minted bearer token works on its own
+            fresh = LakeSoulFlightClient(f"grpc://127.0.0.1:{port}", token=token)
+            assert fresh.scan("lg").num_rows == 2
+        finally:
+            server.shutdown()
+
+    def test_bad_credentials_rejected(self, tmp_warehouse):
+        import pyarrow.flight as flight
+
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.service.flight import (
+            LakeSoulFlightClient,
+            LakeSoulFlightServer,
+        )
+        from lakesoul_tpu.service.jwt import UserRegistry
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        UserRegistry(catalog.client).register("bob", "pw")
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0", jwt_secret="k")
+        try:
+            client = LakeSoulFlightClient(
+                f"grpc://127.0.0.1:{server.port}", basic_auth=("bob", "WRONG")
+            )
+            with pytest.raises(flight.FlightUnauthenticatedError):
+                client.login()
+        finally:
+            server.shutdown()
