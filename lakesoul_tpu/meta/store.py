@@ -562,11 +562,27 @@ class SqlMetadataStore(MetadataStore):
 
     def set_global_config(self, key: str, value: str) -> None:
         with self._txn() as conn:
-            self._exec(conn, 
+            self._exec(conn,
                 "INSERT INTO global_config(key, value) VALUES (?,?)"
                 " ON CONFLICT(key) DO UPDATE SET value=excluded.value",
                 (key, value),
             )
+
+    def update_global_config(self, key: str, updater) -> str:
+        """Atomic read-modify-write: ``updater(old_value_or_None) -> new``
+        runs inside ONE write transaction, so concurrent updates serialize
+        instead of losing each other's changes."""
+        with self._txn() as conn:
+            row = self._exec(
+                conn, "SELECT value FROM global_config WHERE key=?", (key,)
+            ).fetchone()
+            new = updater(row[0] if row else None)
+            self._exec(conn,
+                "INSERT INTO global_config(key, value) VALUES (?,?)"
+                " ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (key, new),
+            )
+            return new
 
     # -- discard (compaction garbage) ---------------------------------------
     def insert_discard_file(self, file_path: str, table_path: str, partition_desc: str) -> None:

@@ -20,6 +20,7 @@ total streams, rows and bytes in/out, exposed via the ``metrics`` action."""
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 from dataclasses import dataclass, field
@@ -29,7 +30,7 @@ import pyarrow.flight as flight
 
 from lakesoul_tpu.errors import LakeSoulError, RBACError
 from lakesoul_tpu.io.filters import Filter
-from lakesoul_tpu.service.jwt import JwtServer
+from lakesoul_tpu.service.jwt import Claims, JwtServer, UserRegistry
 from lakesoul_tpu.service.rbac import RbacVerifier
 
 
@@ -71,9 +72,38 @@ class StreamMetrics:
 
 
 class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
+    # successful Basic verifications are cached briefly: PBKDF2 is slow BY
+    # DESIGN (~0.2s), and clients are expected to call `login` once — but a
+    # client that keeps sending Basic headers must not pay (or inflict) a
+    # KDF + registry read per RPC
+    _BASIC_CACHE_TTL = 60.0
+
     def __init__(self, jwt_server: JwtServer | None, user_registry=None):
         self.jwt_server = jwt_server
         self.user_registry = user_registry
+        self._basic_cache: dict[str, tuple[float, str, str]] = {}
+        self._basic_lock = threading.Lock()
+
+    def _verify_basic(self, header: str):
+        import time as _time
+
+        now = _time.monotonic()
+        with self._basic_lock:
+            hit = self._basic_cache.get(header)
+            if hit is not None and hit[0] > now:
+                return hit[1], hit[2]
+        try:
+            user, _, password = base64.b64decode(header[6:]).decode().partition(":")
+            claims = self.user_registry.verify(user, password)
+        except (RBACError, ValueError, UnicodeDecodeError) as e:
+            raise flight.FlightUnauthenticatedError(str(e))
+        with self._basic_lock:
+            self._basic_cache[header] = (
+                now + self._BASIC_CACHE_TTL, claims.sub, claims.group,
+            )
+            if len(self._basic_cache) > 1024:  # bound the credential cache
+                self._basic_cache.clear()
+        return claims.sub, claims.group
 
     def start_call(self, info, headers):
         if self.jwt_server is None:
@@ -85,16 +115,8 @@ class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
         if token.lower().startswith("basic ") and self.user_registry is not None:
             # handshake role: user/password authenticates this call; the
             # `login` action then mints a bearer token for the session
-            import base64 as _b64
-
-            try:
-                user, _, password = (
-                    _b64.b64decode(token[6:]).decode().partition(":")
-                )
-                claims = self.user_registry.verify(user, password)
-            except (RBACError, ValueError, UnicodeDecodeError) as e:
-                raise flight.FlightUnauthenticatedError(str(e))
-            return _AuthMiddleware(claims.sub, claims.group)
+            user, group = self._verify_basic(token)
+            return _AuthMiddleware(user, group)
         if token.lower().startswith("bearer "):
             token = token[7:]
         try:
@@ -120,8 +142,6 @@ class LakeSoulFlightServer(flight.FlightServerBase):
     ):
         self.catalog = catalog
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
-        from lakesoul_tpu.service.jwt import UserRegistry
-
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
         self.metrics = StreamMetrics()
@@ -290,12 +310,16 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             # bearer token for the session
             if self.jwt_server is None:
                 raise flight.FlightServerError("server runs without auth")
-            from lakesoul_tpu.service.jwt import Claims
-
+            try:
+                ttl = int(body.get("ttl_seconds", 3600))
+            except (TypeError, ValueError):
+                raise flight.FlightServerError("ttl_seconds must be an integer")
+            # a short-lived token must not launder itself into a permanent
+            # credential via login: cap at 24h
+            ttl = max(1, min(ttl, 24 * 3600))
             user, group = self._identity(context)
             token = self.jwt_server.create_token(
-                Claims(sub=user, group=group),
-                ttl_seconds=int(body.get("ttl_seconds", 3600)),
+                Claims(sub=user, group=group), ttl_seconds=ttl
             )
             return [flight.Result(json.dumps({"token": token}).encode())]
         if action.type == "data_assets":
@@ -367,10 +391,8 @@ class LakeSoulFlightClient:
                 headers=[(b"authorization", f"Bearer {token}".encode())]
             )
         elif basic_auth is not None:
-            import base64 as _b64
-
             user, password = basic_auth
-            cred = _b64.b64encode(f"{user}:{password}".encode()).decode()
+            cred = base64.b64encode(f"{user}:{password}".encode()).decode()
             self._options = flight.FlightCallOptions(
                 headers=[(b"authorization", f"Basic {cred}".encode())]
             )

@@ -62,13 +62,15 @@ class JwtServer:
 
 
 USERS_CONFIG_KEY = "lakesoul.users"
+_PBKDF2_ITERATIONS = 600_000  # OWASP-grade work factor; stdlib-only
 
 
 class UserRegistry:
     """User/password registry in the metadata ``global_config`` table — the
     credential store behind the reference's JWT token service (the gRPC
     handshake that exchanges user/password for a token).  Passwords are
-    stored as salted SHA-256; groups drive RBAC domains."""
+    stored as salted PBKDF2-HMAC-SHA256 (slow by design — brute-forcing a
+    leaked table costs ~0.2s per guess); groups drive RBAC domains."""
 
     def __init__(self, client):
         self.client = client
@@ -77,23 +79,39 @@ class UserRegistry:
         raw = self.client.store.get_global_config(USERS_CONFIG_KEY, "{}")
         return json.loads(raw or "{}")
 
+    @staticmethod
+    def _kdf(salt: str, password: str, iterations: int) -> str:
+        return hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt.encode(), iterations
+        ).hex()
+
     def register(self, user: str, password: str, *, group: str = "public") -> None:
         import secrets
 
-        users = self._load()
         salt = secrets.token_hex(8)
-        users[user] = {
+        entry = {
             "salt": salt,
-            "password_sha256": hashlib.sha256((salt + password).encode()).hexdigest(),
+            "iterations": _PBKDF2_ITERATIONS,
+            "password_pbkdf2": self._kdf(salt, password, _PBKDF2_ITERATIONS),
             "group": group,
         }
-        self.client.store.set_global_config(USERS_CONFIG_KEY, json.dumps(users))
+
+        def updater(old: str | None) -> str:
+            # atomic read-modify-write: concurrent registrations must not
+            # drop each other's users
+            users = json.loads(old or "{}")
+            users[user] = entry
+            return json.dumps(users)
+
+        self.client.store.update_global_config(USERS_CONFIG_KEY, updater)
 
     def verify(self, user: str, password: str) -> Claims:
         entry = self._load().get(user)
         if entry is None:
             raise RBACError(f"unknown user {user!r}")
-        digest = hashlib.sha256((entry["salt"] + password).encode()).hexdigest()
-        if not hmac.compare_digest(digest, entry["password_sha256"]):
+        digest = self._kdf(
+            entry["salt"], password, int(entry.get("iterations", _PBKDF2_ITERATIONS))
+        )
+        if not hmac.compare_digest(digest, entry["password_pbkdf2"]):
             raise RBACError("invalid credentials")
         return Claims(sub=user, group=entry.get("group", "public"))
