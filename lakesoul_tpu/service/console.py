@@ -64,6 +64,7 @@ class Console:
             "  assets                       per-table data-asset statistics\n"
             "  clean                        run the cleaner (TTLs, discard list)\n"
             "  cache-stats                  page cache counters\n"
+            "  user-add <name> <pw> [group] register a gateway/proxy user\n"
             "  drop <table>                 drop a table\n"
             "  quit"
         )
@@ -135,6 +136,15 @@ class Console:
 
         result = Cleaner(self.catalog).clean_all()
         return " ".join(f"{k}={v}" for k, v in result.items())
+
+    def cmd_user_add(self, args) -> str:
+        if len(args) < 2:
+            return "usage: user-add <name> <password> [group]"
+        from lakesoul_tpu.service.jwt import UserRegistry
+
+        group = args[2] if len(args) > 2 else "public"
+        UserRegistry(self.catalog.client).register(args[0], args[1], group=group)
+        return f"registered user {args[0]} (group {group})"
 
     def cmd_cache_stats(self, args) -> str:
         from lakesoul_tpu.io.object_store import cache_stats

@@ -63,6 +63,9 @@ class StorageProxy:
                  port: int = 0):
         self.catalog = catalog
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
+        from lakesoul_tpu.service.jwt import UserRegistry
+
+        self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
         proxy = self
 
@@ -74,16 +77,31 @@ class StorageProxy:
                 user, group = "anonymous", "public"
                 if proxy.jwt_server is not None:
                     auth = self.headers.get("Authorization", "")
-                    token = auth[7:] if auth.lower().startswith("bearer ") else auth
-                    if not token:
-                        self.send_error(401, "missing token")
-                        return False
-                    try:
-                        claims = proxy.jwt_server.decode_token(token)
-                    except RBACError as e:
-                        self.send_error(401, str(e))
-                        return False
-                    user, group = claims.sub, claims.group
+                    if auth.lower().startswith("basic "):
+                        # same credential store as the Flight gateway
+                        import base64
+
+                        try:
+                            u, _, pw = (
+                                base64.b64decode(auth[6:]).decode().partition(":")
+                            )
+                            claims = proxy.user_registry.verify(u, pw)
+                        except (RBACError, ValueError, UnicodeDecodeError) as e:
+                            self.send_error(401, str(e))
+                            return False
+                        user, group = claims.sub, claims.group
+                        auth = None
+                    if auth is not None:
+                        token = auth[7:] if auth.lower().startswith("bearer ") else auth
+                        if not token:
+                            self.send_error(401, "missing token")
+                            return False
+                        try:
+                            claims = proxy.jwt_server.decode_token(token)
+                        except RBACError as e:
+                            self.send_error(401, str(e))
+                            return False
+                        user, group = claims.sub, claims.group
                 parts = self.path.lstrip("/").split("/")
                 if len(parts) < 3:
                     self.send_error(400, "path must be /<namespace>/<table>/<file>")

@@ -187,3 +187,35 @@ class TestOnlineFeaturesExample:
         )
         assert out.returncode == 0, out.stderr[-2000:]
         assert "online features updated" in out.stdout
+
+
+class TestProxyBasicAuth:
+    def test_basic_credentials_accepted(self, tmp_warehouse):
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.service.jwt import UserRegistry
+        from lakesoul_tpu.service.storage_proxy import StorageProxy
+        import base64
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table("pb", SCHEMA)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        UserRegistry(catalog.client).register("carol", "pw9")
+        proxy = StorageProxy(catalog, jwt_secret="pxy")
+        proxy.start()
+        try:
+            file_path = t.scan().scan_plan()[0].data_files[0]
+            rel = file_path.replace(catalog.warehouse + "/", "")
+            cred = base64.b64encode(b"carol:pw9").decode()
+            req = urllib.request.Request(f"http://127.0.0.1:{proxy.port}/{rel}")
+            req.add_header("Authorization", f"Basic {cred}")
+            data = urllib.request.urlopen(req, timeout=10).read()
+            assert data[:4] == b"PAR1"
+            # wrong password rejected
+            bad = base64.b64encode(b"carol:nope").decode()
+            req2 = urllib.request.Request(f"http://127.0.0.1:{proxy.port}/{rel}")
+            req2.add_header("Authorization", f"Basic {bad}")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req2, timeout=10)
+            assert e.value.code == 401
+        finally:
+            proxy.stop()
