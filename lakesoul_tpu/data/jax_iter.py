@@ -29,6 +29,47 @@ import pyarrow as pa
 _SENTINEL = object()
 
 
+class LoaderCheckpoint:
+    """Mid-epoch input-stream position (tf.data-checkpoint role).
+
+    The trainer persists this NEXT TO its model checkpoint: after resuming,
+    a loader built with the restored object continues exactly after the
+    last delivered batch — no replayed or skipped rows.  Position is the
+    delivered-row count over the scan's deterministic unit order, guarded by
+    a digest of the table version (a commit in between makes the position
+    meaningless, so resume refuses it).
+
+    ::
+
+        ckpt = LoaderCheckpoint()
+        for batch in scan.to_jax_iter(checkpoint=ckpt):
+            step(batch)
+            save(model_state, ckpt.to_json())   # atomically, per N steps
+        # after a crash:
+        ckpt = LoaderCheckpoint.from_json(saved)
+        for batch in scan.to_jax_iter(checkpoint=ckpt):  # resumes mid-epoch
+            ...
+    """
+
+    def __init__(self, rows_delivered: int = 0, plan_digest: str | None = None):
+        self.rows_delivered = rows_delivered
+        self.plan_digest = plan_digest
+
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps(
+            {"rows_delivered": self.rows_delivered, "plan_digest": self.plan_digest}
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "LoaderCheckpoint":
+        import json
+
+        d = json.loads(s)
+        return cls(d["rows_delivered"], d.get("plan_digest"))
+
+
 def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
     """Arrow → dict of numpy arrays (zero-copy where possible).  Fixed-width
     columns map directly; strings stay as object arrays (caller should
@@ -108,6 +149,7 @@ class JaxBatchIterator:
         device_prefetch: int = 2,
         drop_remainder: bool = True,
         io_threads: int | None = None,
+        checkpoint: "LoaderCheckpoint | None" = None,
     ):
         self._scan = scan
         self._collate = collate_fn or _default_collate
@@ -118,6 +160,23 @@ class JaxBatchIterator:
         self._device_prefetch = max(1, device_prefetch)
         self._drop_remainder = drop_remainder
         self._io_threads = io_threads
+        self._checkpoint = checkpoint
+        if checkpoint is not None:
+            digest = self._plan_digest()
+            if checkpoint.plan_digest is None:
+                checkpoint.plan_digest = digest
+            elif checkpoint.plan_digest != digest:
+                from lakesoul_tpu.errors import ConfigError
+
+                raise ConfigError(
+                    "loader checkpoint was taken against a different table"
+                    " version/scan — the saved position is meaningless"
+                )
+
+    def _plan_digest(self) -> str:
+        import hashlib
+
+        return hashlib.md5(repr(self._scan._cache_key()).encode()).hexdigest()
 
     # ------------------------------------------------------------- pipeline
     def _producer(self, q: queue.Queue, stop: threading.Event) -> None:
@@ -133,15 +192,26 @@ class JaxBatchIterator:
             return False
 
         try:
+            # resume: discard the rows the checkpoint already delivered —
+            # the scan's unit order is deterministic, so the row offset is a
+            # complete position
+            skip = self._checkpoint.rows_delivered if self._checkpoint else 0
             rb = _Rebatcher(self._scan._batch_size)
             for arrow_batch in self._scan.to_batches(num_threads=self._io_threads):
+                if skip:
+                    n = len(arrow_batch)
+                    if skip >= n:
+                        skip -= n
+                        continue
+                    arrow_batch = arrow_batch.slice(skip)
+                    skip = 0
                 for window in rb.push(arrow_batch):
-                    if not put(self._host_batch(window)):
+                    if not put((len(window), self._host_batch(window))):
                         return
             if not self._drop_remainder:
                 tail = rb.tail()
                 if tail is not None:
-                    if not put(self._host_batch(tail)):
+                    if not put((len(tail), self._host_batch(tail))):
                         return
             put(_SENTINEL)
         except BaseException as e:  # surface errors to the consumer
@@ -171,8 +241,16 @@ class JaxBatchIterator:
             finally:
                 stop.set()
 
+        def delivered(rows: int) -> None:
+            # position advances when a batch reaches the CONSUMER: a trainer
+            # saving (model, checkpoint) after step k resumes exactly at k+1
+            if self._checkpoint is not None:
+                self._checkpoint.rows_delivered += rows
+
         if not self._device_put:
-            yield from host_iter()
+            for rows, host_batch in host_iter():
+                delivered(rows)  # BEFORE yield: a post-step save includes it
+                yield host_batch
             return
 
         import jax
@@ -185,8 +263,12 @@ class JaxBatchIterator:
         # double buffering: keep device_prefetch transfers in flight so the
         # H2D copy of batch k+1 overlaps the step on batch k
         buf: list = []
-        for host_batch in host_iter():
-            buf.append(put(host_batch))
+        for rows, host_batch in host_iter():
+            buf.append((rows, put(host_batch)))
             if len(buf) > self._device_prefetch:
-                yield buf.pop(0)
-        yield from buf
+                r, b = buf.pop(0)
+                delivered(r)
+                yield b
+        for r, b in buf:
+            delivered(r)
+            yield b
