@@ -322,7 +322,44 @@ def bench_remote() -> tuple[float, float, float]:
     return cold, warm, rate
 
 
+def _device_reachable(timeout_s: float = 180.0) -> bool:
+    """Probe jax backend init on a daemon thread: a wedged TPU tunnel hangs
+    jax.devices() forever, which must not leave the driver with no output.
+    After a failed probe this PROCESS must never touch jax (the hung import
+    holds locks) — the caller re-execs on CPU instead."""
+    import subprocess as sp
+
+    code = "import jax; jax.devices(); print('ok')"
+    try:
+        out = sp.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            env={**os.environ},
+        )
+        return out.returncode == 0 and "ok" in out.stdout
+    except sp.TimeoutExpired:
+        return False
+
+
 def main():
+    device_label = os.environ.get("LAKESOUL_BENCH_DEVICE_LABEL")
+    if device_label is None:
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            device_label = "cpu"
+        elif _device_reachable():
+            device_label = "tpu"
+        else:
+            # wedged tunnel: produce an honest, clearly-labeled CPU line
+            # instead of hanging the driver with no output at all
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "LAKESOUL_BENCH_DEVICE_LABEL": "cpu-fallback (device unreachable)",
+            }
+            import subprocess as sp
+
+            raise SystemExit(sp.run([sys.executable, __file__], env=env).returncode)
+
     from lakesoul_tpu import LakeSoulCatalog
     from lakesoul_tpu.utils import honor_platform_env
 
@@ -359,6 +396,7 @@ def main():
                 "value": round(value, 1),
                 "unit": "rows/s/chip",
                 "vs_baseline": vs,
+                "device": device_label,
                 "mor_uncompacted_rows_per_s": round(mor, 1),
                 "ann_qps": round(ann_qps, 1),
                 "ann_recall_at_10": round(ann_recall, 4),
