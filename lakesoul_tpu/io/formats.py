@@ -225,6 +225,46 @@ class ArrowIpcFormat(PhysicalFormat):
         return fs.size(p)
 
 
+class LsfFormat(PhysicalFormat):
+    """LSF: the native columnar format (io/lsf.py) — lightweight encodings +
+    zero-copy mmap decode, the third registered format (the Vortex role,
+    file_format/vortex.rs).  All IO bypasses pyarrow.dataset: the footer
+    carries everything."""
+
+    name = "lsf"
+    extensions = (".lsf",)
+
+    def _open(self, path, storage_options):
+        from lakesoul_tpu.io.lsf import LsfFile
+
+        return LsfFile(path, storage_options)
+
+    def read_table(self, path, *, columns=None, arrow_filter=None, storage_options=None):
+        return self._open(path, storage_options).read(columns, arrow_filter)
+
+    def iter_batches(self, path, *, columns=None, arrow_filter=None,
+                     batch_size=65_536, storage_options=None):
+        yield from self._open(path, storage_options).iter_batches(
+            columns, arrow_filter, batch_size
+        )
+
+    def read_schema(self, path, storage_options=None):
+        from lakesoul_tpu.io.lsf import LsfFile
+
+        return LsfFile(path, storage_options, footer_only=True).schema
+
+    def count_rows(self, path, storage_options=None):
+        # footer-only: local mmap or two ranged GETs, no column data decoded
+        from lakesoul_tpu.io.lsf import LsfFile
+
+        return LsfFile(path, storage_options, footer_only=True).n_rows
+
+    def write_table(self, table, path, *, config=None):
+        from lakesoul_tpu.io.lsf import write_lsf_table
+
+        return write_lsf_table(table, path, config=config)
+
+
 def storage_options_of(config) -> dict:
     return getattr(config, "object_store_options", None) or {}
 
@@ -242,6 +282,7 @@ def register_format(fmt: PhysicalFormat) -> None:
 
 register_format(ParquetFormat())
 register_format(ArrowIpcFormat())
+register_format(LsfFormat())
 
 
 def format_for(path: str) -> PhysicalFormat:

@@ -1,0 +1,506 @@
+"""LSF — the native columnar file format (``.lsf``).
+
+Plays the role Vortex plays in the reference (third physical format behind the
+registry seam: rust/lakesoul-io/src/file_format.rs:46-150 dispatch,
+file_format/vortex.rs integration).  Vortex has no Python/C++ implementation
+to bind, so this is a fresh TPU-first design rather than a port.  The design
+goal is the same as Vortex's ("lightweight encodings, fast decode") but tuned
+for this framework's bottleneck: feeding HBM from a 1-2 core TPU-VM host.
+
+Decode is the hot path, so LSF does **no block compression at all** —
+only lightweight encodings whose decode is either zero-copy or a single
+vectorized pass:
+
+=========  =================================================================
+``raw``    fixed-width values verbatim → zero-copy mmap wrap (floats, and
+           ints whose range doesn't benefit from packing)
+``for``    frame-of-reference bit-packing (C++ kernel, numpy fallback);
+           width 0 encodes a constant column in 0 bytes
+``dfor``   delta + FOR for non-decreasing ints (PK/id columns: deltas are
+           tiny, often 1-4 bits/row)
+``bool``   packed bit values (Arrow layout, zero-copy)
+``bytes``  var-len binary: lengths FOR-packed + data bytes verbatim
+``dict``   low-cardinality strings: dictionary (bytes-encoded) + FOR indices
+``fsl``    fixed_size_list<fixed-width> (embedding columns): flat child
+           values verbatim (zero-copy)
+``ipc``    anything else: Arrow IPC record-batch bytes — every Arrow type
+           round-trips even when no specialized encoding applies
+=========  =================================================================
+
+File layout (all chunk buffers 8-byte aligned; FOR streams carry 8 pad bytes
+for the decoder's word-wide loads)::
+
+    "LSF1" | chunk 0 buffers | chunk 1 buffers | ... | footer JSON
+          | uint32-LE footer_len | "LSF1"
+
+The footer carries ``n_rows``, the full Arrow schema (IPC bytes, base64) and
+per-chunk per-column buffer locations + encoding params + int min/max stats.
+Rows are chunked by ``IOConfig.max_row_group_size`` — the streaming reader's
+memory granularity, like a parquet row group.
+
+Invariants preserved (formats.py contract): row order within a file and
+exact schema round-trip.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from lakesoul_tpu import native
+from lakesoul_tpu.errors import IOError_
+
+MAGIC = b"LSF1"
+VERSION = 1
+# encode-side decision knobs
+_DICT_SAMPLE = 2048
+_DICT_MAX_RATIO = 0.3  # sampled unique/total below this → dictionary-encode
+_FOR_SAVINGS = 0.75  # packed width must be <= 75% of raw width to bother
+
+_INT_NP = {
+    pa.int8(): np.int8, pa.int16(): np.int16, pa.int32(): np.int32,
+    pa.int64(): np.int64, pa.uint8(): np.uint8, pa.uint16(): np.uint16,
+    pa.uint32(): np.uint32, pa.uint64(): np.uint64,
+}
+
+
+def _is_fixed_raw(t: pa.DataType) -> bool:
+    """Fixed-width types stored verbatim when no int packing applies."""
+    return (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_timestamp(t)
+        or pa.types.is_date(t)
+        or pa.types.is_time(t)
+        or pa.types.is_duration(t)
+    )
+
+
+def _np_dtype_for(t: pa.DataType):
+    if t in _INT_NP:
+        return _INT_NP[t]
+    if pa.types.is_float16(t):
+        return np.float16
+    if pa.types.is_float32(t):
+        return np.float32
+    if pa.types.is_float64(t):
+        return np.float64
+    # 32/64-bit temporal types are integers on the wire
+    if pa.types.is_date32(t) or pa.types.is_time32(t):
+        return np.int32
+    return np.int64  # timestamp, date64, time64, duration
+
+
+class _BufferWriter:
+    """Sequential file writer tracking 8-byte-aligned buffer placement."""
+
+    def __init__(self, f):
+        self._f = f
+        self.offset = 0
+
+    def write(self, data) -> None:
+        self._f.write(data)
+        self.offset += len(data)
+
+    def add(self, data) -> list[int]:
+        """Write one aligned buffer; returns [offset, length]."""
+        pad = (-self.offset) % 8
+        if pad:
+            self.write(b"\0" * pad)
+        off = self.offset
+        self.write(data)
+        return [off, len(data)]
+
+
+def _validity_bytes(arr: pa.Array) -> bytes | None:
+    if arr.null_count == 0:
+        return None
+    mask = arr.is_valid().to_numpy(zero_copy_only=False)
+    return np.packbits(mask, bitorder="little").tobytes()
+
+
+def _int_values(arr: pa.Array, fill) -> np.ndarray:
+    filled = pc.fill_null(arr, fill) if arr.null_count else arr
+    return filled.to_numpy(zero_copy_only=False)
+
+
+def _encode_ipc(arr: pa.Array, field: pa.Field, w: _BufferWriter) -> dict:
+    sink = pa.BufferOutputStream()
+    schema = pa.schema([field])
+    with pa.ipc.new_stream(sink, schema) as out:
+        out.write_batch(pa.record_batch([arr], schema=schema))
+    return {"enc": "ipc", "bufs": [w.add(sink.getvalue())]}
+
+
+def _encode_for(vals: np.ndarray, w: _BufferWriter, *, nulls_meta) -> dict | None:
+    """FOR / delta-FOR encode an int64-safe numpy array; None if raw wins."""
+    n = len(vals)
+    raw_bits = vals.dtype.itemsize * 8
+    if n == 0:
+        return {"enc": "for", "base": 0, "width": 0, "bufs": [],
+                "stats": None, **nulls_meta}
+    v64 = vals.astype(np.int64, copy=False)
+    lo, hi = int(v64.min()), int(v64.max())
+    span = hi - lo
+    width = span.bit_length()
+    if width > 63:
+        return None
+    stats = [lo, hi]
+    # delta+FOR when non-decreasing (sorted PK/id runs): deltas pack tighter
+    if n > 1:
+        deltas = np.diff(v64)
+        if int(deltas.min()) >= 0:
+            dlo, dhi = int(deltas.min()), int(deltas.max())
+            dwidth = (dhi - dlo).bit_length()
+            if dwidth < width and dwidth <= raw_bits * _FOR_SAVINGS:
+                packed = native.bitpack64(deltas, dlo, dwidth)
+                return {
+                    "enc": "dfor", "first": int(v64[0]), "base": dlo,
+                    "width": dwidth, "bufs": [w.add(packed.tobytes())],
+                    "stats": stats, **nulls_meta,
+                }
+    if width == 0:
+        return {"enc": "for", "base": lo, "width": 0, "bufs": [],
+                "stats": stats, **nulls_meta}
+    if width > raw_bits * _FOR_SAVINGS:
+        return None
+    packed = native.bitpack64(v64, lo, width)
+    return {"enc": "for", "base": lo, "width": width,
+            "bufs": [w.add(packed.tobytes())], "stats": stats, **nulls_meta}
+
+
+def _flatten_binary(arr: pa.Array) -> tuple[np.ndarray, bytes]:
+    """(lengths int64, contiguous data bytes) for a binary-like array."""
+    large = pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type)
+    odtype = np.int64 if large else np.int32
+    obuf = arr.buffers()[1]
+    offs = np.frombuffer(obuf, dtype=odtype, count=len(arr) + 1, offset=arr.offset * odtype().itemsize)
+    offs = offs.astype(np.int64, copy=False)
+    lengths = np.diff(offs)
+    dbuf = arr.buffers()[2]
+    if dbuf is None or len(offs) == 0:
+        return lengths, b""
+    data = np.frombuffer(dbuf, dtype=np.uint8)[offs[0]: offs[-1]].tobytes()
+    return lengths, data
+
+
+def _encode_bytes_like(arr: pa.Array, w: _BufferWriter, nulls_meta) -> dict:
+    lengths, data = _flatten_binary(arr)
+    # lengths always FOR-pack: width > 48 would need a single >256 TB value
+    lmeta = _encode_for(lengths, w, nulls_meta={})
+    assert lmeta is not None
+    return {"enc": "bytes", "lengths": lmeta, "bufs": [w.add(data)], **nulls_meta}
+
+
+def _encode_column(arr: pa.Array, field: pa.Field, w: _BufferWriter) -> dict:
+    t = field.type
+    n = len(arr)
+    vb = _validity_bytes(arr)
+    nulls_meta = {"nulls": w.add(vb), "null_count": arr.null_count} if vb else {}
+
+    if pa.types.is_boolean(t):
+        filled = pc.fill_null(arr, False) if arr.null_count else arr
+        bits = np.packbits(
+            filled.to_numpy(zero_copy_only=False), bitorder="little"
+        ).tobytes()
+        return {"enc": "bool", "bufs": [w.add(bits)], **nulls_meta}
+
+    if pa.types.is_integer(t):
+        vals = _int_values(arr, 0)
+        # uint64 beyond int63 can't ride the int64 packing space
+        if t == pa.uint64() and n and int(vals.max()) > (1 << 62):
+            meta = None
+        else:
+            meta = _encode_for(vals, w, nulls_meta=nulls_meta)
+        if meta is not None:
+            return meta
+        return {"enc": "raw", "bufs": [w.add(np.ascontiguousarray(vals).tobytes())],
+                **nulls_meta}
+
+    if _is_fixed_raw(t):
+        filled = pc.fill_null(arr, 0) if arr.null_count else arr
+        vals = filled.to_numpy(zero_copy_only=False)
+        dt = _np_dtype_for(t)
+        vals = vals.view(dt) if vals.dtype.itemsize == np.dtype(dt).itemsize else vals.astype(dt)
+        return {"enc": "raw", "bufs": [w.add(np.ascontiguousarray(vals).tobytes())],
+                **nulls_meta}
+
+    if pa.types.is_string(t) or pa.types.is_large_string(t) \
+            or pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        fill = "" if (pa.types.is_string(t) or pa.types.is_large_string(t)) else b""
+        filled = pc.fill_null(arr, fill) if arr.null_count else arr
+        # dictionary decision on a sample: cheap, avoids encoding high-
+        # cardinality chunks twice
+        if n >= _DICT_SAMPLE:
+            sample = filled.slice(0, _DICT_SAMPLE)
+            uniq = pc.count_distinct(sample).as_py()
+            if uniq / _DICT_SAMPLE <= _DICT_MAX_RATIO:
+                denc = pc.dictionary_encode(filled)
+                dvals = denc.dictionary
+                if len(dvals) <= n * _DICT_MAX_RATIO:
+                    indices = denc.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+                    # indices are bounded by the chunk row count → always packable
+                    imeta = _encode_for(indices, w, nulls_meta={})
+                    assert imeta is not None
+                    vmeta = _encode_bytes_like(dvals.cast(t), w, {})
+                    return {"enc": "dict", "indices": imeta, "values": vmeta,
+                            "n_values": len(dvals), **nulls_meta}
+        return _encode_bytes_like(filled, w, nulls_meta)
+
+    if pa.types.is_fixed_size_list(t):
+        child_t = t.value_type
+        if _is_fixed_raw(child_t) and arr.null_count == 0:
+            flat = arr.flatten()
+            if flat.null_count == 0:
+                filled = flat
+                vals = filled.to_numpy(zero_copy_only=False)
+                dt = _np_dtype_for(child_t)
+                vals = vals.view(dt) if vals.dtype.itemsize == np.dtype(dt).itemsize else vals.astype(dt)
+                return {"enc": "fsl",
+                        "bufs": [w.add(np.ascontiguousarray(vals).tobytes())]}
+        return _encode_ipc(arr, field, w)
+
+    return _encode_ipc(arr, field, w)
+
+
+def write_lsf_table(table: pa.Table, path: str, *, config=None) -> int:
+    """Write one ``.lsf`` file; returns its byte size."""
+    from lakesoul_tpu.io.formats import storage_options_of
+    from lakesoul_tpu.io.object_store import filesystem_for
+
+    chunk_rows = getattr(config, "max_row_group_size", None) or 250_000
+    opts = dict(storage_options_of(config)) if config is not None else {}
+    fs, p = filesystem_for(path, opts, write=True)
+    with fs.open(p, "wb") as f:
+        w = _BufferWriter(f)
+        w.write(MAGIC)
+        chunks = []
+        n = len(table)
+        for start in range(0, n, chunk_rows):
+            sub = table.slice(start, chunk_rows)
+            cols = []
+            for i, field in enumerate(table.schema):
+                col = sub.column(i)
+                arr = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+                if isinstance(arr, pa.ChunkedArray):  # 0-chunk edge
+                    arr = pa.array([], type=field.type)
+                meta = _encode_column(arr, field, w)
+                meta["name"] = field.name
+                cols.append(meta)
+            chunks.append({"n_rows": len(sub), "columns": cols})
+        footer = {
+            "version": VERSION,
+            "n_rows": n,
+            "schema": base64.b64encode(table.schema.serialize().to_pybytes()).decode(),
+            "chunks": chunks,
+        }
+        payload = json.dumps(footer, separators=(",", ":")).encode()
+        w.write(payload)
+        w.write(struct.pack("<I", len(payload)))
+        w.write(MAGIC)
+        size = w.offset
+    return size
+
+
+# --------------------------------------------------------------------- read
+
+
+class LsfFile:
+    """One open ``.lsf`` file: zero-copy over mmap for local files, a single
+    GET for remote ones (the page cache fronts remote stores elsewhere).
+
+    ``footer_only=True`` skips the data entirely — for remote files that is
+    two small ranged GETs (tail probe + footer), the parquet
+    ``read_metadata`` equivalent for count-only scans and schema reads."""
+
+    def __init__(self, path: str, storage_options: dict | None = None,
+                 *, footer_only: bool = False):
+        from lakesoul_tpu.io.formats import _is_local
+        from lakesoul_tpu.io.object_store import filesystem_for
+
+        fs, p = filesystem_for(path, storage_options)
+        self._buf = None
+        if _is_local(fs):
+            mm = pa.memory_map(p, "r")
+            self._mm = mm  # the buffer views this mapping; keep it alive
+            self._buf = mm.read_buffer(mm.size())
+        elif not footer_only:
+            self._buf = pa.py_buffer(fs.cat_file(p))
+        if self._buf is not None:
+            size = self._buf.size
+            if size < 16 or self._buf.slice(0, 4).to_pybytes() != MAGIC \
+                    or self._buf.slice(size - 4, 4).to_pybytes() != MAGIC:
+                raise IOError_(f"{path}: not an LSF file")
+            (flen,) = struct.unpack("<I", self._buf.slice(size - 8, 4).to_pybytes())
+            footer = json.loads(self._buf.slice(size - 8 - flen, flen).to_pybytes())
+        else:
+            size = fs.size(p)
+            if size < 16:
+                raise IOError_(f"{path}: not an LSF file")
+            tail = fs.cat_file(p, start=size - 8, end=size)
+            if tail[4:] != MAGIC:
+                raise IOError_(f"{path}: not an LSF file")
+            (flen,) = struct.unpack("<I", tail[:4])
+            footer = json.loads(fs.cat_file(p, start=size - 8 - flen, end=size - 8))
+        if footer.get("version") != VERSION:
+            raise IOError_(f"{path}: unsupported LSF version {footer.get('version')}")
+        self._footer = footer
+        self.schema = pa.ipc.read_schema(
+            pa.py_buffer(base64.b64decode(footer["schema"]))
+        )
+        self.n_rows = footer["n_rows"]
+
+    # ------------------------------------------------------------- decoding
+    def _np(self, buf_loc, dtype, count=None) -> np.ndarray:
+        off, ln = buf_loc
+        mv = memoryview(self._buf.slice(off, ln))
+        return np.frombuffer(mv, dtype=dtype, count=count if count is not None else -1)
+
+    def _validity(self, meta, n):
+        if "nulls" not in meta:
+            return None, 0
+        off, ln = meta["nulls"]
+        return self._buf.slice(off, ln), meta.get("null_count", -1)
+
+    def _decode_ints(self, meta, n) -> np.ndarray:
+        enc = meta["enc"]
+        if enc == "for":
+            if meta["width"] == 0:
+                return np.full(n, meta["base"], dtype=np.int64)
+            packed = self._np(meta["bufs"][0], np.uint8)
+            return native.bitunpack64(packed, n, meta["base"], meta["width"])
+        if enc == "dfor":
+            if n == 0:
+                return np.empty(0, dtype=np.int64)
+            packed = self._np(meta["bufs"][0], np.uint8)
+            deltas = native.bitunpack64(packed, n - 1, meta["base"], meta["width"])
+            out = np.empty(n, dtype=np.int64)
+            out[0] = meta["first"]
+            np.cumsum(deltas, out=out[1:])
+            out[1:] += meta["first"]
+            return out
+        raise IOError_(f"not an int encoding: {enc}")
+
+    def _fixed_from_np(self, vals: np.ndarray, t: pa.DataType, n, validity, null_count):
+        dt = _np_dtype_for(t)
+        if vals.dtype != dt:
+            vals = vals.astype(dt) if vals.dtype.itemsize != np.dtype(dt).itemsize else vals.view(dt)
+        vals = np.ascontiguousarray(vals)
+        return pa.Array.from_buffers(
+            t, n, [validity, pa.py_buffer(vals)], null_count=null_count
+        )
+
+    def _decode_bytes_like(self, meta, t, n, validity, null_count):
+        lengths = self._decode_ints(meta["lengths"], n)
+        offs = np.empty(n + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lengths, out=offs[1:])
+        off, ln = meta["bufs"][0]
+        data = self._buf.slice(off, ln)
+        large = pa.types.is_large_string(t) or pa.types.is_large_binary(t)
+        if not large:
+            offs = offs.astype(np.int32)
+        return pa.Array.from_buffers(
+            t, n, [validity, pa.py_buffer(np.ascontiguousarray(offs)), data],
+            null_count=null_count,
+        )
+
+    def _decode_column(self, meta, field: pa.Field, n) -> pa.Array:
+        t = field.type
+        enc = meta["enc"]
+        validity, null_count = self._validity(meta, n)
+        if enc == "ipc":
+            off, ln = meta["bufs"][0]
+            with pa.ipc.open_stream(self._buf.slice(off, ln)) as rd:
+                return rd.read_all().column(0).combine_chunks()
+        if enc == "bool":
+            off, ln = meta["bufs"][0]
+            return pa.Array.from_buffers(
+                pa.bool_(), n, [validity, self._buf.slice(off, ln)],
+                null_count=null_count,
+            )
+        if enc in ("for", "dfor"):
+            return self._fixed_from_np(self._decode_ints(meta, n), t, n, validity, null_count)
+        if enc == "raw":
+            off, ln = meta["bufs"][0]
+            if pa.types.is_integer(t) or _is_fixed_raw(t):
+                # zero-copy: wrap the mmap slice directly
+                return pa.Array.from_buffers(
+                    t, n, [validity, self._buf.slice(off, ln)], null_count=null_count
+                )
+            raise IOError_(f"raw encoding for unsupported type {t}")
+        if enc == "bytes":
+            return self._decode_bytes_like(meta, t, n, validity, null_count)
+        if enc == "dict":
+            nvals = meta["n_values"]
+            values = self._decode_bytes_like(meta["values"], t, nvals, None, 0)
+            indices = self._decode_ints(meta["indices"], n)
+            if null_count:
+                mask = ~np.unpackbits(
+                    self._np(meta["nulls"], np.uint8), bitorder="little"
+                )[:n].astype(bool)
+                iarr = pa.array(indices, mask=mask)
+            else:
+                iarr = pa.array(indices)
+            return pc.take(values, iarr)
+        if enc == "fsl":
+            off, ln = meta["bufs"][0]
+            child_t = t.value_type
+            nchild = n * t.list_size
+            child = pa.Array.from_buffers(
+                child_t, nchild, [None, self._buf.slice(off, ln)], null_count=0
+            )
+            return pa.FixedSizeListArray.from_arrays(child, t.list_size)
+        raise IOError_(f"unknown LSF encoding {enc!r}")
+
+    # -------------------------------------------------------------- reading
+    def _chunk_table(self, chunk, columns: list[str] | None) -> pa.Table:
+        n = chunk["n_rows"]
+        by_name = {m["name"]: m for m in chunk["columns"]}
+        fields, arrays = [], []
+        names = columns if columns is not None else [f.name for f in self.schema]
+        for name in names:
+            meta = by_name.get(name)
+            if meta is None:
+                continue  # schema evolution: caller null-fills
+            field = self.schema.field(name)
+            arrays.append(self._decode_column(meta, field, n))
+            fields.append(field)
+        if not fields:
+            # projection to zero stored columns: row count still matters
+            return pa.table({"__dummy": pa.nulls(n)}).select([])
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+    def read(self, columns: list[str] | None = None, arrow_filter=None) -> pa.Table:
+        parts = [self._chunk_table(c, columns) for c in self._footer["chunks"]]
+        if not parts:
+            names = columns if columns is not None else [f.name for f in self.schema]
+            fields = [self.schema.field(n) for n in names if n in self.schema.names]
+            return pa.schema(fields).empty_table()
+        if parts[0].num_columns == 0:
+            # zero stored columns projected (schema evolution): concat_tables
+            # would collapse the row count the caller null-fills from
+            total = sum(p.num_rows for p in parts)
+            return pa.table({"__dummy": pa.nulls(total)}).select([])
+        out = pa.concat_tables(parts)
+        if arrow_filter is not None:
+            try:
+                out = out.filter(arrow_filter)
+            except (pa.lib.ArrowInvalid, KeyError):
+                pass  # best-effort pushdown; caller re-applies exactly
+        return out
+
+    def iter_batches(self, columns=None, arrow_filter=None, batch_size=65_536):
+        for chunk in self._footer["chunks"]:
+            t = self._chunk_table(chunk, columns)
+            if arrow_filter is not None:
+                try:
+                    t = t.filter(arrow_filter)
+                except (pa.lib.ArrowInvalid, KeyError):
+                    pass
+            yield from t.to_batches(max_chunksize=batch_size)

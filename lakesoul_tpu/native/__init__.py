@@ -54,6 +54,8 @@ def _bind(lib) -> None:
     lib.ls_merge_bytes.argtypes = [u8p, i64p, i64p, ctypes.c_int32, i64p, u8p]
     lib.ls_merge_bytes.restype = ctypes.c_int64
     lib.ls_pack_bits.argtypes = [u8p, u8p, ctypes.c_int64, ctypes.c_int64]
+    lib.ls_bitpack64.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, u8p]
+    lib.ls_bitunpack64.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i64p]
 
 
 def get_lib():
@@ -82,7 +84,10 @@ def get_lib():
             lib = ctypes.CDLL(_LIB_PATH)
             _bind(lib)
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so missing newer symbols whose
+            # mtime defeated the staleness check — fall back to numpy rather
+            # than crash the first hash/merge call
             _lib = None
     return _lib
 
@@ -176,6 +181,55 @@ def merge_sorted_runs_bytes(data: np.ndarray, offsets: np.ndarray, run_offsets: 
         _ptr(tail, ctypes.c_uint8),
     )
     return order, tail.astype(bool), int(groups)
+
+
+def bitpack64(vals: np.ndarray, base: int, width: int) -> np.ndarray:
+    """Frame-of-reference bit-pack int64 values into an LSB-first bitstream
+    (LSF columnar format).  Returns the packed bytes INCLUDING 8 padding
+    bytes the decoder's word-wide loads require."""
+    n = len(vals)
+    nbytes = (n * width + 7) // 8 + 8
+    out = np.zeros(nbytes, dtype=np.uint8)
+    lib = get_lib()
+    if lib is not None and width > 0:
+        lib.ls_bitpack64(
+            _ptr(np.ascontiguousarray(vals, np.int64), ctypes.c_int64),
+            n, base, width, _ptr(out, ctypes.c_uint8),
+        )
+        return out
+    if width <= 0 or n == 0:
+        return out
+    # numpy fallback: build the [n, width] bit matrix and packbits it
+    deltas = (vals.astype(np.int64) - np.int64(base)).view(np.uint64)
+    shifts = np.arange(width, dtype=np.uint64)
+    bits = ((deltas[:, None] >> shifts[None, :]) & np.uint64(1)).astype(np.uint8)
+    packed = np.packbits(bits.reshape(-1), bitorder="little")
+    out[: len(packed)] = packed
+    return out
+
+
+def bitunpack64(buf: np.ndarray, n: int, base: int, width: int) -> np.ndarray:
+    """Inverse of :func:`bitpack64` → int64 array of n values."""
+    out = np.empty(n, dtype=np.int64)
+    if width <= 0:
+        out.fill(base)
+        return out
+    lib = get_lib()
+    if lib is not None:
+        lib.ls_bitunpack64(
+            _ptr(np.ascontiguousarray(buf, np.uint8), ctypes.c_uint8),
+            n, base, width, _ptr(out, ctypes.c_int64),
+        )
+        return out
+    if n == 0:
+        return out
+    nbits = n * width
+    bits = np.unpackbits(buf[: (nbits + 7) // 8], bitorder="little")[:nbits]
+    bits = bits.reshape(n, width).astype(np.uint64)
+    shifts = np.arange(width, dtype=np.uint64)
+    deltas = (bits << shifts[None, :]).sum(axis=1, dtype=np.uint64)
+    base_u = np.uint64(base & 0xFFFFFFFFFFFFFFFF)  # two's complement bits
+    return (deltas + base_u).view(np.int64).copy()
 
 
 def pack_bits(bits: np.ndarray) -> np.ndarray:

@@ -275,6 +275,60 @@ int64_t ls_merge_bytes(const uint8_t* data, const int64_t* offsets,
   return groups;
 }
 
+// ----------------------------------------------------- FOR bit-packing
+// Frame-of-reference bit-packing for the LSF columnar format (the role of
+// Vortex's lightweight integer encodings, rust/lakesoul-io/src/file_format/
+// vortex.rs): values are stored as (v - base) in `width` bits each, LSB-first
+// in one contiguous bitstream.  Caller guarantees max-min < 2^63 and provides
+// an output buffer padded with >= 8 spare zero bytes (the inner loop reads/
+// writes whole 64-bit words).
+void ls_bitpack64(const int64_t* vals, int64_t n, int64_t base, int32_t width,
+                  uint8_t* out) {
+  if (width <= 0) return;
+  const uint64_t mask =
+      width >= 64 ? ~0ull : ((1ull << width) - 1);
+  int64_t bitpos = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const uint64_t v = ((uint64_t)vals[i] - (uint64_t)base) & mask;
+    const int64_t byte = bitpos >> 3;
+    const int shift = (int)(bitpos & 7);
+    uint64_t cur;
+    std::memcpy(&cur, out + byte, 8);
+    cur |= v << shift;
+    std::memcpy(out + byte, &cur, 8);
+    if (shift + width > 64) {
+      out[byte + 8] |= (uint8_t)(v >> (64 - shift));
+    }
+    bitpos += width;
+  }
+}
+
+// Inverse of ls_bitpack64.  `in` must have >= 8 readable bytes past the last
+// encoded bit (the encoder pads); out[i] = base + decoded delta.
+void ls_bitunpack64(const uint8_t* in, int64_t n, int64_t base, int32_t width,
+                    int64_t* out) {
+  if (width <= 0) {
+    for (int64_t i = 0; i < n; i++) out[i] = base;
+    return;
+  }
+  const uint64_t mask =
+      width >= 64 ? ~0ull : ((1ull << width) - 1);
+  int64_t bitpos = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t byte = bitpos >> 3;
+    const int shift = (int)(bitpos & 7);
+    uint64_t lo;
+    std::memcpy(&lo, in + byte, 8);
+    uint64_t v = lo >> shift;
+    if (shift + width > 64) {
+      const uint64_t hi = in[byte + 8];
+      v |= hi << (64 - shift);
+    }
+    out[i] = (int64_t)((uint64_t)base + (v & mask));
+    bitpos += width;
+  }
+}
+
 // --------------------------------------------------------------- bit pack
 // bits [n, d] {0,1} bytes → packed [n, ceil(d/8)] MSB-first (np.packbits).
 void ls_pack_bits(const uint8_t* bits, uint8_t* out, int64_t n, int64_t d) {
