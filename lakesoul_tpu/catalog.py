@@ -841,11 +841,61 @@ class LakeSoulScan:
             return self._projected_empty_table()
         return pa.concat_tables(tables, promote_options="default").combine_chunks()
 
-    def to_batches(self, num_threads: int | None = None) -> Iterator[pa.RecordBatch]:
+    def to_batches(
+        self, num_threads: int | None = None, skip_rows: int = 0
+    ) -> Iterator[pa.RecordBatch]:
         """Stream record batches.  ``num_threads > 1`` decodes scan units on a
         thread pool (unit order preserved, bounded in-flight window) — parquet
         decode and the numpy merge release the GIL, so multi-core hosts
-        overlap unit decodes like the reference's per-bucket tokio readers."""
+        overlap unit decodes like the reference's per-bucket tokio readers.
+
+        ``skip_rows`` resumes mid-stream (the LoaderCheckpoint path): whole
+        scan units before the position are dropped via metadata/footer row
+        counts — no decode — when the count is provably the delivered count
+        (no filter/vector search/limit, unit needs no PK merge: the same
+        conditions as the count_rows shortcut); the residual lands inside one
+        unit and only that prefix is decoded and discarded."""
+        if skip_rows:
+            skip = skip_rows
+            fast_ok = (
+                self._filter is None
+                and self._vector_search is None
+                and not self._cache
+                and self._limit is None
+                # CDC: compacted files retain delete rows the decode drops,
+                # so footer counts != delivered counts unless deletes are kept
+                and (self._table.info.cdc_column is None or self._keep_cdc_deletes)
+            )
+            if fast_ok:
+                from lakesoul_tpu.io.formats import format_for
+
+                opts = self._table.catalog.storage_options
+                units = self.scan_plan()
+                idx = 0
+                while idx < len(units) and skip:
+                    u = units[idx]
+                    if u.primary_keys:
+                        break  # merge can collapse rows: count != delivered
+                    n = sum(format_for(f).count_rows(f, opts) for f in u.data_files)
+                    if n > skip:
+                        break
+                    skip -= n
+                    idx += 1
+                inner = self._iter_unit_batches(units[idx:], num_threads)
+            else:
+                inner = self.to_batches(num_threads)
+            try:
+                for b in inner:
+                    if skip >= len(b):
+                        skip -= len(b)
+                        continue
+                    if skip:
+                        b = b.slice(skip)
+                        skip = 0
+                    yield b
+            finally:
+                inner.close()  # stop producer threads on early exit
+            return
         if self._limit is not None:
             inner = self._replace(_limit=None).to_batches(num_threads)
             remaining = self._limit
@@ -882,7 +932,12 @@ class LakeSoulScan:
                 self._table.catalog._scan_cache_put(key, hit)
             yield from hit.to_batches(max_chunksize=self._batch_size)
             return
-        units = self.scan_plan()
+        yield from self._iter_unit_batches(self.scan_plan(), num_threads)
+
+    def _iter_unit_batches(
+        self, units: list[ScanPlanPartition], num_threads: int | None
+    ) -> Iterator[pa.RecordBatch]:
+        """Batch production over an explicit unit list (unit order preserved)."""
         if not num_threads or num_threads <= 1 or len(units) <= 1:
             budget = self._table.io_config().memory_budget_bytes
             for unit in units:
@@ -979,7 +1034,13 @@ class LakeSoulScan:
         when there is no filter/vector search and no unit needs a PK merge —
         merge can collapse duplicate keys, so merged units must be counted
         the slow way (a single PK file may itself hold duplicates)."""
-        if self._filter is None and self._vector_search is None and not self._cache:
+        if (
+            self._filter is None
+            and self._vector_search is None
+            and not self._cache
+            # CDC: compacted files retain delete rows the decode drops
+            and (self._table.info.cdc_column is None or self._keep_cdc_deletes)
+        ):
             units = self.scan_plan()
             if all(not u.primary_keys for u in units):
                 from lakesoul_tpu.io.formats import format_for

@@ -192,19 +192,15 @@ class JaxBatchIterator:
             return False
 
         try:
-            # resume: discard the rows the checkpoint already delivered —
-            # the scan's unit order is deterministic, so the row offset is a
-            # complete position
+            # resume: the scan's unit order is deterministic, so the
+            # checkpoint's delivered-row count is a complete position; the
+            # scan skips whole units via metadata row counts without decoding
+            # them and decode-discards only the residual prefix of one unit
             skip = self._checkpoint.rows_delivered if self._checkpoint else 0
             rb = _Rebatcher(self._scan._batch_size)
-            for arrow_batch in self._scan.to_batches(num_threads=self._io_threads):
-                if skip:
-                    n = len(arrow_batch)
-                    if skip >= n:
-                        skip -= n
-                        continue
-                    arrow_batch = arrow_batch.slice(skip)
-                    skip = 0
+            for arrow_batch in self._scan.to_batches(
+                num_threads=self._io_threads, skip_rows=skip
+            ):
                 for window in rb.push(arrow_batch):
                     if not put((len(window), self._host_batch(window))):
                         return

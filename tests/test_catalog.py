@@ -485,6 +485,82 @@ class TestLoaderCheckpoint:
         # position already includes it
         assert ckpt.rows_delivered == 128
 
+    def test_resume_fast_skips_whole_units_without_decode(self, catalog, monkeypatch):
+        """Resume drops whole pre-position units via metadata row counts —
+        they must never be decoded (footer-count fast path)."""
+        import lakesoul_tpu.catalog as cat_mod
+        from lakesoul_tpu.data.jax_iter import LoaderCheckpoint
+
+        t = catalog.create_table("lck_fast", SCHEMA, primary_keys=["id"], hash_bucket_num=4)
+        n = 2000
+        t.write_arrow(pa.table({
+            "id": np.arange(n), "v": np.arange(n, dtype=np.float64), "name": ["x"] * n,
+        }))
+        t.compact()  # steady state: 4 single-file units, merge-skip (no PKs)
+        units = t.scan().scan_plan()
+        assert len(units) == 4 and all(not u.primary_keys for u in units)
+
+        ckpt = LoaderCheckpoint()
+        seen = []
+        it = iter(t.scan().batch_size(100).to_jax_iter(device_put=False, checkpoint=ckpt))
+        # consume past at least one whole unit (largest unit < 700 rows here)
+        while ckpt.rows_delivered < 700:
+            seen.extend(next(it)["id"].tolist())
+        state = ckpt.to_json()
+        it.close()  # the "crash": stop the abandoned producer thread
+
+        decoded = []
+        real = cat_mod.iter_scan_unit_batches
+
+        def spy(files, pks, **kw):
+            decoded.append(list(files))
+            return real(files, pks, **kw)
+
+        monkeypatch.setattr(cat_mod, "iter_scan_unit_batches", spy)
+        for b in t.scan().batch_size(100).to_jax_iter(
+            device_put=False, drop_remainder=False,
+            checkpoint=LoaderCheckpoint.from_json(state),
+        ):
+            seen.extend(b["id"].tolist())
+        assert sorted(seen) == list(range(n))  # exactly-once across the resume
+        assert len(decoded) < len(units)  # at least one unit skipped undecoded
+
+    def test_cdc_table_skips_footer_fast_paths(self, catalog):
+        """Compacted CDC files retain delete rows the decode drops, so the
+        footer-count shortcuts (count_rows AND checkpoint fast-skip) must not
+        trust them: counts would misalign the resume position."""
+        from lakesoul_tpu.data.jax_iter import LoaderCheckpoint
+
+        t = catalog.create_table("lck_cdc", SCHEMA, primary_keys=["id"], cdc=True)
+        rk = t.info.cdc_column
+        n = 600
+        t.write_arrow(pa.table({
+            "id": np.arange(n), "v": np.zeros(n), "name": ["x"] * n,
+            rk: ["insert"] * n,
+        }))
+        t.write_arrow(pa.table({
+            "id": np.arange(0, 100), "v": np.zeros(100), "name": ["x"] * 100,
+            rk: ["delete"] * 100,
+        }))
+        t.compact()
+        units = t.scan().scan_plan()
+        assert all(not u.primary_keys for u in units)  # compacted heads
+        live = n - 100
+        assert t.scan().count_rows() == live  # shortcut must not overcount
+        ckpt = LoaderCheckpoint()
+        seen = []
+        it = iter(t.scan().batch_size(64).to_jax_iter(device_put=False, checkpoint=ckpt))
+        for _ in range(3):
+            seen.extend(next(it)["id"].tolist())
+        state = ckpt.to_json()
+        it.close()
+        for b in t.scan().batch_size(64).to_jax_iter(
+            device_put=False, drop_remainder=False,
+            checkpoint=LoaderCheckpoint.from_json(state),
+        ):
+            seen.extend(b["id"].tolist())
+        assert sorted(seen) == list(range(100, n))  # exactly-once, no replay
+
     def test_table_version_change_rejected(self, catalog):
         from lakesoul_tpu.data.jax_iter import LoaderCheckpoint
         from lakesoul_tpu.errors import ConfigError
