@@ -1,0 +1,192 @@
+"""Micro-benchmark harness (the reference's ``benches/`` role:
+rust/lakesoul-io/benches/{spill_bench,partial_merge,cache_bench}.rs and the
+criterion harnesses).  Each leg prints one JSON line with a throughput figure
+so regressions are visible run-to-run.
+
+    python benchmarks/micro.py merge      # k-way MOR merge rows/s
+    python benchmarks/micro.py formats    # decode rows/s per physical format
+    python benchmarks/micro.py cache      # page-cache hit/miss throughput
+    python benchmarks/micro.py spill      # writer auto-flush (spill) + re-merge
+    python benchmarks/micro.py all
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pyarrow as pa
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _emit(leg: str, value: float, unit: str, **extra) -> None:
+    print(json.dumps({"bench": leg, "value": round(value, 1), "unit": unit, **extra}))
+
+
+def bench_merge(n_rows: int = 2_000_000, n_files: int = 8) -> None:
+    """k-way merge throughput over sorted int64 PK runs (partial_merge.rs
+    role): overlapping key ranges, UseLast semantics."""
+    from lakesoul_tpu.io.merge import merge_sorted_tables
+
+    rng = np.random.default_rng(0)
+    per = n_rows // n_files
+    tables = []
+    for i in range(n_files):
+        keys = np.sort(rng.choice(n_rows * 2, per, replace=False)).astype(np.int64)
+        tables.append(pa.table({
+            "id": keys,
+            "v": rng.normal(size=per),
+        }))
+    start = time.perf_counter()
+    out = merge_sorted_tables(tables, ["id"])
+    dt = time.perf_counter() - start
+    _emit("merge_i64_kway", n_rows / dt, "rows/s", files=n_files, out_rows=len(out))
+
+    # string keys exercise the bytes loser tree
+    s_tables = [
+        t.set_column(0, "id", pa.array([f"k{v:012d}" for v in t.column("id").to_pylist()]))
+        for t in (tb.slice(0, per // 4) for tb in tables)
+    ]
+    n_s = sum(len(t) for t in s_tables)
+    start = time.perf_counter()
+    merge_sorted_tables(s_tables, ["id"])
+    dt = time.perf_counter() - start
+    _emit("merge_bytes_kway", n_s / dt, "rows/s", files=n_files)
+
+
+def bench_formats(n_rows: int = 2_000_000) -> None:
+    """Decode throughput per registered physical format (file_format.rs role;
+    LSF is the Vortex-role fast-decode format)."""
+    from lakesoul_tpu.io.config import IOConfig
+    from lakesoul_tpu.io.formats import format_by_name
+
+    rng = np.random.default_rng(0)
+    cols = {"id": np.arange(n_rows, dtype=np.int64)}
+    for i in range(8):
+        cols[f"f{i}"] = rng.normal(size=n_rows).astype(np.float32)
+    t = pa.table(cols)
+    with tempfile.TemporaryDirectory() as d:
+        for name, ext in (("parquet", ".parquet"), ("arrow", ".arrow"), ("lsf", ".lsf")):
+            fmt = format_by_name(name)
+            path = os.path.join(d, f"t{ext}")
+            cfg = IOConfig(compression="lz4")
+            start = time.perf_counter()
+            size = fmt.write_table(t, path, config=cfg)
+            wdt = time.perf_counter() - start
+            best = 1e9
+            for _ in range(3):
+                start = time.perf_counter()
+                got = fmt.read_table(path)
+                best = min(best, time.perf_counter() - start)
+            assert got.num_rows == n_rows
+            _emit(
+                f"decode_{name}", n_rows / best, "rows/s",
+                write_rows_per_s=round(n_rows / wdt, 1), file_mb=round(size / 1e6, 1),
+            )
+
+
+def bench_cache(n_objects: int = 64, obj_kb: int = 256) -> None:
+    """Read-through page cache throughput, cold vs warm (cache_bench.rs
+    role), over a latency-injected store."""
+    import fsspec
+    from fsspec.implementations.memory import MemoryFileSystem
+
+    class SlowFS(MemoryFileSystem):
+        protocol = "slowmicro"
+        latency = 0.005
+
+        def cat_file(self, *a, **k):
+            time.sleep(self.latency)
+            return super().cat_file(*a, **k)
+
+    if "slowmicro" not in fsspec.registry:
+        fsspec.register_implementation("slowmicro", SlowFS, clobber=True)
+    from lakesoul_tpu.io.object_store import cache_stats, filesystem_for
+
+    mem = fsspec.filesystem("slowmicro")
+    blob = os.urandom(obj_kb * 1024)
+    # MemoryFileSystem only strips its own "memory://" prefix: custom-protocol
+    # keys must be written in the same URL form they are read with
+    for i in range(n_objects):
+        mem.pipe_file(f"slowmicro://micro/o{i}", blob)
+    cache_dir = tempfile.mkdtemp(prefix="lsf_cache_bench")
+    opts = {"lakesoul.cache_dir": cache_dir}
+    try:
+        def sweep():
+            total = 0
+            start = time.perf_counter()
+            for i in range(n_objects):
+                fs, p = filesystem_for(f"slowmicro://micro/o{i}", opts)
+                total += len(fs.cat_file(p))
+            return total / (time.perf_counter() - start)
+
+        cold = sweep()
+        warm = sweep()
+        stats = cache_stats(opts)
+        _emit(
+            "page_cache", warm / 1e6, "MB/s warm",
+            cold_mb_per_s=round(cold / 1e6, 1), hit_rate=round(stats["hit_rate"], 4),
+        )
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def bench_spill(n_rows: int = 1_000_000) -> None:
+    """Writer byte-budget auto-flush (sorted spill runs) + bounded streaming
+    re-merge (spill_bench.rs role)."""
+    from lakesoul_tpu import LakeSoulCatalog
+
+    with tempfile.TemporaryDirectory() as wh:
+        catalog = LakeSoulCatalog(wh)
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table(
+            "spill", schema, primary_keys=["id"], hash_bucket_num=1,
+            properties={"lakesoul.memory_budget_bytes": str(8 << 20)},
+        )
+        rng = np.random.default_rng(0)
+        ids = rng.permutation(n_rows).astype(np.int64)
+        vals = rng.normal(size=n_rows)
+        start = time.perf_counter()
+        # several commits of overlapping sorted runs: the staged files ARE
+        # the spill runs; the bounded streaming merger re-combines them
+        step = n_rows // 8
+        for lo in range(0, n_rows, step):
+            t.write_arrow(pa.table(
+                {"id": ids[lo:lo + step], "v": vals[lo:lo + step]}, schema=schema
+            ))
+        wdt = time.perf_counter() - start
+        files = [f for u in t.scan().scan_plan() for f in u.data_files]
+        start = time.perf_counter()
+        rows = sum(len(b) for b in t.scan().batch_size(65_536).to_batches())
+        rdt = time.perf_counter() - start
+        assert rows == n_rows
+        _emit(
+            "spill_write", n_rows / wdt, "rows/s",
+            runs=len(files), read_rows_per_s=round(n_rows / rdt, 1),
+        )
+
+
+LEGS = {
+    "merge": bench_merge,
+    "formats": bench_formats,
+    "cache": bench_cache,
+    "spill": bench_spill,
+}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    legs = list(LEGS) if which == "all" else [which]
+    for leg in legs:
+        LEGS[leg]()
+
+
+if __name__ == "__main__":
+    main()

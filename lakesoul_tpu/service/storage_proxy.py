@@ -13,6 +13,13 @@ GET honors HTTP Range requests (``bytes=a-b``, open-ended and suffix forms)
 with 206/416 responses, so parquet readers can pull footers and column
 chunks through the proxy exactly like against S3.
 
+Upstream mode (the reference's full re-proxy shape, aws.rs + the pingora
+discovery loop at main.rs:306-347): pass ``upstream=S3Upstream(...)`` and
+object operations forward to a real S3 endpoint as SigV4-signed requests
+(service/sigv4.py) over DNS-discovered, health-checked backends with
+failover (service/s3_upstream.py) — the proxy terminates client auth, the
+upstream sees only the proxy's credentials.
+
   GET  /<namespace>/<table>/<file...>   → object bytes (Range supported)
   PUT  /<namespace>/<table>/<file...>   → store object (streamed)
   HEAD                                   → existence/size
@@ -60,13 +67,14 @@ def parse_range(header: str | None, size: int) -> tuple[int, int] | None:
 
 class StorageProxy:
     def __init__(self, catalog, *, jwt_secret: str | None = None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, upstream=None):
         self.catalog = catalog
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
         from lakesoul_tpu.service.jwt import UserRegistry
 
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
+        self.upstream = upstream  # S3Upstream | None
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -112,10 +120,44 @@ class StorageProxy:
                     self.send_error(403, f"no access to {ns}/{table}")
                     return False
                 self._object_path = f"{table_path}/{'/'.join(parts[2:])}"
+                # decoded form: the upstream client re-encodes exactly once
+                # for both the wire and the SigV4 canonical path
+                import urllib.parse
+
+                self._object_key = urllib.parse.unquote("/".join(parts))
                 return True
+
+            # ---------------------------------------------- upstream relays
+            def _relay_upstream(self, method, **kw) -> None:
+                """Forward to the signed S3 upstream and stream the answer."""
+                try:
+                    status, headers, resp = proxy.upstream.request(
+                        method, self._object_key, **kw
+                    )
+                except OSError as e:
+                    self.send_error(502, f"upstream unavailable: {e}")
+                    return
+                try:
+                    self.send_response(status)
+                    for h in ("Content-Length", "Content-Range", "Accept-Ranges",
+                              "ETag", "Last-Modified"):
+                        if h in headers:
+                            self.send_header(h, headers[h])
+                    self.end_headers()
+                    if method != "HEAD":
+                        while True:
+                            piece = resp.read(CHUNK)
+                            if not piece:
+                                break
+                            self.wfile.write(piece)
+                finally:
+                    resp.close()
 
             def do_GET(self):
                 if not self._authorize():
+                    return
+                if proxy.upstream is not None:
+                    self._relay_upstream("GET", range_header=self.headers.get("Range"))
                     return
                 fs, p = filesystem_for(self._object_path, proxy.catalog.storage_options)
                 try:
@@ -154,6 +196,9 @@ class StorageProxy:
             def do_HEAD(self):
                 if not self._authorize():
                     return
+                if proxy.upstream is not None:
+                    self._relay_upstream("HEAD")
+                    return
                 fs, p = filesystem_for(self._object_path, proxy.catalog.storage_options)
                 if not fs.exists(p):
                     self.send_error(404, "not found")
@@ -165,6 +210,20 @@ class StorageProxy:
 
             def do_PUT(self):
                 if not self._authorize():
+                    return
+                if proxy.upstream is not None:
+                    length = int(self.headers.get("Content-Length", 0))
+
+                    def chunks():
+                        remaining = length
+                        while remaining > 0:
+                            piece = self.rfile.read(min(CHUNK, remaining))
+                            if not piece:
+                                break
+                            remaining -= len(piece)
+                            yield piece
+
+                    self._relay_upstream("PUT", body_iter=chunks(), content_length=length)
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 parent = self._object_path.rsplit("/", 1)[0]

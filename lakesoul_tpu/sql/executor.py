@@ -304,6 +304,8 @@ class SqlSession:
         stmt = parse(sql)
         if isinstance(stmt, ast.Select):
             return self._select(stmt)
+        if isinstance(stmt, ast.SetOp):
+            return self._set_op(stmt)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
         if isinstance(stmt, ast.CreateTable):
@@ -376,6 +378,55 @@ class SqlSession:
         raise SqlError(f"unknown procedure {stmt.procedure!r}")
 
     # ------------------------------------------------------------------- DQL
+    def _query(self, stmt) -> pa.Table:
+        """Select or set-op subtree (derived tables / CTE bodies)."""
+        if isinstance(stmt, ast.SetOp):
+            return self._set_op(stmt)
+        return self._select(stmt)
+
+    def _set_op(self, stmt: ast.SetOp) -> pa.Table:
+        """UNION [ALL] / INTERSECT / EXCEPT with SQL set semantics (distinct
+        rows unless ALL; NULLs compare equal for dedup, like DISTINCT)."""
+        left = self._query(stmt.left)
+        right = self._query(stmt.right)
+        if left.num_columns != right.num_columns:
+            raise SqlError(
+                f"set operation arity mismatch: {left.num_columns} vs "
+                f"{right.num_columns} columns"
+            )
+        right = right.rename_columns(left.column_names)
+        if stmt.op == "union":
+            # permissive: unify types across branches (int + double → double)
+            out = pa.concat_tables([left, right], promote_options="permissive")
+            if not stmt.all:
+                # same dedup the SELECT DISTINCT path uses (NULLs group equal)
+                out = out.group_by(out.column_names).aggregate([])
+        else:
+            import pandas as pd
+
+            lf = left.to_pandas()
+            rf = right.to_pandas()
+            if stmt.op == "intersect":
+                merged = lf.drop_duplicates().merge(rf.drop_duplicates(), how="inner")
+            else:  # except
+                probe = lf.drop_duplicates().merge(
+                    rf.drop_duplicates(), how="left", indicator=True
+                )
+                merged = probe[probe["_merge"] == "left_only"].drop(columns="_merge")
+            out = pa.Table.from_pandas(merged, preserve_index=False)
+            # pandas may widen types (e.g. int64 → float64 when NaNs appear)
+            try:
+                out = out.cast(left.schema)
+            except (pa.lib.ArrowInvalid, pa.lib.ArrowNotImplementedError):
+                pass
+        if stmt.order_by:
+            out = out.sort_by(
+                [(c, "descending" if d else "ascending") for c, d in stmt.order_by]
+            )
+        if stmt.limit is not None:
+            out = out.slice(0, stmt.limit)
+        return out
+
     def _select(self, stmt: ast.Select) -> pa.Table:
         # bare `SELECT count(*) FROM t`: metadata-only count, no decode
         # (reference: EmptyScanCountExec shortcut)
@@ -404,7 +455,7 @@ class SqlSession:
         residual_nodes: list = []
         key_renames: dict[str, str] = {}
         if stmt.from_subquery is not None:
-            table = self._select(stmt.from_subquery)
+            table = self._query(stmt.from_subquery)
             if stmt.where is not None:
                 residual_nodes = [stmt.where]
         else:
@@ -449,7 +500,7 @@ class SqlSession:
         # ---- joins (hash joins on Arrow compute; right side may be derived)
         for j in stmt.joins:
             if j.subquery is not None:
-                right = self._select(j.subquery)
+                right = self._query(j.subquery)
             else:
                 right = self.catalog.table(j.table, self.namespace).to_arrow()
             rname = j.alias or j.table
@@ -656,7 +707,7 @@ class SqlSession:
                 )
             raise SqlError(f"unknown function {expr.name!r}")
         if isinstance(expr, ast.ScalarSubquery):
-            sub = self._select(expr.select)
+            sub = self._query(expr.select)
             if sub.num_columns != 1 or len(sub) > 1:
                 raise SqlError("scalar subquery must produce one value")
             return sub.column(0)[0] if len(sub) else pa.scalar(None)
@@ -716,7 +767,7 @@ class SqlSession:
         if isinstance(node, ast.InList):
             return pc.is_in(table.column(node.col), value_set=pa.array(node.values))
         if isinstance(node, ast.InSubquery):
-            sub = self._select(node.select)
+            sub = self._query(node.select)
             if sub.num_columns != 1:
                 raise SqlError("IN (SELECT ...) must produce one column")
             mask = pc.is_in(
@@ -724,7 +775,7 @@ class SqlSession:
             )
             return pc.invert(mask) if node.negated else mask
         if isinstance(node, ast.Exists):
-            exists = len(self._select(node.select)) > 0
+            exists = len(self._query(node.select)) > 0
             return pa.scalar(exists != node.negated)
         if isinstance(node, ast.Like):
             mask = pc.match_like(table.column(node.col), node.pattern)
@@ -758,7 +809,7 @@ class SqlSession:
         t = self.catalog.table(stmt.table, self.namespace)
         schema = t.schema
         if stmt.select is not None:
-            src = self._select(stmt.select)
+            src = self._query(stmt.select)
             names = stmt.columns or list(src.column_names)
             if len(names) != src.num_columns:
                 raise SqlError(

@@ -39,7 +39,7 @@ KEYWORDS = {
     "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
     "column", "call", "update", "set", "delete", "join", "inner", "left", "on",
     "case", "when", "then", "else", "end", "having", "between", "like",
-    "substring", "for",
+    "substring", "for", "union", "intersect", "except", "all",
 }
 
 
@@ -216,6 +216,19 @@ class Select:
 
 
 @dataclass
+class SetOp:
+    """UNION [ALL] / INTERSECT / EXCEPT over two selects (or nested set
+    ops).  ORDER BY / LIMIT written after the chain bind to the whole."""
+
+    op: str  # union | intersect | except
+    left: "Select | SetOp"
+    right: "Select | SetOp"
+    all: bool = False
+    order_by: list[tuple[str, bool]] = field(default_factory=list)
+    limit: int | None = None
+
+
+@dataclass
 class Insert:
     table: str
     columns: list[str]
@@ -329,7 +342,8 @@ class Parser:
         if tok is None:
             raise SqlError("empty statement")
         dispatch = {
-            "select": self.parse_select,
+            "select": self.parse_query,
+            "with": self.parse_with,
             "insert": self.parse_insert,
             "create": self.parse_create,
             "drop": self.parse_drop,
@@ -349,6 +363,66 @@ class Parser:
                 raise SqlError(f"unexpected trailing token {extra.value!r}")
         return stmt
 
+    def parse_with(self):
+        """``WITH name AS (query), ... <query>``: non-recursive CTEs, inlined
+        as derived tables (each reference becomes a from_subquery — the way
+        lightweight planners lower WITH).  Earlier CTEs are visible to later
+        ones and to the main query, including inside subqueries and joins."""
+        self.expect("kw", "with")
+        ctes: dict[str, object] = {}
+        while True:
+            name = self.ident()
+            self.expect("kw", "as")
+            self.expect("op", "(")
+            body = self.parse_query()
+            self.expect("op", ")")
+            inline_ctes(body, ctes)
+            ctes[name] = body
+            if not self.accept("op", ","):
+                break
+        stmt = self.parse_query()
+        inline_ctes(stmt, ctes)
+        return stmt
+
+    def parse_query(self):
+        """One query: a SELECT, optionally chained with UNION [ALL] /
+        INTERSECT / EXCEPT.  Standard precedence: INTERSECT binds tighter
+        than UNION/EXCEPT; same-level operators are left-associative."""
+        left = self._parse_intersect_chain()
+        while True:
+            tok = self.peek()
+            if tok is None or tok.kind != "kw" or tok.value not in ("union", "except"):
+                break
+            op = self.next().value
+            all_ = bool(self.accept("kw", "all"))
+            right = self._parse_intersect_chain()
+            left = SetOp(op, left, right, all_)
+        return self._hoist_trailing_order(left)
+
+    def _parse_intersect_chain(self):
+        left = self.parse_select()
+        while self.peek() is not None and self.peek().kind == "kw" \
+                and self.peek().value == "intersect":
+            self.next()
+            all_ = bool(self.accept("kw", "all"))
+            left = SetOp("intersect", left, self.parse_select(), all_)
+        return left
+
+    @staticmethod
+    def _hoist_trailing_order(node):
+        """ORDER BY / LIMIT written after a set-op chain were consumed by the
+        rightmost SELECT's parse — per SQL they bind to the whole query."""
+        if not isinstance(node, SetOp):
+            return node
+        rightmost = node
+        while isinstance(rightmost.right, SetOp):
+            rightmost = rightmost.right
+        tail = rightmost.right
+        if tail.order_by or tail.limit is not None:
+            node.order_by, node.limit = tail.order_by, tail.limit
+            tail.order_by, tail.limit = [], None
+        return node
+
     def parse_select(self) -> Select:
         self.expect("kw", "select")
         distinct = bool(self.accept("kw", "distinct"))
@@ -364,7 +438,7 @@ class Parser:
         self.expect("kw", "from")
         sel = Select(items=items, star=star, table="", distinct=distinct)
         if self.accept("op", "("):
-            sel.from_subquery = self.parse_select()
+            sel.from_subquery = self.parse_query()
             self.expect("op", ")")
             self.accept("kw", "as")
             if self.peek() is not None and self.peek().kind == "ident":
@@ -392,7 +466,7 @@ class Parser:
             jt = ""
             alias = None
             if self.accept("op", "("):
-                sub = self.parse_select()
+                sub = self.parse_query()
                 self.expect("op", ")")
                 self.accept("kw", "as")
                 alias = self.ident()
@@ -509,7 +583,7 @@ class Parser:
             # (SELECT ...) scalar subquery or parenthesized expression
             nxt = self.peek()
             if nxt is not None and nxt.kind == "kw" and nxt.value == "select":
-                sub = self.parse_select()
+                sub = self.parse_query()
                 self.expect("op", ")")
                 return ScalarSubquery(sub)
             e = self._arith_expr()
@@ -590,7 +664,7 @@ class Parser:
             return NotOp(self._bool_factor())
         if self.accept("kw", "exists"):
             self.expect("op", "(")
-            sub = self.parse_select()
+            sub = self.parse_query()
             self.expect("op", ")")
             return Exists(sub)
         if self.peek() and self.peek().kind == "op" and self.peek().value == "(":
@@ -656,7 +730,7 @@ class Parser:
         self.expect("op", "(")
         nxt = self.peek()
         if nxt is not None and nxt.kind == "kw" and nxt.value == "select":
-            sub = self.parse_select()
+            sub = self.parse_query()
             self.expect("op", ")")
             if simple_col is None:
                 raise SqlError("IN (SELECT ...) requires a plain column")
@@ -713,7 +787,7 @@ class Parser:
             self.expect("op", ")")
         nxt = self.peek()
         if nxt is not None and nxt.kind == "kw" and nxt.value == "select":
-            return Insert(table, columns, [], select=self.parse_select())
+            return Insert(table, columns, [], select=self.parse_query())
         self.expect("kw", "values")
         rows = [self._value_list()]
         while self.accept("op", ","):
@@ -842,6 +916,34 @@ class Parser:
     def parse_describe(self) -> Describe:
         self.expect("kw", "describe")
         return Describe(self.ident())
+
+
+def inline_ctes(node, ctes: dict, _seen: set | None = None) -> None:
+    """Substitute CTE references throughout a query AST: any Select/Join
+    whose source name matches a CTE becomes a derived table over the CTE
+    body.  Walks every dataclass field (subqueries in WHERE/HAVING/items
+    included); shared CTE bodies are visited once."""
+    import dataclasses
+
+    if not ctes or not dataclasses.is_dataclass(node) or isinstance(node, Token):
+        return
+    seen = _seen if _seen is not None else set()
+    if id(node) in seen:
+        return
+    seen.add(id(node))
+    if isinstance(node, Select) and node.from_subquery is None and node.table in ctes:
+        node.from_subquery = ctes[node.table]
+        node.from_alias = node.from_alias or node.table
+        node.table = ""
+    if isinstance(node, Join) and node.subquery is None and node.table in ctes:
+        node.subquery = ctes[node.table]
+        node.alias = node.alias or node.table
+        node.table = ""
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        for item in (v if isinstance(v, list) else [v]):
+            if dataclasses.is_dataclass(item) and not isinstance(item, Token):
+                inline_ctes(item, ctes, seen)
 
 
 def parse(sql: str):

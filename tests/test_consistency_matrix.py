@@ -88,6 +88,18 @@ def write_arrow_ipc_format(catalog, name):
     t.upsert(to_table(UPSERT_ROWS))
 
 
+def write_lsf_format(catalog, name):
+    """Same logical writes through the native LSF columnar format, with the
+    upsert in parquet → a mixed lsf+parquet partition read transparently."""
+    t = catalog.create_table(
+        name, SCHEMA, primary_keys=["id"], hash_bucket_num=2,
+        properties={"lakesoul.file_format": "lsf"},
+    )
+    t.write_arrow(to_table(ROWS))
+    t.set_properties({"lakesoul.file_format": "parquet"})
+    catalog.table(name).upsert(to_table(UPSERT_ROWS))
+
+
 def write_debezium(catalog, name):
     from lakesoul_tpu.streaming import DebeziumJsonConsumer
 
@@ -168,6 +180,7 @@ WRITERS = {
     "checkpointed": write_checkpointed,
     "flight": write_flight,
     "ipc_format": write_arrow_ipc_format,
+    "lsf_format": write_lsf_format,
     "debezium": write_debezium,
 }
 READERS = {

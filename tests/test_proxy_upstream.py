@@ -1,0 +1,347 @@
+"""Storage-proxy upstream mode: SigV4 re-signing + DNS discovery/failover.
+
+Role parity with rust/lakesoul-s3-proxy/src/aws.rs (outbound signing) and
+main.rs:306-347 (DNS backend discovery).  Signing is anchored against AWS's
+published SigV4 example signatures; the e2e leg runs a local fake S3 server
+that CRYPTOGRAPHICALLY verifies every forwarded request's signature.
+"""
+
+import datetime
+import hashlib
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.service import sigv4
+from lakesoul_tpu.service.jwt import Claims
+from lakesoul_tpu.service.s3_upstream import DnsDiscovery, S3Upstream, S3UpstreamConfig
+from lakesoul_tpu.service.storage_proxy import StorageProxy
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+AK, SK = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+class TestSigV4Vectors:
+    """AWS's published example signatures — byte-exact anchors."""
+
+    def test_iam_list_users_example(self):
+        # docs.aws.amazon.com "Signature Version 4 signing process" example
+        headers = sigv4.sign_request(
+            "GET",
+            "iam.amazonaws.com",
+            "/",
+            "Action=ListUsers&Version=2010-05-08",
+            {"content-type": "application/x-www-form-urlencoded; charset=utf-8"},
+            sigv4.EMPTY_SHA256,
+            access_key=AK,
+            secret_key=SK,
+            region="us-east-1",
+            service="iam",
+            timestamp=datetime.datetime(2015, 8, 30, 12, 36, 0),
+        )
+        assert headers["Authorization"] == (
+            "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20150830/us-east-1/iam/"
+            "aws4_request, SignedHeaders=content-type;host;x-amz-date, Signature="
+            "5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7"
+        )
+
+    def test_s3_get_object_example(self):
+        # the S3 "GET object with Range" documented example (NB: the S3 docs
+        # use the slash variant of the example secret, the IAM docs the plus)
+        headers = sigv4.sign_request(
+            "GET",
+            "examplebucket.s3.amazonaws.com",
+            "/test.txt",
+            "",
+            {"range": "bytes=0-9"},
+            sigv4.EMPTY_SHA256,
+            access_key=AK,
+            secret_key="wJalrXUtnFEMI/K7MDENG/bPxRfiCYEXAMPLEKEY",
+            region="us-east-1",
+            service="s3",
+            timestamp=datetime.datetime(2013, 5, 24, 0, 0, 0),
+        )
+        assert headers["Authorization"].endswith(
+            "Signature=f0e8bdb87c964420e857bd35b5d6ed310bd44f0170aba48dd91039c6036bdb41"
+        )
+
+    def test_verify_roundtrip_and_tamper(self):
+        headers = sigv4.sign_request(
+            "PUT", "s3.local:9000", "/bkt/a/b.parquet", "", {},
+            hashlib.sha256(b"xyz").hexdigest(),
+            access_key="AK1", secret_key="shh", region="eu-west-1",
+        )
+        ok = sigv4.verify_signature(
+            "PUT", "/bkt/a/b.parquet", "", headers, secret_keys={"AK1": "shh"}
+        )
+        assert ok
+        assert not sigv4.verify_signature(
+            "PUT", "/bkt/a/OTHER", "", headers, secret_keys={"AK1": "shh"}
+        )
+        assert not sigv4.verify_signature(
+            "PUT", "/bkt/a/b.parquet", "", headers, secret_keys={"AK1": "wrong"}
+        )
+
+
+class TestDnsDiscovery:
+    def test_health_filter_and_round_robin(self):
+        d = DnsDiscovery(
+            "svc.local", 9000,
+            resolver=lambda h, p: ["10.0.0.1", "10.0.0.2", "10.0.0.3"],
+            health_check=lambda ip, p: ip != "10.0.0.2",
+        )
+        assert d.backends() == ["10.0.0.1", "10.0.0.3"]
+        picks = {d.pick() for _ in range(4)}
+        assert picks == {"10.0.0.1", "10.0.0.3"}
+
+    def test_failure_markdown_and_recovery(self):
+        now = [0.0]
+        d = DnsDiscovery(
+            "svc.local", 9000,
+            resolver=lambda h, p: ["a", "b"],
+            health_check=lambda ip, p: True,
+            retry_down_s=10.0,
+            clock=lambda: now[0],
+        )
+        d.report_failure("a")
+        assert {d.pick() for _ in range(3)} == {"b"}
+        now[0] = 11.0  # past retry window: "a" comes back
+        assert {d.pick() for _ in range(4)} == {"a", "b"}
+
+    def test_all_down_fails_open(self):
+        d = DnsDiscovery(
+            "svc.local", 9000,
+            resolver=lambda h, p: ["a", "b"],
+            health_check=lambda ip, p: True,
+        )
+        d.report_failure("a")
+        d.report_failure("b")
+        assert d.pick() in ("a", "b")  # degraded, not refusing service
+
+    def test_refresh_interval_and_dns_change(self):
+        now = [0.0]
+        answers = [["a"], ["c", "d"]]
+        d = DnsDiscovery(
+            "svc.local", 9000,
+            resolver=lambda h, p: answers[0 if now[0] < 30 else 1],
+            health_check=lambda ip, p: True,
+            refresh_interval_s=30.0,
+            clock=lambda: now[0],
+        )
+        assert d.backends() == ["a"]
+        now[0] = 5.0
+        assert d.backends() == ["a"]  # cached within the interval
+        now[0] = 31.0
+        assert d.backends() == ["c", "d"]  # re-resolved
+
+
+class FakeS3:
+    """Minimal S3 endpoint verifying every request's SigV4 signature."""
+
+    def __init__(self, access_key=AK, secret_key=SK):
+        self.objects: dict[str, bytes] = {}
+        self.bad_auth = 0
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _check(self) -> bool:
+                path, _, query = self.path.partition("?")
+                if not sigv4.verify_signature(
+                    self.command, path, query, dict(self.headers),
+                    secret_keys={access_key: secret_key},
+                ):
+                    store.bad_auth += 1
+                    self.send_error(403, "SignatureDoesNotMatch")
+                    return False
+                return True
+
+            def do_PUT(self):
+                if not self._check():
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                store.objects[self.path] = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("ETag", '"fake"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if not self._check():
+                    return
+                body = store.objects.get(self.path)
+                if body is None:
+                    self.send_error(404, "NoSuchKey")
+                    return
+                rng = self.headers.get("Range")
+                status = 200
+                if rng and rng.startswith("bytes="):
+                    lo_s, _, hi_s = rng[6:].partition("-")
+                    lo = int(lo_s)
+                    hi = int(hi_s) + 1 if hi_s else len(body)
+                    sliced = body[lo:hi]
+                    status = 206
+                    self.send_response(status)
+                    self.send_header(
+                        "Content-Range", f"bytes {lo}-{hi - 1}/{len(body)}"
+                    )
+                    body = sliced
+                else:
+                    self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_HEAD(self):
+                if not self._check():
+                    return
+                body = store.objects.get(self.path)
+                if body is None:
+                    self.send_error(404, "NoSuchKey")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    def start(self):
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def fake_s3():
+    s = FakeS3()
+    s.start()
+    yield s
+    s.stop()
+
+
+def _upstream(fake_s3, resolver=None, **kw) -> S3Upstream:
+    cfg = S3UpstreamConfig(
+        endpoint=f"http://s3.internal:{fake_s3.port}",
+        bucket="lake",
+        access_key=AK,
+        secret_key=SK,
+        connect_timeout_s=2.0,
+        **kw,
+    )
+    return S3Upstream(
+        cfg, resolver=resolver or (lambda h, p: ["127.0.0.1"]),
+        health_check=lambda ip, p: True,
+    )
+
+
+class TestS3Upstream:
+    def test_put_get_head_signed(self, fake_s3):
+        up = _upstream(fake_s3)
+        status, _, resp = up.request("PUT", "ns/t/file.bin", body=b"payload-123")
+        resp.read()
+        resp.close()
+        assert status == 200
+        assert fake_s3.objects["/lake/ns/t/file.bin"] == b"payload-123"
+        status, headers, resp = up.request("GET", "ns/t/file.bin")
+        got = resp.read()
+        resp.close()
+        assert status == 200 and got == b"payload-123"
+        status, headers, resp = up.request(
+            "GET", "ns/t/file.bin", range_header="bytes=2-4"
+        )
+        got = resp.read()
+        resp.close()
+        assert status == 206 and got == b"ylo"
+        assert fake_s3.bad_auth == 0
+
+    def test_failover_to_live_backend(self, fake_s3):
+        # first backend refuses connections (127.0.0.2 same port, nothing
+        # listening); the request reports it down and retries on the live one
+        up = _upstream(fake_s3, resolver=lambda h, p: ["127.0.0.2", "127.0.0.1"])
+        # force round robin to start on the dead backend
+        for _ in range(4):
+            status, _, resp = up.request("PUT", "k", body=b"x", retries=2)
+            resp.read()
+            resp.close()
+            assert status == 200
+        assert "127.0.0.2" in up.discovery._down_until
+
+
+class TestProxyUpstreamE2E:
+    """Client → RBAC/JWT proxy → SigV4-signed upstream → fake S3."""
+
+    @pytest.fixture()
+    def env(self, tmp_warehouse, fake_s3):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        catalog.create_table("t", SCHEMA)
+        proxy = StorageProxy(
+            catalog, jwt_secret="pxy", upstream=_upstream(fake_s3)
+        )
+        proxy.start()
+        token = proxy.jwt_server.create_token(Claims(sub="u", group="public"))
+        yield catalog, proxy, token, fake_s3
+        proxy.stop()
+
+    def _req(self, url, method="GET", token=None, data=None, rng=None):
+        req = urllib.request.Request(url, method=method, data=data)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        if rng:
+            req.add_header("Range", rng)
+        return urllib.request.urlopen(req, timeout=5)
+
+    def test_put_get_range_head_via_proxy(self, env):
+        catalog, proxy, token, fake = env
+        url = f"http://127.0.0.1:{proxy.port}/default/t/part-1.lsf"
+        body = bytes(range(256)) * 4
+        resp = self._req(url, method="PUT", token=token, data=body)
+        assert resp.status == 200
+        # the object landed on the upstream under the bucket prefix, and the
+        # upstream verified the proxy's signature on every hop
+        assert fake.objects["/lake/default/t/part-1.lsf"] == body
+        assert fake.bad_auth == 0
+        got = self._req(url, token=token).read()
+        assert got == body
+        r = self._req(url, token=token, rng="bytes=10-19")
+        assert r.status == 206 and r.read() == body[10:20]
+        h = self._req(url, method="HEAD", token=token)
+        assert int(h.headers["Content-Length"]) == len(body)
+
+    def test_escaped_key_signed_consistently(self, env):
+        """Keys needing URI escaping must be encoded ONCE — the same form is
+        signed and sent, or real S3 answers SignatureDoesNotMatch."""
+        catalog, proxy, token, fake = env
+        url = f"http://127.0.0.1:{proxy.port}/default/t/part%20a%2Bb.lsf"
+        body = b"spaced-key-bytes"
+        resp = self._req(url, method="PUT", token=token, data=body)
+        assert resp.status == 200
+        assert fake.bad_auth == 0
+        stored = [k for k in fake.objects if "part" in k]
+        assert stored == ["/lake/default/t/part%20a%2Bb.lsf"]
+        got = self._req(url, token=token).read()
+        assert got == body
+
+    def test_rbac_still_enforced_before_upstream(self, env):
+        catalog, proxy, token, fake = env
+        url = f"http://127.0.0.1:{proxy.port}/default/t/x.bin"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._req(url)  # no token: refused before any upstream traffic
+        assert e.value.code == 401
+
+    def test_missing_object_404(self, env):
+        catalog, proxy, token, fake = env
+        url = f"http://127.0.0.1:{proxy.port}/default/t/ghost"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._req(url, token=token)
+        assert e.value.code == 404

@@ -626,3 +626,110 @@ class TestConsoleManagement:
         assert "versions_dropped=" in cleaned
         stats = c.execute("cache-stats")
         assert "hits=" in stats
+
+
+class TestCtesAndSetOps:
+    """WITH (CTEs, inlined as derived tables) + UNION/INTERSECT/EXCEPT."""
+
+    def test_union_all_and_distinct(self, session):
+        out = session.execute(
+            "SELECT city FROM users WHERE age > 29"
+            " UNION ALL SELECT city FROM users WHERE city = 'sf'"
+        )
+        assert sorted(out.column("city").to_pylist()) == ["sf", "sf", "sf", "sf"]
+        out = session.execute(
+            "SELECT city FROM users WHERE age > 29"
+            " UNION SELECT city FROM users WHERE city = 'sf'"
+        )
+        assert out.column("city").to_pylist() == ["sf"]
+
+    def test_union_order_limit_bind_to_whole(self, session):
+        out = session.execute(
+            "SELECT id FROM users WHERE id <= 2"
+            " UNION ALL SELECT id FROM users WHERE id >= 3"
+            " ORDER BY id DESC LIMIT 3"
+        )
+        assert out.column("id").to_pylist() == [4, 3, 2]
+
+    def test_union_type_promotion_and_rename(self, session):
+        out = session.execute(
+            "SELECT id, age FROM users WHERE id = 1"
+            " UNION ALL SELECT id, 99.5 FROM users WHERE id = 2"
+        )
+        got = sorted(out.to_pylist(), key=lambda r: r["id"])
+        assert got[0]["age"] == 30.0 and got[1]["age"] == 99.5
+
+    def test_intersect_and_except(self, session):
+        out = session.execute(
+            "SELECT city FROM users INTERSECT SELECT city FROM users WHERE age < 29"
+        )
+        assert sorted(out.column("city").to_pylist()) == ["nyc"]
+        out = session.execute(
+            "SELECT city FROM users EXCEPT SELECT city FROM users WHERE age < 29"
+        )
+        assert out.column("city").to_pylist() == ["sf"]
+
+    def test_cte_basic_and_chained(self, session):
+        out = session.execute(
+            "WITH sf AS (SELECT id, age FROM users WHERE city = 'sf'),"
+            " old_sf AS (SELECT id FROM sf WHERE age > 31)"
+            " SELECT id FROM old_sf"
+        )
+        assert out.column("id").to_pylist() == [3]
+
+    def test_cte_in_join_and_subquery(self, session):
+        out = session.execute(
+            "WITH sf AS (SELECT id, city FROM users WHERE city = 'sf')"
+            " SELECT u.id FROM users u INNER JOIN sf ON u.id = sf.id ORDER BY u.id"
+        )
+        assert out.column("id").to_pylist() == [1, 3]
+        out = session.execute(
+            "WITH young AS (SELECT id FROM users WHERE age < 29)"
+            " SELECT name FROM users WHERE id IN (SELECT id FROM young) ORDER BY name"
+        )
+        assert out.column("name").to_pylist() == ["bob", "dave"]
+
+    def test_cte_aggregate_body_and_union_body(self, session):
+        out = session.execute(
+            "WITH per_city AS ("
+            "   SELECT city, count(*) AS n FROM users GROUP BY city"
+            " ) SELECT city FROM per_city WHERE n = 2 ORDER BY city"
+        )
+        assert out.column("city").to_pylist() == ["nyc", "sf"]
+        out = session.execute(
+            "WITH both_ends AS ("
+            "   SELECT id FROM users WHERE id = 1 UNION ALL"
+            "   SELECT id FROM users WHERE id = 4"
+            " ) SELECT count(*) AS n FROM both_ends"
+        )
+        assert out.column("n").to_pylist() == [2]
+
+    def test_insert_from_union_select(self, session):
+        session.execute(
+            "CREATE TABLE ids (id bigint PRIMARY KEY) WITH (hashBucketNum = '1')"
+        )
+        session.execute(
+            "INSERT INTO ids SELECT id FROM users WHERE id = 1"
+            " UNION ALL SELECT id FROM users WHERE id = 2"
+        )
+        out = session.execute("SELECT id FROM ids ORDER BY id")
+        assert out.column("id").to_pylist() == [1, 2]
+
+    def test_intersect_binds_tighter_than_union(self, session):
+        """Standard SQL precedence: a UNION (b INTERSECT c), not
+        (a UNION b) INTERSECT c."""
+        stmt = parse("SELECT x FROM a UNION SELECT x FROM b INTERSECT SELECT x FROM c")
+        assert stmt.op == "union"
+        assert stmt.right.op == "intersect"
+        # semantic check: sf rows survive even though absent from the
+        # INTERSECT operands
+        out = session.execute(
+            "SELECT city FROM users WHERE city = 'sf'"
+            " UNION SELECT city FROM users WHERE age < 29"
+            " INTERSECT SELECT city FROM users WHERE age = 25"
+        )
+        assert sorted(out.column("city").to_pylist()) == ["nyc", "sf"]
+
+    def test_set_op_arity_mismatch(self, session):
+        with pytest.raises(SqlError, match="arity"):
+            session.execute("SELECT id, age FROM users UNION SELECT id FROM users")
