@@ -180,6 +180,26 @@ def _substrait_to_expression(data: bytes) -> pc.Expression:
     return next(iter(bound.expressions.values()))
 
 
+def zone_conjuncts(flt: "Filter | None") -> list[tuple[str, str, Any]]:
+    """Simple (col, op, value) conjuncts provably AND-ed at the top of the
+    tree — the zone-map contract: each is a NECESSARY condition, so a file
+    chunk whose min/max stats refute any one of them cannot contain a
+    matching row (LSF chunk skipping; the role of parquet's row-group
+    statistics pruning)."""
+    out: list[tuple[str, str, Any]] = []
+    if flt is None:
+        return out
+    if flt.op == "and":
+        for a in flt.args:
+            out.extend(zone_conjuncts(a))
+        return out
+    if flt.op in _COMPARES and flt.op != "ne" and flt.col is not None:
+        out.append((flt.col, flt.op, flt.value))
+    elif flt.op == "in" and flt.col is not None:
+        out.append((flt.col, "in", list(flt.value)))
+    return out
+
+
 def filter_column_names(flt: "Filter | None") -> set[str] | None:
     """Columns a filter references, or None when unknowable (substrait
     payloads are opaque) — callers must then be conservative: no pre-merge

@@ -47,8 +47,14 @@ class PhysicalFormat:
         columns: list[str] | None = None,
         arrow_filter=None,
         storage_options: dict | None = None,
+        zone_predicates=None,
     ) -> pa.Table:
         """Materialize one file with projection + best-effort filter pushdown.
+
+        ``zone_predicates`` are (col, op, value) conjuncts each NECESSARY for
+        a row to match — formats with chunk statistics (LSF) skip chunks they
+        refute; parquet ignores them (its row-group pruning rides
+        ``arrow_filter``).
 
         Schema evolution: a file written before add_columns may be missing
         projected columns — they are dropped here and null-filled by the
@@ -74,6 +80,7 @@ class PhysicalFormat:
         arrow_filter=None,
         batch_size: int = 65_536,
         storage_options: dict | None = None,
+        zone_predicates=None,
     ) -> Iterator[pa.RecordBatch]:
         """Stream one file without materializing it (streaming MOR path)."""
         fs, p = filesystem_for(path, storage_options)
@@ -146,7 +153,8 @@ class ParquetFormat(PhysicalFormat):
         # remote stores, which the block cache absorbs.
         return pads.ParquetFragmentScanOptions(pre_buffer=False)
 
-    def read_table(self, path, *, columns=None, arrow_filter=None, storage_options=None):
+    def read_table(self, path, *, columns=None, arrow_filter=None,
+                   storage_options=None, zone_predicates=None):
         if arrow_filter is not None:
             return super().read_table(
                 path, columns=columns, arrow_filter=arrow_filter,
@@ -239,13 +247,16 @@ class LsfFormat(PhysicalFormat):
 
         return LsfFile(path, storage_options)
 
-    def read_table(self, path, *, columns=None, arrow_filter=None, storage_options=None):
-        return self._open(path, storage_options).read(columns, arrow_filter)
+    def read_table(self, path, *, columns=None, arrow_filter=None,
+                   storage_options=None, zone_predicates=None):
+        return self._open(path, storage_options).read(
+            columns, arrow_filter, zone_predicates=zone_predicates
+        )
 
     def iter_batches(self, path, *, columns=None, arrow_filter=None,
-                     batch_size=65_536, storage_options=None):
+                     batch_size=65_536, storage_options=None, zone_predicates=None):
         yield from self._open(path, storage_options).iter_batches(
-            columns, arrow_filter, batch_size
+            columns, arrow_filter, batch_size, zone_predicates=zone_predicates
         )
 
     def read_schema(self, path, storage_options=None):

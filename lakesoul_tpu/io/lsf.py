@@ -219,8 +219,10 @@ def _encode_column(arr: pa.Array, field: pa.Field, w: _BufferWriter) -> dict:
             meta = _encode_for(vals, w, nulls_meta=nulls_meta)
         if meta is not None:
             return meta
+        # raw ints still carry min/max so zone maps can skip the chunk
+        stats = [int(vals.min()), int(vals.max())] if n else None
         return {"enc": "raw", "bufs": [w.add(np.ascontiguousarray(vals).tobytes())],
-                **nulls_meta}
+                "stats": stats, **nulls_meta}
 
     if _is_fixed_raw(t):
         filled = pc.fill_null(arr, 0) if arr.null_count else arr
@@ -354,6 +356,7 @@ class LsfFile:
             pa.py_buffer(base64.b64decode(footer["schema"]))
         )
         self.n_rows = footer["n_rows"]
+        self.chunks_decoded = 0
 
     # ------------------------------------------------------------- decoding
     def _np(self, buf_loc, dtype, count=None) -> np.ndarray:
@@ -459,7 +462,40 @@ class LsfFile:
         raise IOError_(f"unknown LSF encoding {enc!r}")
 
     # -------------------------------------------------------------- reading
+    @staticmethod
+    def _zone_refutes(chunk, zone_predicates) -> bool:
+        """True when chunk int stats PROVE no row can match (every predicate
+        is a necessary condition — see filters.zone_conjuncts).  Columns
+        without stats (floats, strings, all-null) never refute."""
+        if not zone_predicates:
+            return False
+        stats_by_col = {
+            m["name"]: m.get("stats") for m in chunk["columns"]
+        }
+        for col, op, value in zone_predicates:
+            st = stats_by_col.get(col)
+            if not st:
+                continue
+            lo, hi = st
+            try:
+                if op == "eq" and (value < lo or value > hi):
+                    return True
+                if op == "lt" and lo >= value:
+                    return True
+                if op == "le" and lo > value:
+                    return True
+                if op == "gt" and hi <= value:
+                    return True
+                if op == "ge" and hi < value:
+                    return True
+                if op == "in" and all(v < lo or v > hi for v in value):
+                    return True
+            except TypeError:
+                continue  # non-numeric predicate against int stats
+        return False
+
     def _chunk_table(self, chunk, columns: list[str] | None) -> pa.Table:
+        self.chunks_decoded += 1  # observability: zone-map tests pin skips
         n = chunk["n_rows"]
         by_name = {m["name"]: m for m in chunk["columns"]}
         fields, arrays = [], []
@@ -476,12 +512,17 @@ class LsfFile:
             return pa.table({"__dummy": pa.nulls(n)}).select([])
         return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
-    def read(self, columns: list[str] | None = None, arrow_filter=None) -> pa.Table:
-        parts = [self._chunk_table(c, columns) for c in self._footer["chunks"]]
-        if not parts:
+    def read(self, columns: list[str] | None = None, arrow_filter=None,
+             zone_predicates=None) -> pa.Table:
+        chunks = [
+            c for c in self._footer["chunks"]
+            if not self._zone_refutes(c, zone_predicates)
+        ]
+        if not chunks:
             names = columns if columns is not None else [f.name for f in self.schema]
             fields = [self.schema.field(n) for n in names if n in self.schema.names]
             return pa.schema(fields).empty_table()
+        parts = [self._chunk_table(c, columns) for c in chunks]
         if parts[0].num_columns == 0:
             # zero stored columns projected (schema evolution): concat_tables
             # would collapse the row count the caller null-fills from
@@ -495,8 +536,11 @@ class LsfFile:
                 pass  # best-effort pushdown; caller re-applies exactly
         return out
 
-    def iter_batches(self, columns=None, arrow_filter=None, batch_size=65_536):
+    def iter_batches(self, columns=None, arrow_filter=None, batch_size=65_536,
+                     zone_predicates=None):
         for chunk in self._footer["chunks"]:
+            if self._zone_refutes(chunk, zone_predicates):
+                continue
             t = self._chunk_table(chunk, columns)
             if arrow_filter is not None:
                 try:

@@ -29,7 +29,7 @@ import pyarrow.dataset as pads
 logger = logging.getLogger(__name__)
 
 from lakesoul_tpu.io.config import DEFAULT_MEMORY_BUDGET
-from lakesoul_tpu.io.filters import Filter, filter_column_names
+from lakesoul_tpu.io.filters import Filter, filter_column_names, zone_conjuncts
 from lakesoul_tpu.io.formats import format_for
 from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
 
@@ -40,21 +40,25 @@ def _read_one_file(
     columns: list[str] | None,
     arrow_filter,
     storage_options: dict | None,
+    zone_predicates=None,
 ) -> pa.Table:
     return format_for(path).read_table(
-        path, columns=columns, arrow_filter=arrow_filter, storage_options=storage_options
+        path, columns=columns, arrow_filter=arrow_filter,
+        storage_options=storage_options, zone_predicates=zone_predicates,
     )
 
 
 @dataclass
 class _UnitPlan:
     """Resolved read plan for one scan unit (projection closure, file schema,
-    pushdown-safe file filter, exact post-merge filter)."""
+    pushdown-safe file filter, exact post-merge filter, zone conjuncts for
+    stats-based chunk skipping)."""
 
     read_columns: list[str] | None
     file_schema: pa.Schema | None
     file_filter: object | None
     post_filter: object | None
+    zone_predicates: list = None
 
 
 def _plan_unit(
@@ -116,7 +120,8 @@ def _plan_unit(
             # file to skip it), so the exact filter is always re-applied
             # post-merge
             file_filter = arrow_filter
-    return _UnitPlan(read_columns, file_schema, file_filter, post_filter)
+    zone = zone_conjuncts(filter) if file_filter is not None else []
+    return _UnitPlan(read_columns, file_schema, file_filter, post_filter, zone)
 
 
 def _postprocess(
@@ -199,6 +204,7 @@ def read_scan_unit(
             columns=plan.read_columns,
             arrow_filter=plan.file_filter,
             storage_options=storage_options,
+            zone_predicates=plan.zone_predicates,
         )
         if plan.file_schema is not None:
             t = uniform_table(t, plan.file_schema, defaults)
@@ -344,6 +350,7 @@ def iter_scan_unit_batches(
                 arrow_filter=plan.file_filter,
                 batch_size=rows,
                 storage_options=storage_options,
+                zone_predicates=plan.zone_predicates,
             ):
                 t = pa.Table.from_batches([batch])
                 if plan.file_schema is not None:
@@ -368,6 +375,7 @@ def iter_scan_unit_batches(
         defaults=defaults,
         storage_options=storage_options,
         stream_batch_rows=rows,
+        zone_predicates=plan.zone_predicates,
     ):
         t = post(window)
         windows += 1

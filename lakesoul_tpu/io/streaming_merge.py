@@ -140,6 +140,7 @@ class _SortedFileStream:
         defaults: dict | None,
         storage_options: dict | None,
         batch_rows: int,
+        zone_predicates=None,
     ):
         from lakesoul_tpu.io.formats import format_for
 
@@ -152,6 +153,7 @@ class _SortedFileStream:
                 arrow_filter=arrow_filter,
                 batch_size=batch_rows,
                 storage_options=storage_options,
+                zone_predicates=zone_predicates,
             )
         )
         self.buffer: pa.Table = (
@@ -211,6 +213,7 @@ def iter_merged_windows(
     defaults: dict | None = None,
     storage_options: dict | None = None,
     stream_batch_rows: int = DEFAULT_STREAM_BATCH_ROWS,
+    zone_predicates=None,
 ) -> Iterator[pa.Table]:
     """Merge k sorted file runs into a stream of merged windows.
 
@@ -228,6 +231,7 @@ def iter_merged_windows(
             defaults=defaults,
             storage_options=storage_options,
             batch_rows=stream_batch_rows,
+            zone_predicates=zone_predicates,
         )
         for p in files
     ]

@@ -57,6 +57,10 @@ def _expr_columns(expr) -> set[str]:
             if a is not None:
                 cols |= _expr_columns(a)
         return cols
+    if isinstance(expr, ast.WindowFn):
+        cols = set(expr.partition_by) | {c for c, _ in expr.order_by}
+        cols |= _expr_columns(expr.fn)
+        return cols
     return set()
 
 
@@ -241,6 +245,8 @@ def _expr_label(expr) -> str:
         return "case"
     if isinstance(expr, ast.Func):
         return expr.name
+    if isinstance(expr, ast.WindowFn):
+        return _expr_label(expr.fn)
     return "expr"
 
 
@@ -691,6 +697,8 @@ class SqlSession:
             right = self._eval_expr(expr.right, table)
             fn = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide}[expr.op]
             return fn(left, right)
+        if isinstance(expr, ast.WindowFn):
+            return self._eval_window(expr, table)
         if isinstance(expr, ast.Case):
             return self._eval_case(expr, table)
         if isinstance(expr, ast.Func):
@@ -714,6 +722,137 @@ class SqlSession:
         if isinstance(expr, ast.Agg):
             raise SqlError("aggregate not allowed here (missing GROUP BY context?)")
         raise SqlError(f"unsupported expression {expr!r}")
+
+    def _eval_window(self, wf: ast.WindowFn, table: pa.Table):
+        """Window functions: ONE stable multi-key sort (partition + order
+        keys + row tiebreaker), vectorized rank/offset/aggregate computation
+        in the sorted domain, scatter back to row order.  Aggregates with an
+        ORDER BY are running with RANGE semantics (peer rows share the value
+        at the last peer); without one they broadcast the partition value —
+        standard SQL defaults (the reference gets these from DataFusion's
+        window planner)."""
+        import numpy as np
+
+        fn = wf.fn
+        n = len(table)
+        is_rank = isinstance(fn, ast.Func) and fn.name in (
+            "row_number", "rank", "dense_rank"
+        )
+        if n == 0:
+            return pa.nulls(0, type=pa.int64() if is_rank else pa.float64())
+
+        aug = table.append_column("__rn", pa.array(np.arange(n, dtype=np.int64)))
+        sort_keys = (
+            [(c, "ascending") for c in wf.partition_by]
+            + [(c, "descending" if d else "ascending") for c, d in wf.order_by]
+            + [("__rn", "ascending")]  # determinism among peers
+        )
+        order = pc.sort_indices(aug, sort_keys=sort_keys).to_numpy()
+        idx = np.arange(n, dtype=np.int64)
+        inv = np.empty(n, dtype=np.int64)
+        inv[order] = idx
+
+        def sorted_codes(cname: str) -> np.ndarray:
+            # dictionary codes make run detection null-safe and type-agnostic
+            arr = table.column(cname).combine_chunks()
+            enc = arr if pa.types.is_dictionary(arr.type) else pc.dictionary_encode(arr)
+            codes = pc.fill_null(enc.indices.cast(pa.int64()), -1).to_numpy()
+            return codes[order]
+
+        part_new = np.zeros(n, dtype=bool)
+        part_new[0] = True
+        for c in wf.partition_by:
+            cs = sorted_codes(c)
+            part_new[1:] |= cs[1:] != cs[:-1]
+        peer_new = part_new.copy()
+        for c, _ in wf.order_by:
+            cs = sorted_codes(c)
+            peer_new[1:] |= cs[1:] != cs[:-1]
+        part_first = np.maximum.accumulate(np.where(part_new, idx, 0))
+
+        if isinstance(fn, ast.Func) and fn.name in ("row_number", "rank", "dense_rank"):
+            if fn.name == "row_number":
+                out_sorted = idx - part_first + 1
+            elif fn.name == "rank":
+                peer_first = np.maximum.accumulate(np.where(peer_new, idx, 0))
+                out_sorted = peer_first - part_first + 1
+            else:  # dense_rank
+                dr = np.cumsum(peer_new)
+                dr_start = np.maximum.accumulate(np.where(part_new, dr, 0))
+                out_sorted = dr - dr_start + 1
+            res = np.empty(n, dtype=np.int64)
+            res[order] = out_sorted
+            return pa.array(res)
+
+        if isinstance(fn, ast.Func):  # lag / lead
+            k = fn.args[1].value if len(fn.args) > 1 else 1
+            default = fn.args[2].value if len(fn.args) > 2 else None
+            vals = _broadcast(self._eval_expr(fn.args[0], table), n)
+            if isinstance(vals, pa.ChunkedArray):
+                vals = vals.combine_chunks()
+            sorted_vals = vals.take(pa.array(order))
+            shift = k if fn.name == "lag" else -k
+            src = idx - shift
+            part_id = np.cumsum(part_new)
+            valid = (src >= 0) & (src < n)
+            src_c = np.clip(src, 0, n - 1)
+            valid &= part_id[src_c] == part_id
+            taken = sorted_vals.take(pa.array(np.where(valid, src_c, 0)))
+            fallback = (
+                pa.nulls(n, type=sorted_vals.type)
+                if default is None
+                else pa.array([default] * n).cast(sorted_vals.type)
+            )
+            out = pc.if_else(pa.array(valid), taken, fallback)
+            return out.take(pa.array(inv))
+
+        # aggregate window (Agg)
+        import pandas as pd
+
+        part_id = np.cumsum(part_new)
+        if fn.arg is None:
+            ser = pd.Series(np.ones(n))
+            counts_star = True
+        else:
+            vals = _broadcast(self._eval_expr(fn.arg, table), n)
+            if isinstance(vals, pa.ChunkedArray):
+                vals = vals.combine_chunks()
+            ser = vals.take(pa.array(order)).to_pandas()
+            counts_star = False
+        g = ser.groupby(part_id)
+        if not wf.order_by:  # whole-partition broadcast
+            if fn.fn == "count":
+                out = g.transform("size") if counts_star else g.transform("count")
+            else:
+                out = g.transform({"sum": "sum", "min": "min", "max": "max",
+                                   "avg": "mean"}[fn.fn])
+                if fn.fn == "sum":
+                    # SQL: sum over zero non-null inputs is NULL, not 0
+                    nn = ser.notna().groupby(part_id).transform("sum")
+                    out = out.where(nn > 0)
+            out_sorted = out.to_numpy()
+        else:  # running (RANGE: peers share the last peer row's value)
+            # SQL frame semantics: NULL inputs are SKIPPED — the running
+            # value carries forward through them (pandas cum* would leave
+            # NaN at NaN positions instead)
+            nn = ser.notna().groupby(part_id).cumsum()
+            if fn.fn == "count":
+                out = g.cumcount() + 1 if counts_star else nn
+            elif fn.fn == "sum":
+                out = ser.fillna(0).groupby(part_id).cumsum().where(nn > 0)
+            elif fn.fn == "min":
+                out = g.cummin().groupby(part_id).ffill()
+            elif fn.fn == "max":
+                out = g.cummax().groupby(part_id).ffill()
+            else:  # avg
+                out = (ser.fillna(0).groupby(part_id).cumsum() / nn).where(nn > 0)
+            starts = np.flatnonzero(peer_new)
+            ends = np.append(starts[1:], n) - 1
+            peer_last = np.repeat(ends, np.diff(np.append(starts, n)))
+            out_sorted = out.to_numpy()[peer_last]
+        res = np.empty(n, dtype=np.asarray(out_sorted).dtype)
+        res[order] = out_sorted
+        return pa.array(res, from_pandas=True)  # NaN → null
 
     def _eval_case(self, expr: ast.Case, table: pa.Table):
         """CASE with SQL's lazy-branch guarantee: each THEN/ELSE evaluates

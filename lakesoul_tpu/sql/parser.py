@@ -39,8 +39,12 @@ KEYWORDS = {
     "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
     "column", "call", "update", "set", "delete", "join", "inner", "left", "on",
     "case", "when", "then", "else", "end", "having", "between", "like",
-    "substring", "for", "union", "intersect", "except", "all",
+    "substring", "for", "union", "intersect", "except", "all", "over",
+    "partition",
 }
+
+# window-only functions (idents, not keywords: usable as column names)
+WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank", "lag", "lead")
 
 
 @dataclass
@@ -115,6 +119,19 @@ class ScalarSubquery:
     """Uncorrelated (SELECT ...) used as a value."""
 
     select: "Select"
+
+
+@dataclass
+class WindowFn:
+    """``fn OVER (PARTITION BY ... ORDER BY ...)``: fn is an Agg (sum/avg/
+    min/max/count) or a Func for row_number/rank/dense_rank/lag/lead.
+    Aggregates with an ORDER BY are running (RANGE semantics: peers share
+    the value at the last peer row), without one they broadcast the whole-
+    partition value — standard SQL defaults."""
+
+    fn: object
+    partition_by: list[str] = field(default_factory=list)
+    order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
 
 
 @dataclass
@@ -600,13 +617,63 @@ class Parser:
             return self._substring_expr()
         agg = self._maybe_agg()
         if agg is not None:
+            # OVER turns the aggregate into a window function
+            if self.peek() is not None and self.peek().kind == "kw" \
+                    and self.peek().value == "over":
+                part, order = self._over_clause()
+                return WindowFn(agg, part, order)
             return agg  # aggregates inside expressions (HAVING, agg arith)
         if tok.kind == "number" or tok.kind == "string" or (
             tok.kind == "kw" and tok.value in ("true", "false", "null")
         ):
             return Literal(self._value())
+        if tok.kind == "ident" and tok.value.lower() in WINDOW_FUNCTIONS \
+                and self.pos + 1 < len(self.tokens) \
+                and self.tokens[self.pos + 1].kind == "op" \
+                and self.tokens[self.pos + 1].value == "(":
+            return self._window_call()
         _, name = self._qualified_ident()
         return Column(name)
+
+    def _window_call(self) -> WindowFn:
+        name = self.next().value.lower()
+        self.expect("op", "(")
+        args: list = []
+        if name in ("lag", "lead"):
+            args.append(self._arith_expr())
+            if self.accept("op", ","):
+                off = self._value()
+                args.append(Literal(int(off)))
+                if self.accept("op", ","):
+                    args.append(Literal(self._value()))
+        self.expect("op", ")")
+        part, order = self._over_clause()
+        if not order and name != "row_number":
+            raise SqlError(f"{name}() requires ORDER BY in its OVER clause")
+        return WindowFn(Func(name, args), part, order)
+
+    def _over_clause(self) -> tuple[list[str], list[tuple[str, bool]]]:
+        self.expect("kw", "over")
+        self.expect("op", "(")
+        part: list[str] = []
+        order: list[tuple[str, bool]] = []
+        if self.accept("kw", "partition"):
+            self.expect("kw", "by")
+            part.append(self._qualified_ident()[1])
+            while self.accept("op", ","):
+                part.append(self._qualified_ident()[1])
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                col = self._qualified_ident()[1]
+                desc = bool(self.accept("kw", "desc"))
+                if not desc:
+                    self.accept("kw", "asc")
+                order.append((col, desc))
+                if not self.accept("op", ","):
+                    break
+        self.expect("op", ")")
+        return part, order
 
     def _case_expr(self) -> Case:
         self.expect("kw", "case")

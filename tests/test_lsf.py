@@ -285,6 +285,107 @@ class TestChunkingAndProjection:
         assert got.num_rows == 10_000
 
 
+class TestZoneMaps:
+    """Chunk min/max statistics skip whole chunks on refuting predicates
+    (the role of parquet's row-group statistics pruning)."""
+
+    def _file(self, tmp_path, n=100_000, chunk=10_000):
+        t = pa.table({
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array(np.random.default_rng(0).normal(size=n).astype(np.float32)),
+        })
+        path = str(tmp_path / "z.lsf")
+        write_lsf_table(t, path, config=IOConfig(max_row_group_size=chunk))
+        return path, t
+
+    def test_skip_chunks_by_stats(self, tmp_path):
+        path, t = self._file(tmp_path)
+        f = LsfFile(path)
+        got = f.read(zone_predicates=[("id", "lt", 15_000)])
+        assert f.chunks_decoded == 2  # chunks [0,10k) and [10k,20k) only
+        assert got.num_rows == 20_000  # stats skip is chunk-granular
+        f = LsfFile(path)
+        got = f.read(zone_predicates=[("id", "ge", 95_000)])
+        assert f.chunks_decoded == 1 and got.num_rows == 10_000
+        f = LsfFile(path)
+        got = f.read(zone_predicates=[("id", "eq", 55_555)])
+        assert f.chunks_decoded == 1
+        f = LsfFile(path)
+        got = f.read(zone_predicates=[("id", "in", [5, 99_999])])
+        assert f.chunks_decoded == 2
+        f = LsfFile(path)
+        got = f.read(zone_predicates=[("id", "lt", -1)])
+        assert f.chunks_decoded == 0 and got.num_rows == 0
+        # float column has no stats → never refutes
+        f = LsfFile(path)
+        f.read(zone_predicates=[("v", "lt", -100.0)])
+        assert f.chunks_decoded == 10
+
+    def test_raw_int_chunks_carry_stats(self, tmp_path):
+        # full-range int64 falls back to raw encoding but still has stats
+        t = pa.table({"i": pa.array([-2**63, 0, 2**63 - 1] * 100, type=pa.int64()),
+                      "j": pa.array(np.arange(300, dtype=np.int64))})
+        path = str(tmp_path / "raw.lsf")
+        write_lsf_table(t, path)
+        f = LsfFile(path)
+        meta = f._footer["chunks"][0]["columns"][0]
+        assert meta["enc"] == "raw" and meta["stats"] == [-2**63, 2**63 - 1]
+
+    def test_e2e_scan_filter_skips_chunks(self, tmp_warehouse, monkeypatch):
+        """A PK-only filter pushes down through the catalog scan and the zone
+        maps skip chunks; results stay exact."""
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.io.filters import col
+        import lakesoul_tpu.io.lsf as lsf_mod
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table(
+            "zm", schema, primary_keys=["id"], hash_bucket_num=1,
+            properties={"lakesoul.file_format": "lsf",
+                        "lakesoul.max_row_group_size": "1000"},
+        )
+        n = 20_000
+        t.write_arrow(pa.table({
+            "id": np.arange(n, dtype=np.int64), "v": np.zeros(n),
+        }, schema=schema))
+        decoded = []
+        orig = lsf_mod.LsfFile._chunk_table
+
+        def spy(self, chunk, columns):
+            decoded.append(chunk["n_rows"])
+            return orig(self, chunk, columns)
+
+        monkeypatch.setattr(lsf_mod.LsfFile, "_chunk_table", spy)
+        got = t.scan().filter(col("id") < 1500).to_arrow()
+        assert got.num_rows == 1500
+        assert sum(decoded) <= 2000  # 2 of 20 chunks decoded
+
+    def test_streaming_merge_respects_zone_maps(self, tmp_warehouse):
+        """Zone predicates flow into the bounded-memory streaming path; the
+        merged result equals the materialized one."""
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.io.filters import col
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table(
+            "zs", schema, primary_keys=["id"], hash_bucket_num=1,
+            properties={"lakesoul.file_format": "lsf",
+                        "lakesoul.max_row_group_size": "500",
+                        "lakesoul.memory_budget_bytes": str(1 << 20)},
+        )
+        n = 30_000
+        t.write_arrow(pa.table({"id": np.arange(n), "v": np.zeros(n)}, schema=schema))
+        t.upsert(pa.table({"id": np.arange(0, n, 7), "v": np.ones(n // 7 + (1 if n % 7 else 0))}, schema=schema))
+        flt = (col("id") >= 100) & (col("id") < 700)
+        streamed = pa.Table.from_batches(
+            list(t.scan().filter(flt).batch_size(128).to_batches())
+        ).sort_by("id")
+        assert streamed.column("id").to_pylist() == list(range(100, 700))
+        assert streamed.column("v").to_pylist()[5] == 1.0  # id=105 upserted
+
+
 class TestRegistryDispatch:
     def test_extension_dispatch(self):
         assert format_for("a/b/part-x_0000.lsf").name == "lsf"

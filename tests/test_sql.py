@@ -733,3 +733,124 @@ class TestCtesAndSetOps:
     def test_set_op_arity_mismatch(self, session):
         with pytest.raises(SqlError, match="arity"):
             session.execute("SELECT id, age FROM users UNION SELECT id FROM users")
+
+
+class TestWindowFunctions:
+    """OVER (PARTITION BY ... ORDER BY ...): ranks, offsets, running and
+    whole-partition aggregates (DataFusion window-planner role)."""
+
+    @pytest.fixture()
+    def wsession(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(catalog)
+        s.execute(
+            "CREATE TABLE sales (id bigint PRIMARY KEY, region string,"
+            " amt double, day int) WITH (hashBucketNum = '1')"
+        )
+        s.execute(
+            "INSERT INTO sales VALUES"
+            " (1, 'w', 10.0, 1), (2, 'w', 30.0, 2), (3, 'w', 20.0, 2),"
+            " (4, 'e', 5.0, 1), (5, 'e', 50.0, 3), (6, 'e', 50.0, 2)"
+        )
+        return s
+
+    def _by_id(self, out, col):
+        rows = sorted(out.to_pylist(), key=lambda r: r["id"])
+        return [r[col] for r in rows]
+
+    def test_row_number(self, wsession):
+        out = wsession.execute(
+            "SELECT id, row_number() OVER (PARTITION BY region ORDER BY amt) AS rn"
+            " FROM sales"
+        )
+        assert self._by_id(out, "rn") == [1, 3, 2, 1, 2, 3]
+
+    def test_rank_and_dense_rank_with_ties(self, wsession):
+        out = wsession.execute(
+            "SELECT id, rank() OVER (PARTITION BY region ORDER BY amt DESC) AS r,"
+            " dense_rank() OVER (PARTITION BY region ORDER BY amt DESC) AS dr"
+            " FROM sales"
+        )
+        # east: amts 5, 50, 50 → desc ranks: 50→1, 50→1, 5→3 (dense: 2)
+        assert self._by_id(out, "r") == [3, 1, 2, 3, 1, 1]
+        assert self._by_id(out, "dr") == [3, 1, 2, 2, 1, 1]
+
+    def test_running_sum_range_peers(self, wsession):
+        out = wsession.execute(
+            "SELECT id, sum(amt) OVER (PARTITION BY region ORDER BY day) AS s"
+            " FROM sales"
+        )
+        # west day2 has two rows (ids 2,3): RANGE peers share 10+30+20=60
+        assert self._by_id(out, "s") == [10.0, 60.0, 60.0, 5.0, 105.0, 55.0]
+
+    def test_partition_aggregate_broadcast(self, wsession):
+        out = wsession.execute(
+            "SELECT id, sum(amt) OVER (PARTITION BY region) AS tot,"
+            " count(*) OVER (PARTITION BY region) AS n FROM sales"
+        )
+        assert self._by_id(out, "tot") == [60.0, 60.0, 60.0, 105.0, 105.0, 105.0]
+        assert self._by_id(out, "n") == [3, 3, 3, 3, 3, 3]
+
+    def test_lag_lead(self, wsession):
+        out = wsession.execute(
+            "SELECT id, lag(amt) OVER (PARTITION BY region ORDER BY day, id) AS prev,"
+            " lead(amt, 1, -1.0) OVER (PARTITION BY region ORDER BY day, id) AS nxt"
+            " FROM sales"
+        )
+        assert self._by_id(out, "prev") == [None, 10.0, 30.0, None, 50.0, 5.0]
+        assert self._by_id(out, "nxt") == [30.0, 20.0, -1.0, 50.0, -1.0, 50.0]
+
+    def test_window_in_expression_and_global(self, wsession):
+        out = wsession.execute(
+            "SELECT id, amt * 100 / sum(amt) OVER (PARTITION BY region) AS pct,"
+            " row_number() OVER (ORDER BY amt DESC, id) AS g FROM sales"
+        )
+        pct = self._by_id(out, "pct")
+        assert abs(pct[0] - 10.0 / 60.0 * 100) < 1e-9
+        # amt desc, id asc: id5(50), id6(50), id2(30), id3(20), id1(10), id4(5)
+        assert self._by_id(out, "g") == [5, 3, 4, 6, 1, 2]
+
+    def test_window_over_derived_and_cte(self, wsession):
+        out = wsession.execute(
+            "WITH w AS (SELECT region, amt FROM sales WHERE amt > 5)"
+            " SELECT region, rank() OVER (PARTITION BY region ORDER BY amt) AS r"
+            " FROM w ORDER BY region, r"
+        )
+        # east keeps the tied 50s (both rank 1); west keeps 10, 20, 30
+        assert out.column("r").to_pylist() == [1, 1, 1, 2, 3]
+
+    def test_running_avg_and_min_max(self, wsession):
+        out = wsession.execute(
+            "SELECT id, avg(amt) OVER (PARTITION BY region ORDER BY day, id) AS a,"
+            " max(amt) OVER (PARTITION BY region ORDER BY day, id) AS m FROM sales"
+        )
+        assert self._by_id(out, "a") == [10.0, 20.0, 20.0, 5.0, 35.0, 27.5]
+        assert self._by_id(out, "m") == [10.0, 30.0, 30.0, 5.0, 50.0, 50.0]
+
+    def test_null_skipping_in_window_aggregates(self, wsession):
+        """SQL frame semantics: NULLs are skipped — running values carry
+        forward through them, and an all-NULL frame sums to NULL, not 0."""
+        wsession.execute(
+            "CREATE TABLE nw (id bigint PRIMARY KEY, grp string, x double, d int)"
+            " WITH (hashBucketNum = '1')"
+        )
+        wsession.execute(
+            "INSERT INTO nw (id, grp, x, d) VALUES"
+            " (1, 'a', 10.0, 1), (2, 'a', NULL, 2), (3, 'a', 20.0, 3),"
+            " (4, 'b', NULL, 1), (5, 'b', NULL, 2)"
+        )
+        out = wsession.execute(
+            "SELECT id, sum(x) OVER (PARTITION BY grp ORDER BY d) AS s,"
+            " avg(x) OVER (PARTITION BY grp ORDER BY d) AS a,"
+            " min(x) OVER (PARTITION BY grp ORDER BY d) AS m,"
+            " sum(x) OVER (PARTITION BY grp) AS tot FROM nw"
+        )
+        rows = sorted(out.to_pylist(), key=lambda r: r["id"])
+        assert [r["s"] for r in rows] == [10.0, 10.0, 30.0, None, None]
+        assert [r["a"] for r in rows] == [10.0, 10.0, 15.0, None, None]
+        assert [r["m"] for r in rows] == [10.0, 10.0, 10.0, None, None]
+        assert [r["tot"] for r in rows] == [30.0, 30.0, 30.0, None, None]
+
+    def test_window_requires_order(self, wsession):
+        with pytest.raises(SqlError, match="requires ORDER BY"):
+            wsession.execute("SELECT rank() OVER (PARTITION BY region) FROM sales")
