@@ -31,10 +31,20 @@ def _finalize_topk(ids: np.ndarray, dists: np.ndarray, idx: np.ndarray, top_k: i
 
 @dataclass(frozen=True)
 class SearchParams:
-    """reference: SearchParams{top_k, nprobe} (ivf/mod.rs:29)."""
+    """reference: SearchParams{top_k, nprobe} (ivf/mod.rs:29).
+
+    ``rerank_depth`` sizes the estimator shortlist handed to the exact
+    re-rank (None → 4·top_k).  With raw vectors kept, recall is bounded only
+    by probe coverage and this depth, so deeper re-rank trades QPS for
+    recall without touching the quantizer."""
 
     top_k: int = 10
     nprobe: int = 8
+    rerank_depth: int | None = None
+
+    def shortlist(self) -> int:
+        s = self.rerank_depth if self.rerank_depth is not None else self.top_k * 4
+        return max(s, self.top_k)
 
 
 @dataclass
@@ -285,7 +295,7 @@ class IvfRabitqIndex:
         probe_mask = np.zeros(len(self.centroids), dtype=bool)
         probe_mask[probe] = True
         do_rerank = bundle["raw"] is not None
-        s = min(max(params.top_k * 4, params.top_k), int(bundle["codes"].shape[0]))
+        s = min(params.shortlist(), int(bundle["codes"].shape[0]))
         k = min(params.top_k, int(bundle["codes"].shape[0]))
         dists, idx = _fused_search_resident(
             bundle["codes"], bundle["norms"], bundle["factors"], bundle["cdc"],
@@ -414,7 +424,7 @@ class IvfRabitqIndex:
                 np.concatenate(cand["raw"]) if use_rerank else None,
                 query,
                 top_k=params.top_k,
-                shortlist=max(params.top_k * 4, params.top_k),
+                shortlist=params.shortlist(),
             )
             return _finalize_topk(ids, dists, idx, params.top_k)
         dists, idx = fused_search(
@@ -429,7 +439,7 @@ class IvfRabitqIndex:
             query,
             d=self.quantizer.padded_dim,
             top_k=params.top_k,
-            shortlist=max(params.top_k * 4, params.top_k),
+            shortlist=params.shortlist(),
         )
         return _finalize_topk(ids, dists, idx, params.top_k)
 
@@ -500,7 +510,7 @@ class IvfRabitqIndex:
         ).astype(np.float32)
         do_rerank = bundle["raw"] is not None
         n_pad = int(bundle["codes"].shape[0])
-        s = min(max(params.top_k * 4, params.top_k), n_pad)
+        s = min(params.shortlist(), n_pad)
         k = min(params.top_k, n_pad)
         if self._ex_bits:
             from lakesoul_tpu.vector.kernels import _fused_search_resident_ex_batch

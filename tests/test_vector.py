@@ -129,6 +129,26 @@ class TestIvfIndex:
             recalls.append(len(true & set(int(i) for i in got)) / 10)
         assert np.mean(recalls) >= 0.5, f"recall@10 = {np.mean(recalls)}"
 
+    def test_rerank_depth_lifts_recall(self):
+        # deeper exact-rerank shortlist → recall monotone (within noise):
+        # the estimator only has to land true neighbors in the top-S, so
+        # growing S recovers everything probe coverage allows
+        index, vectors, _ = self._make()
+        rng = np.random.default_rng(7)
+        queries = rng.normal(size=(20, vectors.shape[1])).astype(np.float32)
+        means = []
+        for depth in (10, 200):
+            recalls = []
+            for q in queries:
+                true = set(brute_force_knn(vectors, q, 10))
+                got, _ = index.search(
+                    q, SearchParams(top_k=10, nprobe=16, rerank_depth=depth)
+                )
+                recalls.append(len(true & set(int(i) for i in got)) / 10)
+            means.append(np.mean(recalls))
+        assert means[1] >= means[0]
+        assert means[1] >= 0.8, f"recall@10 depth=200: {means[1]}"
+
     def test_recall_no_rerank_still_useful(self):
         # 1-bit codes alone on iid Gaussian data (worst case: zero cluster
         # structure) — far above chance (10/2000) but well below the reranked
