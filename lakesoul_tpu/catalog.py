@@ -615,7 +615,18 @@ class LakeSoulScan:
     def select(self, columns: list[str]) -> "LakeSoulScan":
         return self._replace(_columns=list(columns))
 
-    def filter(self, flt: Filter) -> "LakeSoulScan":
+    def filter(self, flt: "Filter | str") -> "LakeSoulScan":
+        """Add a pushdown predicate: a Filter node, or a WHERE-style string
+        (``scan.filter("f > 100 AND id IN (1, 2)")``) parsed by the SQL
+        predicate grammar."""
+        if isinstance(flt, str):
+            from lakesoul_tpu.sql.parser import parse_predicate
+
+            flt = parse_predicate(flt)
+        elif not isinstance(flt, Filter):
+            raise ConfigError(
+                f"filter() takes a Filter or a predicate string, got {type(flt).__name__}"
+            )
         new = flt if self._filter is None else (self._filter & flt)
         return self._replace(_filter=new)
 

@@ -135,6 +135,13 @@ class JaxBatchIterator:
         drop_remainder: drop the final short batch (jit-friendly default True).
         io_threads: decode scan units on this many threads (multi-core hosts;
             see LakeSoulScan.to_batches).
+        cache: ``"device"`` pins every delivered batch in device memory on the
+            first complete epoch; re-iterating then replays the resident
+            batches with ZERO storage/host/link traffic (the tf.data
+            ``.cache()`` role, placed in HBM where re-reads are free).  The
+            whole epoch must fit device memory — the caller opts in knowing
+            rows × bytes/row.  An epoch abandoned early leaves the cache
+            unfilled (partial replay would silently drop data).
     """
 
     def __init__(
@@ -150,7 +157,20 @@ class JaxBatchIterator:
         drop_remainder: bool = True,
         io_threads: int | None = None,
         checkpoint: "LoaderCheckpoint | None" = None,
+        cache: str | None = None,
     ):
+        from lakesoul_tpu.errors import ConfigError
+
+        if cache not in (None, "device"):
+            raise ConfigError(f"unknown cache mode {cache!r}; expected 'device'")
+        if cache == "device" and checkpoint is not None:
+            # a replayed epoch never touches the input stream, so a loader
+            # checkpoint could not represent its position
+            raise ConfigError("cache='device' and checkpoint are mutually exclusive")
+        if cache == "device" and not device_put:
+            raise ConfigError("cache='device' requires device_put=True")
+        self._cache_mode = cache
+        self._device_cached: list | None = None
         self._scan = scan
         self._collate = collate_fn or _default_collate
         self._transform = transform
@@ -219,7 +239,20 @@ class JaxBatchIterator:
             batch = self._transform(batch)
         return batch
 
+    def _fresh_containers(self, batch):
+        """Rebuild the pytree's containers (leaves — device arrays — stay
+        shared): consumers that mutate a yielded dict in place must never
+        poison the cached epoch."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: x, batch)
+
     def __iter__(self):
+        if self._device_cached is not None:
+            # steady state: replay the HBM-resident epoch, no host pipeline
+            for b in self._device_cached:
+                yield self._fresh_containers(b)
+            return
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         stop = threading.Event()
         thread = threading.Thread(target=self._producer, args=(q, stop), daemon=True)
@@ -258,13 +291,24 @@ class JaxBatchIterator:
         )
         # double buffering: keep device_prefetch transfers in flight so the
         # H2D copy of batch k+1 overlaps the step on batch k
+        fill: list | None = [] if self._cache_mode == "device" else None
         buf: list = []
         for rows, host_batch in host_iter():
             buf.append((rows, put(host_batch)))
             if len(buf) > self._device_prefetch:
                 r, b = buf.pop(0)
                 delivered(r)
+                if fill is not None:
+                    fill.append(b)
+                    b = self._fresh_containers(b)  # cache keeps the pristine one
                 yield b
         for r, b in buf:
             delivered(r)
+            if fill is not None:
+                fill.append(b)
+                b = self._fresh_containers(b)
             yield b
+        if fill is not None:
+            # only a COMPLETE epoch becomes the resident cache: an abandoned
+            # iteration (consumer break → GeneratorExit) never reaches here
+            self._device_cached = fill

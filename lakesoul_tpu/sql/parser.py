@@ -1015,3 +1015,19 @@ def inline_ctes(node, ctes: dict, _seen: set | None = None) -> None:
 
 def parse(sql: str):
     return Parser(sql).parse()
+
+
+def parse_predicate(text: str):
+    """Parse a bare WHERE-style boolean expression (``"f > 100 AND id IN
+    (1, 2)"``) into the pushdown Filter AST — the string form of
+    ``LakeSoulScan.filter``.  Only pushdown-eligible predicates are accepted
+    (simple comparisons, IN, BETWEEN, IS NULL, AND/OR/NOT); anything needing
+    the general SQL evaluator must go through ``SqlSession``."""
+    from lakesoul_tpu.sql.executor import _where_to_filter
+
+    p = Parser(text)
+    node = p._bool_expr()
+    tok = p.peek()
+    if tok is not None:
+        raise SqlError(f"trailing input in predicate: {tok.value!r}")
+    return _where_to_filter(node)

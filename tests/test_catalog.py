@@ -190,6 +190,66 @@ class TestJaxDelivery:
         assert batches[0].sharding == sharding
         assert batches[0].shape == (64,)
 
+    def test_filter_accepts_predicate_strings(self, catalog):
+        from lakesoul_tpu.errors import ConfigError
+
+        t = catalog.create_table("strf", SCHEMA)
+        t.write_arrow(
+            pa.table({"id": np.arange(100), "v": np.arange(100, dtype=np.float64), "name": ["x"] * 100})
+        )
+        assert len(t.scan().filter("v >= 90 AND id < 95").to_arrow()) == 5
+        assert len(t.scan().filter("id IN (3, 7) OR v > 98.5").to_arrow()) == 3
+        with pytest.raises(Exception):
+            t.scan().filter("v LIKE 'a%'")  # non-pushable → clear parse error
+        with pytest.raises(ConfigError):
+            t.scan().filter(123)
+
+    def test_device_cache_replays_epoch(self, catalog):
+        import jax
+
+        t = catalog.create_table("hbm", SCHEMA)
+        n = 512
+        t.write_arrow(
+            pa.table({"id": np.arange(n), "v": np.arange(n, dtype=np.float64), "name": ["x"] * n})
+        )
+
+        def transform(b):
+            return {"x": b["v"].astype(np.float32)}
+
+        it = t.scan().batch_size(128).to_jax_iter(transform=transform, cache="device")
+        first = list(it)
+        assert len(first) == 4 and isinstance(first[0]["x"], jax.Array)
+        # steady state: replay serves THE SAME device arrays — no new
+        # transfers, byte-identical epochs
+        second = list(it)
+        assert [b["x"] is a["x"] for a, b in zip(first, second)] == [True] * 4
+        # consumers mutating a yielded dict in place must not poison the
+        # cache: every epoch hands out fresh containers over shared leaves
+        for b in it:
+            b["x"] = None
+        assert all(b["x"] is not None for b in it)
+
+    def test_device_cache_ignores_abandoned_epoch(self, catalog):
+        t = catalog.create_table("hbm2", SCHEMA)
+        t.write_arrow(
+            pa.table({"id": np.arange(256), "v": np.ones(256), "name": ["x"] * 256})
+        )
+        it = t.scan().batch_size(64).to_jax_iter(
+            cache="device", transform=lambda b: {"v": b["v"].astype(np.float32)}
+        )
+        for b in it:
+            break  # abandon mid-epoch: the partial pass must NOT become the cache
+        assert it._device_cached is None
+        assert len(list(it)) == 4  # next pass streams (and completes) normally
+
+    def test_device_cache_rejects_checkpoint(self, catalog):
+        from lakesoul_tpu.data.jax_iter import LoaderCheckpoint
+        from lakesoul_tpu.errors import ConfigError
+
+        t = seed_pk_table(catalog)
+        with pytest.raises(ConfigError):
+            t.scan().to_jax_iter(cache="device", checkpoint=LoaderCheckpoint())
+
     def test_producer_error_propagates(self, catalog):
         t = seed_pk_table(catalog)
 
