@@ -229,8 +229,15 @@ def _encode_column(arr: pa.Array, field: pa.Field, w: _BufferWriter) -> dict:
         vals = filled.to_numpy(zero_copy_only=False)
         dt = _np_dtype_for(t)
         vals = vals.view(dt) if vals.dtype.itemsize == np.dtype(dt).itemsize else vals.astype(dt)
-        return {"enc": "raw", "bufs": [w.add(np.ascontiguousarray(vals).tobytes())],
+        meta = {"enc": "raw", "bufs": [w.add(np.ascontiguousarray(vals).tobytes())],
                 **nulls_meta}
+        if pa.types.is_floating(t) and n:
+            # float zone stats: the 0 null-fill can only WIDEN [lo, hi], so
+            # refutation stays sound; any NaN poisons min/max → no stats
+            lo, hi = float(np.min(vals)), float(np.max(vals))
+            if np.isfinite([lo, hi]).all():  # NaN or ±inf anywhere → no stats
+                meta["stats"] = [lo, hi]
+        return meta
 
     if pa.types.is_string(t) or pa.types.is_large_string(t) \
             or pa.types.is_binary(t) or pa.types.is_large_binary(t):
@@ -464,9 +471,10 @@ class LsfFile:
     # -------------------------------------------------------------- reading
     @staticmethod
     def _zone_refutes(chunk, zone_predicates) -> bool:
-        """True when chunk int stats PROVE no row can match (every predicate
-        is a necessary condition — see filters.zone_conjuncts).  Columns
-        without stats (floats, strings, all-null) never refute."""
+        """True when chunk min/max stats PROVE no row can match (every
+        predicate is a necessary condition — see filters.zone_conjuncts).
+        Ints and NaN-free floats carry stats; columns without stats
+        (strings, NaN-bearing floats, empty) never refute."""
         if not zone_predicates:
             return False
         stats_by_col = {

@@ -316,10 +316,33 @@ class TestZoneMaps:
         f = LsfFile(path)
         got = f.read(zone_predicates=[("id", "lt", -1)])
         assert f.chunks_decoded == 0 and got.num_rows == 0
-        # float column has no stats → never refutes
+        # float columns carry min/max stats too: v ~ N(0,1), so every chunk
+        # refutes v < -100 and none refutes v < 0
         f = LsfFile(path)
-        f.read(zone_predicates=[("v", "lt", -100.0)])
+        got = f.read(zone_predicates=[("v", "lt", -100.0)])
+        assert f.chunks_decoded == 0 and got.num_rows == 0
+        f = LsfFile(path)
+        f.read(zone_predicates=[("v", "lt", 0.0)])
         assert f.chunks_decoded == 10
+
+    def test_float_stats_skip_nan_and_null_fill_is_sound(self, tmp_path):
+        # a NaN anywhere in the chunk poisons min/max → that chunk keeps no
+        # stats and never refutes; null fill (0.0) only widens the range
+        t = pa.table({
+            "a": pa.array([1.0, float("nan"), 3.0], type=pa.float64()),
+            "b": pa.array([5.0, None, 9.0], type=pa.float64()),
+        })
+        path = str(tmp_path / "nan.lsf")
+        write_lsf_table(t, path)
+        f = LsfFile(path)
+        f.read(zone_predicates=[("a", "gt", 100.0)])
+        assert f.chunks_decoded == 1  # NaN column: no stats, no refutation
+        f = LsfFile(path)
+        got = f.read(zone_predicates=[("b", "lt", -1.0)])
+        assert f.chunks_decoded == 0 and got.num_rows == 0  # [0, 9] refutes
+        f = LsfFile(path)
+        f.read(zone_predicates=[("b", "lt", 2.0)])
+        assert f.chunks_decoded == 1  # fill-0 widened the range: kept (sound)
 
     def test_raw_int_chunks_carry_stats(self, tmp_path):
         # full-range int64 falls back to raw encoding but still has stats
