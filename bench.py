@@ -7,21 +7,30 @@ Legs and honesty rules (VERDICT r1 #2):
 1. **MOR delivery (headline)** — our table (native LSF format, hash-bucketed,
    one upsert wave so merge-on-read does real work) → scan → merge →
    device_put → jitted MLP train step on the chip.
-2. **Arms-length baseline** — the same rows written as a plain parquet
+2. **Arms-length baselines** — the same rows written as a plain parquet
    dataset by pyarrow itself (zstd level 1, no dictionary — the reference
    writer's settings, writer/mod.rs:215-240), consumed by a pure
-   pyarrow.dataset → torch DataLoader loop with ZERO repo imports in the
-   loop.  The baseline does strictly LESS work than we do (no merge, no
-   device transfer, no optimizer step), so vs_baseline ≥ 1.0 means the
-   TPU-first design overcomes a handicap, not an artifact.
-3. **ANN QPS** — device-resident IVF-RaBitQ batch search over a 200k x 64d
-   shard; reports QPS and recall@10 vs brute force.
-4. **Remote leg** — a smaller table on a latency-injected in-memory object
+   pyarrow.dataset → torch DataLoader pipeline with ZERO repo imports.
+   Two measurements: `baseline_e2e` delivers into the SAME jitted train
+   step on the same chip (BASELINE.md's comparator — "GPU-DataLoader
+   rows/sec/chip" is a delivery-to-accelerator metric) and sets
+   vs_baseline; the host-decode-only loop (no device, strictly less work)
+   is kept as vs_baseline_host_decode_only for r1/r2 continuity.
+3. **HBM-resident replay** — the loader's cache="device" epoch cache:
+   steady-state epochs replay from device memory with zero storage/host/
+   link traffic.  Separately labeled; it measures the epoch-cache feature,
+   not delivery from storage.
+4. **ANN QPS** — device-resident IVF-RaBitQ batch search over a 200k x 64d
+   shard; reports QPS and recall@10 vs brute force (full probe + exact
+   re-rank at depth 100: the resident kernel scans every packed code
+   regardless of nprobe, so full probing is free on this path).
+5. **Remote leg** — a smaller table on a latency-injected in-memory object
    store (10 ms per GET — GCS-like) read cold then warm through the owned
    page cache.
 
 Prints ONE json line:
-  {"metric", "value", "unit", "vs_baseline", "ann_qps", "ann_recall_at_10",
+  {"metric", "value", "unit", "vs_baseline", "vs_baseline_host_decode_only",
+   "hbm_resident_replay_rows_per_s", "ann_qps", "ann_recall_at_10",
    "remote_cold_rows_per_s", "remote_warm_rows_per_s", "cache_hit_rate"}
 """
 
@@ -125,7 +134,7 @@ def build_baseline_dataset(root: str) -> str:
     return data_dir
 
 
-def bench_lakesoul(t, *, epochs: int = 2) -> float:
+def bench_lakesoul(t, *, epochs: int = 2, device_cache: bool = False) -> float:
     import jax
     import jax.numpy as jnp
     import optax
@@ -208,12 +217,31 @@ def bench_lakesoul(t, *, epochs: int = 2) -> float:
 
     best = 0.0
     loss = None
+    if device_cache:
+        # HBM-resident leg: the loader's cache="device" pins the epoch in
+        # device memory on the first pass (20M rows x 33 B/row ≈ 660 MB —
+        # well inside one chip's HBM); steady-state epochs replay resident
+        # arrays with ZERO storage/host/link traffic.  Reported separately —
+        # this measures the epoch-cache feature, not delivery from storage.
+        it = t.scan().batch_size(group_rows).to_jax_iter(
+            transform=col_transform, io_threads=2, drop_remainder=False,
+            cache="device",
+        )
+        for batch in it:  # fill epoch (trains too, untimed)
+            if len(batch["y"]):
+                params, opt_state, loss = compiled[batch["x"].shape](
+                    params, opt_state, batch["x"], batch["y"]
+                )
+        jax.block_until_ready(loss)
+        epoch_iter = lambda: it
+    else:
+        epoch_iter = lambda: batches(io_threads=2)
     for _ in range(epochs):  # best-of-N epochs damps filesystem/cache variance
         rows = 0
         start = time.perf_counter()
         # io_threads=2: lz4/lsf decode releases the GIL, overlapping unit
         # decode with device transfer even on small hosts
-        for batch in batches(io_threads=2):
+        for batch in epoch_iter():
             if not len(batch["y"]):
                 continue
             params, opt_state, loss = compiled[batch["x"].shape](
@@ -289,6 +317,120 @@ def bench_torch_baseline(data_dir: str) -> float:
     return best
 
 
+def bench_torch_baseline_e2e(data_dir: str) -> float:
+    """The BASELINE.md comparator measured end to end: a stock
+    pyarrow.dataset → torch DataLoader pipeline DELIVERING INTO the same
+    jitted train step on the same chip ("rows/sec/chip ≥ GPU-DataLoader
+    rows/sec/chip" is a delivery-to-accelerator metric).  No repo imports:
+    the model is the same 16→256→2 adam MLP written inline, fed the way a
+    framework-less user feeds it — float32 [B, F] host batches, synchronous
+    device_put, jit on first call.  The baseline keeps DataLoader worker
+    parallelism: every jax device op is deferred until after the persistent
+    workers have forked (fork-before-backend-init is safe; the workers
+    survive across epochs, so no later fork sees an initialized runtime)."""
+    try:
+        import torch
+        from torch.utils.data import DataLoader, IterableDataset
+    except ImportError:
+        return float("nan")
+
+    import pyarrow.dataset as pads
+
+    files = sorted(
+        os.path.join(data_dir, f) for f in os.listdir(data_dir) if f.endswith(".parquet")
+    )
+
+    class DS(IterableDataset):
+        def __iter__(self):
+            import torch.utils.data as tud
+
+            info = tud.get_worker_info()
+            mine = (
+                files
+                if info is None
+                else [f for i, f in enumerate(files) if i % info.num_workers == info.id]
+            )
+            if not mine:
+                return
+            ds = pads.dataset(mine, format="parquet")
+            yield from ds.to_batches(batch_size=BATCH)
+
+    def collate(batches):
+        b = batches[0]
+        x = np.stack(
+            [b.column(f"f{i}").to_numpy(zero_copy_only=False) for i in range(N_FEATURES)],
+            axis=1,
+        )
+        y = b.column("label").to_numpy(zero_copy_only=False).astype(np.int32)
+        return torch.from_numpy(x), torch.from_numpy(y)
+
+    state = {}  # jax model state, built lazily AFTER workers fork
+
+    def make_step():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        def loss_fn(params, x, y):
+            h = jax.nn.relu(x @ params[0]["w"] + params[0]["b"])
+            logits = h @ params[1]["w"] + params[1]["b"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+        tx = optax.adam(1e-3)
+        params = []
+        key = jax.random.key(0)
+        for a, b in zip((N_FEATURES, 256), (256, 2)):
+            key, sub = jax.random.split(key)
+            params.append({"w": jax.random.normal(sub, (a, b)) * (2.0 / a) ** 0.5,
+                           "b": jnp.zeros((b,))})
+
+        @jax.jit
+        def step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        state.update(params=params, opt_state=tx.init(params), step=step)
+
+    best = 0.0
+    for workers in (2, 0):
+        try:
+            kw = {"num_workers": workers, "persistent_workers": True} if workers else {}
+            # ONE loader across epochs: persistent workers fork exactly once,
+            # at first iteration — BEFORE any jax device op in this leg
+            # (state is built lazily below), so the forked children never
+            # inherit an initialized TPU runtime and no timed epoch pays
+            # worker startup twice
+            loader = DataLoader(DS(), batch_size=1, collate_fn=collate, **kw)
+            for _ in range(2):  # best-of: first epoch pays the jit compile
+                import jax
+
+                rows = 0
+                loss = None
+                start = time.perf_counter()
+                for x, y in loader:
+                    if not state:
+                        make_step()  # workers are alive; jax init is safe now
+                    state["params"], state["opt_state"], loss = state["step"](
+                        state["params"], state["opt_state"],
+                        jax.device_put(x.numpy()), jax.device_put(y.numpy()),
+                    )
+                    rows += len(x)
+                jax.block_until_ready(loss)
+                dt = time.perf_counter() - start
+                best = max(best, rows / dt)
+        except Exception as e:
+            if workers == 0:
+                raise  # the in-process leg must work; the worker leg may not
+            # a degraded baseline inflates vs_baseline — say so, loudly
+            sys.stderr.write(
+                f"bench: baseline_e2e worker leg failed ({e!r}); "
+                "baseline is the single-process measurement only\n"
+            )
+    return best
+
+
 def bench_ann() -> tuple[float, float]:
     """Device-resident batched ANN search: (QPS, recall@10)."""
     from lakesoul_tpu.vector.config import VectorIndexConfig
@@ -303,8 +445,12 @@ def bench_ann() -> tuple[float, float]:
     queries = vectors[rng.choice(ANN_N, ANN_Q, replace=False)] + rng.normal(
         scale=0.05, size=(ANN_Q, ANN_D)
     ).astype(np.float32)
-    params = SearchParams(top_k=10, nprobe=32)
-    index.batch_search(queries[:64], params)  # warm-up compile
+    # full probe + deep exact re-rank: the device-resident kernel scans every
+    # packed code regardless of nprobe (the probe set only gates inclusion),
+    # so probing all clusters costs nothing extra on this path and recall is
+    # bounded only by the re-rank shortlist (measured 1.00 at depth 100)
+    params = SearchParams(top_k=10, nprobe=128, rerank_depth=100)
+    index.batch_search(queries[:256], params)  # warm-up the chunk shape (MAX_Q)
     qps = 0.0
     for _ in range(2):  # best-of-2 damps chip-link variance
         start = time.perf_counter()
@@ -437,6 +583,10 @@ def run_one_leg(leg: str) -> None:
         print(json.dumps({"baseline": bench_torch_baseline(
             os.path.join(warehouse, f"baseline_{N_ROWS}"))}))
         return
+    if leg == "baseline_e2e":
+        print(json.dumps({"baseline": bench_torch_baseline_e2e(
+            os.path.join(warehouse, f"baseline_{N_ROWS}"))}))
+        return
     if leg == "remote":
         cold, warm, rate = bench_remote()
         print(json.dumps({"cold": cold, "warm": warm, "hit_rate": rate}))
@@ -447,6 +597,9 @@ def run_one_leg(leg: str) -> None:
         return
     catalog = LakeSoulCatalog(warehouse)
     t = catalog.table(f"bench_{N_ROWS}_lsf")
+    if leg == "train_hbm":
+        print(json.dumps({"rows_per_s": bench_lakesoul(t, epochs=3, device_cache=True)}))
+        return
     print(json.dumps({"rows_per_s": bench_lakesoul(t, epochs=5)}))
 
 
@@ -482,7 +635,8 @@ def main():
     t = build_table(catalog)
     build_baseline_dataset(warehouse)
 
-    baseline = _run_leg("baseline")["baseline"]
+    baseline_host = _run_leg("baseline")["baseline"]
+    baseline = _run_leg("baseline_e2e")["baseline"]
     remote = _run_leg("remote")
 
     # leg 1: live MOR — uncompacted bucket stacks, the merge does real work.
@@ -496,10 +650,15 @@ def main():
     # comes from bucket parallelism + aggressive compaction, SURVEY §7)
     t.compact()
     value = _run_leg("train")["rows_per_s"]
+    hbm = _run_leg("train_hbm")["rows_per_s"]
     ann = _run_leg("ann")
-    # vs_baseline is null when torch isn't available — a fake 1.0 would be
-    # indistinguishable from a genuinely measured parity result
+    # vs_baseline compares like for like: both sides deliver rows into the
+    # SAME jitted train step on the same chip (BASELINE.md's metric); the
+    # host-only decode ratio is kept alongside for continuity with r1/r2.
+    # Null when torch isn't available — a fake 1.0 would be
+    # indistinguishable from a genuinely measured parity result.
     vs = round(value / baseline, 3) if baseline == baseline else None
+    vs_host = round(value / baseline_host, 3) if baseline_host == baseline_host else None
     print(
         json.dumps(
             {
@@ -507,8 +666,10 @@ def main():
                 "value": round(value, 1),
                 "unit": "rows/s/chip",
                 "vs_baseline": vs,
+                "vs_baseline_host_decode_only": vs_host,
                 "device": device_label,
                 "mor_uncompacted_rows_per_s": round(mor, 1),
+                "hbm_resident_replay_rows_per_s": round(hbm, 1),
                 "ann_qps": round(ann["qps"], 1),
                 "ann_recall_at_10": round(ann["recall"], 4),
                 "remote_cold_rows_per_s": round(remote["cold"], 1),
