@@ -31,6 +31,11 @@ class BertConfig:
     ff: int = 3072
     max_len: int = 512
     dtype: str = "bfloat16"
+    # MoE: n_experts > 0 swaps every FFN for a top-1 Switch MoE layer
+    # (parallel/moe.py) with experts sharded over the 'ep' mesh axis
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def base() -> "BertConfig":
@@ -58,48 +63,64 @@ def init_bert_params(cfg: BertConfig, key: jax.Array) -> dict:
         return (jax.random.normal(key, shape) * std).astype(jnp.float32)
 
     ks = jax.random.split(k_layers, 8)
+    layers = {
+        "wq": norm(ks[0], (L, h, h)),
+        "wk": norm(ks[1], (L, h, h)),
+        "wv": norm(ks[2], (L, h, h)),
+        "wo": norm(ks[3], (L, h, h)),
+        "ln1": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+        "ln2": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+    }
+    if cfg.n_experts:
+        from lakesoul_tpu.parallel.moe import init_moe_ffn_params
+
+        layers["moe"] = init_moe_ffn_params(ks[4], L, h, f, cfg.n_experts, std=std)
+    else:
+        layers.update(
+            w1=norm(ks[4], (L, h, f)),
+            w2=norm(ks[5], (L, f, h)),
+            b1=jnp.zeros((L, f)),
+            b2=jnp.zeros((L, h)),
+        )
     params = {
         "tok_emb": norm(k_emb, (cfg.vocab_size, h)),
         "pos_emb": norm(k_pos, (cfg.max_len, h)),
         "emb_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
-        "layers": {
-            "wq": norm(ks[0], (L, h, h)),
-            "wk": norm(ks[1], (L, h, h)),
-            "wv": norm(ks[2], (L, h, h)),
-            "wo": norm(ks[3], (L, h, h)),
-            "w1": norm(ks[4], (L, h, f)),
-            "w2": norm(ks[5], (L, f, h)),
-            "b1": jnp.zeros((L, f)),
-            "b2": jnp.zeros((L, h)),
-            "ln1": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
-            "ln2": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
-        },
+        "layers": layers,
         "mlm_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
         "mlm_bias": jnp.zeros((cfg.vocab_size,)),
     }
     return params
 
 
-def param_sharding_rules(plan) -> dict:
+def param_sharding_rules(plan, *, n_experts: int = 0) -> dict:
     """PartitionSpecs per parameter path for a MeshPlan: FFN and QKV/out
     projections tensor-sharded over 'tp' (Megatron column/row split),
-    embeddings replicated."""
+    embeddings replicated; with MoE, expert weights sharded over 'ep'."""
+    layers = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "ln1": {"scale": P(), "bias": P()},
+        "ln2": {"scale": P(), "bias": P()},
+    }
+    if n_experts:
+        from lakesoul_tpu.parallel.moe import moe_param_rules
+
+        layers["moe"] = moe_param_rules()
+    else:
+        layers.update(
+            w1=P(None, None, "tp"),
+            w2=P(None, "tp", None),
+            b1=P(None, "tp"),
+            b2=P(None, None),
+        )
     rules = {
         "tok_emb": P(),
         "pos_emb": P(),
         "emb_ln": {"scale": P(), "bias": P()},
-        "layers": {
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "w1": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
-            "b1": P(None, "tp"),
-            "b2": P(None, None),
-            "ln1": {"scale": P(), "bias": P()},
-            "ln2": {"scale": P(), "bias": P()},
-        },
+        "layers": layers,
         "mlm_ln": {"scale": P(), "bias": P()},
         "mlm_bias": P(),
     }
@@ -114,6 +135,68 @@ def _layer_norm(x, scale, bias, eps=1e-6):
     return (y * scale + bias).astype(x.dtype)
 
 
+def default_attention(q, k, v, mask):
+    """Plain full attention [B, H, T, D] (single-device sequence)."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / np.sqrt(D)
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+def bert_layer(x, lp, attn_mask, *, cfg: BertConfig, attention_fn=None,
+               moe_ep_sharding=None):
+    """One pre-LN transformer block: x [B, T, h] → (x, aux_loss).
+
+    Module-level (not a closure) so the pipeline-parallel path
+    (parallel/pipeline.py stages) applies the same block the lax.scan
+    encoder does.  aux_loss is the MoE load-balancing term (0 for dense)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = x.shape[0], x.shape[1]
+    H, D = cfg.heads, cfg.head_dim
+    if attention_fn is None:
+        attention_fn = default_attention
+    y = _layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+    q = (y @ lp["wq"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+    k = (y @ lp["wk"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+    v = (y @ lp["wv"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+    a = attention_fn(q, k, v, attn_mask)
+    a = a.transpose(0, 2, 1, 3).reshape(B, T, cfg.hidden)
+    x = x + (a @ lp["wo"].astype(dtype))
+    y = _layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
+    if cfg.n_experts:
+        from lakesoul_tpu.parallel.moe import moe_ffn
+
+        m = lp["moe"]
+        out, aux = moe_ffn(
+            y.reshape(B * T, cfg.hidden),
+            m["gate_w"], m["w1"], m["b1"], m["w2"], m["b2"],
+            capacity_factor=cfg.capacity_factor, ep_sharding=moe_ep_sharding,
+        )
+        x = x + out.reshape(B, T, cfg.hidden)
+    else:
+        hdn = jax.nn.gelu(y @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
+        x = x + (hdn @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype))
+        aux = jnp.float32(0.0)
+    return x, aux
+
+
+def bert_embed(params, input_ids, *, cfg: BertConfig) -> jax.Array:
+    T = input_ids.shape[1]
+    x = params["tok_emb"][input_ids] + params["pos_emb"][:T][None, :, :]
+    x = _layer_norm(x, params["emb_ln"]["scale"], params["emb_ln"]["bias"])
+    return x.astype(jnp.dtype(cfg.dtype))
+
+
+def bert_head(params, x) -> jax.Array:
+    x = _layer_norm(x, params["mlm_ln"]["scale"], params["mlm_ln"]["bias"])
+    # weight-tied MLM head
+    return jnp.einsum(
+        "bth,vh->btv", x.astype(jnp.float32), params["tok_emb"], preferred_element_type=jnp.float32
+    ) + params["mlm_bias"]
+
+
 def bert_forward(
     params: dict,
     input_ids: jax.Array,
@@ -121,54 +204,43 @@ def bert_forward(
     *,
     cfg: BertConfig,
     attention_fn=None,
-) -> jax.Array:
-    """Encoder forward → MLM logits [B, T, vocab].
+    moe_ep_sharding=None,
+    with_aux: bool = False,
+):
+    """Encoder forward → MLM logits [B, T, vocab] (or (logits, aux) with
+    ``with_aux`` — aux is the summed MoE load-balancing loss).
 
     ``attention_fn(q, k, v, mask)`` defaults to plain full attention;
     pass ``make_ring_attention(mesh)`` for sequence parallelism."""
-    dtype = jnp.dtype(cfg.dtype)
     B, T = input_ids.shape
     if attn_mask is None:
         attn_mask = jnp.ones((B, T), dtype=bool)
     else:
         attn_mask = attn_mask.astype(bool)
 
-    x = params["tok_emb"][input_ids] + params["pos_emb"][:T][None, :, :]
-    x = _layer_norm(x, params["emb_ln"]["scale"], params["emb_ln"]["bias"]).astype(dtype)
+    x = bert_embed(params, input_ids, cfg=cfg)
 
-    H, D = cfg.heads, cfg.head_dim
+    def layer(carry, lp):
+        x, aux = carry
+        x, a = bert_layer(x, lp, attn_mask, cfg=cfg, attention_fn=attention_fn,
+                          moe_ep_sharding=moe_ep_sharding)
+        return (x, aux + a), None
 
-    if attention_fn is None:
+    (x, aux), _ = jax.lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+    logits = bert_head(params, x)
+    return (logits, aux) if with_aux else logits
 
-        def attention_fn(q, k, v, mask):
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
-            s = s / np.sqrt(D)
-            s = jnp.where(mask[:, None, None, :], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-            return jnp.einsum("bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32).astype(v.dtype)
 
-    def layer(x, lp):
-        # pre-LN transformer block
-        y = _layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
-        q = (y @ lp["wq"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
-        k = (y @ lp["wk"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
-        v = (y @ lp["wv"].astype(dtype)).reshape(B, T, H, D).transpose(0, 2, 1, 3)
-        a = attention_fn(q, k, v, attn_mask)
-        a = a.transpose(0, 2, 1, 3).reshape(B, T, cfg.hidden)
-        x = x + (a @ lp["wo"].astype(dtype))
-        y = _layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
-        hdn = jax.nn.gelu(y @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
-        x = x + (hdn @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype))
-        return x, None
-
-    x, _ = jax.lax.scan(layer, x, params["layers"])
-
-    x = _layer_norm(x, params["mlm_ln"]["scale"], params["mlm_ln"]["bias"])
-    # weight-tied MLM head
-    logits = jnp.einsum(
-        "bth,vh->btv", x.astype(jnp.float32), params["tok_emb"], preferred_element_type=jnp.float32
-    ) + params["mlm_bias"]
-    return logits
+def masked_nll(logits, labels):
+    """Mean NLL over positions with labels >= 0 (-100 = ignore) — shared by
+    the scan-encoder loss and the pipelined loss so the two can never drift
+    (their exact equality is pinned in tests)."""
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
 
 
 def bert_mlm_loss(
@@ -179,12 +251,15 @@ def bert_mlm_loss(
     *,
     cfg: BertConfig,
     attention_fn=None,
+    moe_ep_sharding=None,
 ) -> jax.Array:
-    """Masked-LM loss: labels == -100 are ignored."""
-    logits = bert_forward(params, input_ids, attn_mask, cfg=cfg, attention_fn=attention_fn)
-    valid = labels >= 0
-    safe_labels = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, nll, 0.0)
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+    """Masked-LM loss: labels == -100 are ignored.  With MoE configs the
+    Switch load-balancing auxiliary joins at cfg.moe_aux_weight."""
+    logits, aux = bert_forward(
+        params, input_ids, attn_mask, cfg=cfg, attention_fn=attention_fn,
+        moe_ep_sharding=moe_ep_sharding, with_aux=True,
+    )
+    loss = masked_nll(logits, labels)
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss

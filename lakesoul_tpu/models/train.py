@@ -11,6 +11,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -34,7 +35,7 @@ def _specs_to_shardings(mesh, rules):
 
 def make_bert_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, seed: int = 0):
     """Initialize (params, opt_state) laid out on the mesh."""
-    rules = param_sharding_rules(plan)
+    rules = param_sharding_rules(plan, n_experts=cfg.n_experts)
     shardings = _specs_to_shardings(plan.mesh, rules)
     init_fn = jax.jit(functools.partial(init_bert_params, cfg), out_shardings=shardings)
     params = init_fn(jax.random.key(seed))
@@ -70,7 +71,95 @@ def make_bert_train_step(
 
             attention_fn = make_ulysses_attention(plan.mesh)
     batch_sharding = NamedSharding(plan.mesh, P("dp", "sp"))
-    loss_fn = functools.partial(bert_mlm_loss, cfg=cfg, attention_fn=attention_fn)
+    loss_fn = functools.partial(
+        bert_mlm_loss, cfg=cfg, attention_fn=attention_fn,
+        # the ep constraint routes MoE dispatch over the expert axis; on an
+        # ep=1 mesh it is skipped (nothing to route)
+        moe_ep_sharding=(
+            NamedSharding(plan.mesh, P("ep", None, None)) if plan.ep > 1 else None
+        ),
+    )
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(param_shardings, None, batch_sharding, batch_sharding, batch_sharding),
+        out_shardings=(param_shardings, None, NamedSharding(plan.mesh, P())),
+        donate_argnums=(0, 1),
+    )
+    def train_step(params, opt_state, input_ids, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, mask)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def make_bert_pipeline_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, seed: int = 0):
+    """(params, opt_state) for the PIPELINE layout: the stacked layer axis is
+    sharded over 'pp' (each device materializes only its own stage's layers —
+    the memory win pipelining exists for), everything else as usual."""
+    if cfg.layers % max(plan.pp, 1):
+        raise ValueError(f"{cfg.layers} layers do not split over pp={plan.pp}")
+    if cfg.n_experts:
+        # MoE composes with dp/tp/sp/ep meshes (make_bert_train_state); a
+        # pipelined MoE stage would silently all-gather every expert into
+        # every stage, so reject rather than run the degraded layout
+        raise ValueError("pipeline layout does not support MoE configs")
+    rules = param_sharding_rules(plan, n_experts=cfg.n_experts)
+    for leaf in ("wq", "wk", "wv", "wo", "w1", "w2", "b1", "b2"):
+        if leaf in rules["layers"]:
+            spec = rules["layers"][leaf]
+            rules["layers"][leaf] = P("pp", *spec[1:])
+    for ln in ("ln1", "ln2"):
+        rules["layers"][ln] = {"scale": P("pp", None), "bias": P("pp", None)}
+    shardings = _specs_to_shardings(plan.mesh, rules)
+    init_fn = jax.jit(functools.partial(init_bert_params, cfg), out_shardings=shardings)
+    params = init_fn(jax.random.key(seed))
+    tx = optax.adamw(lr)
+    return params, tx.init(params), tx, shardings
+
+
+def make_bert_pipeline_train_step(
+    cfg: BertConfig, plan: MeshPlan, tx, param_shardings, *, n_micro: int = 4,
+):
+    """Jitted MLM train step with the encoder pipelined over 'pp': embeddings
+    and head run replicated; microbatches stream through the stage ring
+    (parallel/pipeline.py) and autodiff through scan+ppermute is the reverse
+    pipeline.  Batch arrives sharded P('dp') and is split into n_micro
+    microbatches inside the step."""
+    from lakesoul_tpu.models.bert import bert_embed, bert_head, bert_layer, masked_nll
+    from lakesoul_tpu.parallel.pipeline import (
+        make_pipeline,
+        merge_microbatches,
+        split_microbatches,
+        split_stages,
+    )
+
+    pp = max(plan.pp, 1)
+
+    def stage_fn(stage_layers, inp):
+        def one(x, lp):
+            x, _ = bert_layer(x, lp, inp["mask"] != 0, cfg=cfg, moe_ep_sharding=None)
+            return x, None
+
+        x, _ = jax.lax.scan(one, inp["x"], stage_layers)
+        return {"x": x, "mask": inp["mask"]}
+
+    # microbatch batch-dim stays data-parallel through the stage ring
+    pipeline = make_pipeline(plan.mesh, stage_fn, micro_spec=P(None, "dp"))
+    batch_sharding = NamedSharding(plan.mesh, P("dp"))
+
+    def loss_fn(params, input_ids, labels, mask):
+        B = input_ids.shape[0]
+        x = bert_embed(params, input_ids, cfg=cfg)
+        # mask rides the ring as int32: the collection psum over pp cannot
+        # take booleans
+        micro = split_microbatches({"x": x, "mask": mask.astype(jnp.int32)}, n_micro)
+        stages = split_stages(params["layers"], pp)
+        out = pipeline(stages, micro)
+        x = merge_microbatches(out, B)["x"]
+        return masked_nll(bert_head(params, x), labels)
 
     @functools.partial(
         jax.jit,

@@ -6,9 +6,13 @@ by its north-star consumers (ResNet-50 / BERT training, BASELINE.json):
 
 - ``dp``  — data parallel over batch
 - ``tp``  — tensor parallel over heads / ffn
-- ``sp``  — sequence parallel (ring attention) for long context
+- ``sp``  — sequence parallel (ring attention / Ulysses) for long context
+- ``pp``  — pipeline parallel over the layer stack (parallel/pipeline.py)
+- ``ep``  — expert parallel over MoE experts (parallel/moe.py)
 
-Meshes are pure ``jax.sharding.Mesh`` objects; shardings are expressed with
+Every mesh carries all five axis names (unused axes have size 1 — free, and
+it keeps PartitionSpecs valid across configurations).  Meshes are pure
+``jax.sharding.Mesh`` objects; shardings are expressed with
 ``NamedSharding`` + ``PartitionSpec`` so XLA inserts all collectives over ICI.
 """
 
@@ -29,6 +33,8 @@ class MeshPlan:
     dp: int
     tp: int
     sp: int
+    pp: int = 1
+    ep: int = 1
 
     @property
     def axis_names(self):
@@ -66,20 +72,27 @@ def make_mesh(
     dp: int | None = None,
     tp: int | None = None,
     sp: int | None = None,
+    pp: int | None = None,
+    ep: int | None = None,
 ) -> MeshPlan:
-    """Build a (dp, tp, sp) mesh over the given (default: all) devices.
-    Unspecified axis sizes are inferred from the device count."""
+    """Build a (dp, tp, sp, pp, ep) mesh over the given (default: all)
+    devices.  Unspecified axis sizes are inferred from the device count
+    (pp/ep default to 1 — they are opted into explicitly)."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
+    pp = pp or 1
+    ep = ep or 1
     if dp is None and tp is None and sp is None:
-        dp, tp, sp = _factor(n)
+        if n % (pp * ep):
+            raise ValueError(f"pp*ep={pp * ep} does not divide {n} devices")
+        dp, tp, sp = _factor(n // (pp * ep))
     else:
         dp = dp or 1
         tp = tp or 1
-        sp = sp or max(1, n // (dp * tp))
-    if dp * tp * sp != n:
-        raise ValueError(f"mesh {dp}x{tp}x{sp} != {n} devices")
-    arr = np.array(devices).reshape(dp, tp, sp)
-    mesh = Mesh(arr, ("dp", "tp", "sp"))
-    return MeshPlan(mesh=mesh, dp=dp, tp=tp, sp=sp)
+        sp = sp or max(1, n // (dp * tp * pp * ep))
+    if dp * tp * sp * pp * ep != n:
+        raise ValueError(f"mesh {dp}x{tp}x{sp}x{pp}x{ep} != {n} devices")
+    arr = np.array(devices).reshape(dp, tp, sp, pp, ep)
+    mesh = Mesh(arr, ("dp", "tp", "sp", "pp", "ep"))
+    return MeshPlan(mesh=mesh, dp=dp, tp=tp, sp=sp, pp=pp, ep=ep)
