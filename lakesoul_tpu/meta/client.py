@@ -70,6 +70,20 @@ def dict_to_partition_desc(d: dict[str, str], range_cols: list[str]) -> str:
     return ",".join(f"{c}={d[c]}" for c in range_cols)
 
 
+def canonical_partition_desc(desc: str, range_cols: list[str]) -> str:
+    """Re-order a ``k=v[,k=v...]`` desc into range-column order.  The store
+    keeps ONE canonical desc per partition so planner fast paths (point
+    lookup, desc-prefix index ranges) can hit the primary-key index; descs
+    whose keys don't match the table's range columns pass through untouched
+    (caller-owned formats stay the caller's problem)."""
+    if not desc or desc == NO_PARTITION_DESC or not range_cols:
+        return desc
+    d = partition_desc_to_dict(desc)
+    if set(d) != set(range_cols):
+        return desc
+    return dict_to_partition_desc(d, range_cols)
+
+
 @dataclass
 class PartitionCursor:
     """Follow-stream position for one partition: the last consumed version
@@ -216,7 +230,12 @@ class MetaDataClient:
         conflict (another committer won the version) the current head is
         re-read and the commit retried — Append/Merge simply stack on the new
         head; Compaction/Update re-validate their read version and abort if
-        the partition moved (the caller must re-run on fresh data)."""
+        the partition moved (the caller must re-run on fresh data).
+
+        Callers building MetaInfo by hand must use canonical partition descs
+        (range-column order; ``dict_to_partition_desc``) — phase 1 already
+        inserted data commits under the same desc, and planner fast paths
+        index on the canonical form.  ``commit_data_files`` does this for you."""
         if meta_info.table_info is None:
             raise MetadataError("table info missing")
         last_err: Exception | None = None
@@ -363,12 +382,18 @@ class MetaDataClient:
         and committed is skipped (the Flink exactly-once pattern,
         LakeSoulSinkGlobalCommitter.java:95).  A skipped replay deletes the
         freshly re-staged duplicate files (they are unknown to the durable
-        commit and would otherwise orphan on the object store forever)."""
+        commit and would otherwise orphan on the object store forever).
+
+        Partition-desc keys are canonicalized to range-column order on entry
+        so the stored desc is unique per partition regardless of how the
+        caller ordered the k=v pairs (planner fast paths index on it)."""
+        range_cols = table_info.range_partition_columns
         new_commits: list[DataCommitInfo] = []
         partitions: list[PartitionInfo] = []
         done_ids: list[tuple[str, str]] = []  # (partition_desc, commit_id) to flag committed
-        for desc, file_ops in files_by_partition.items():
-            cid = (commit_id_by_partition or {}).get(desc) or DataCommitInfo.new_commit_id()
+        for raw_desc, file_ops in files_by_partition.items():
+            desc = canonical_partition_desc(raw_desc, range_cols)
+            cid = (commit_id_by_partition or {}).get(raw_desc) or DataCommitInfo.new_commit_id()
             state = self.store.commit_state(table_info.table_id, desc, cid)
             if state is True:
                 # fully durable already: idempotent replay is a no-op — but the
@@ -448,13 +473,40 @@ class MetaDataClient:
         self, table_info: TableInfo, partitions: dict[str, str] | None
     ) -> list[PartitionInfo]:
         partitions = partitions or {}
-        all_latest = self.store.get_all_latest_partition_info(table_info.table_id)
         if not partitions:
-            return all_latest
+            return self.store.get_all_latest_partition_info(table_info.table_id)
+        range_cols = table_info.range_partition_columns
+        if set(partitions) == set(range_cols):
+            # fully-specified filter: one indexed point lookup, O(1) in the
+            # partition count — this is the shape behind the reference 3.0
+            # "~50 ms plan over millions of partitions" claim.  A miss falls
+            # through to the scan below: stores written before descs were
+            # canonicalized on commit may hold the k=v pairs in another order.
+            desc = dict_to_partition_desc(partitions, range_cols)
+            p = self.store.get_latest_partition_info(table_info.table_id, desc)
+            if p is not None:
+                return [p]
         wanted = [f"{k}={v}" for k, v in partitions.items()]
+        n_lead = 0
+        while n_lead < len(range_cols) and range_cols[n_lead] in partitions:
+            n_lead += 1
+        if n_lead == len(range_cols):
+            # point lookup above missed: only a legacy non-canonical desc can
+            # still match, and it won't start with the canonical prefix either
+            n_lead = 0
+        if n_lead:
+            # leading range columns pinned: push an indexed desc-prefix range
+            # into the store (trailing separator stops d1 matching d10)
+            prefix = ",".join(f"{c}={partitions[c]}" for c in range_cols[:n_lead])
+            prefix += "," if n_lead < len(range_cols) else ""
+            candidates = self.store.get_all_latest_partition_info(
+                table_info.table_id, desc_prefix=prefix
+            )
+        else:
+            candidates = self.store.get_all_latest_partition_info(table_info.table_id)
         return [
             p
-            for p in all_latest
+            for p in candidates
             if all(w in p.partition_desc.split(",") for w in wanted)
         ]
 

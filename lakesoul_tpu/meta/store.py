@@ -497,14 +497,25 @@ class SqlMetadataStore(MetadataStore):
         ).fetchone()
         return self._row_to_partition(row) if row else None
 
-    def get_all_latest_partition_info(self, table_id: str) -> list[PartitionInfo]:
-        """Latest version per partition_desc."""
-        rows = self._exec(self._conn(), 
-            f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND version ="
-            " (SELECT MAX(version) FROM partition_info p2 WHERE p2.table_id=partition_info.table_id"
-            "  AND p2.partition_desc=partition_info.partition_desc)",
-            (table_id,),
-        ).fetchall()
+    def get_all_latest_partition_info(
+        self, table_id: str, desc_prefix: str | None = None
+    ) -> list[PartitionInfo]:
+        """Latest version per partition_desc.  ``desc_prefix`` narrows the
+        scan to descs starting with that string via an index range on the
+        (table_id, partition_desc, version) primary key — the planner uses it
+        to push a range-column prefix filter into the store instead of
+        fetching every partition (reference pushes the same filter into PG,
+        metadata_client.rs get_all_partition_info + partition filters)."""
+        sql = f"SELECT {self._PI_COLS} FROM partition_info WHERE table_id=? AND version =" \
+            " (SELECT MAX(version) FROM partition_info p2 WHERE p2.table_id=partition_info.table_id" \
+            "  AND p2.partition_desc=partition_info.partition_desc)"
+        params: tuple = (table_id,)
+        if desc_prefix is not None:
+            # half-open range [prefix, prefix+U+FFFF) rides the PK index where
+            # LIKE would not (sqlite case_sensitive_like, PG collations)
+            sql += " AND partition_desc >= ? AND partition_desc < ?"
+            params += (desc_prefix, desc_prefix + "￿")
+        rows = self._exec(self._conn(), sql, params).fetchall()
         return [self._row_to_partition(r) for r in rows]
 
     def get_partition_versions(

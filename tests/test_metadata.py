@@ -233,6 +233,35 @@ class TestScanPlan:
         assert len(plan) == 1
         assert plan[0].partition_values == {"date": "2024-01-01"}
 
+    def test_filter_fast_paths_multi_column(self, client):
+        """Point-lookup, prefix-range, and unindexed paths all agree — and
+        descs committed with k=v pairs in the wrong order are canonicalized
+        on entry so every filter shape still finds them."""
+        schema = pa.schema(
+            [("id", pa.int64()), ("a", pa.string()), ("b", pa.string())]
+        )
+        info = client.create_table(
+            "t4", "/tmp/wh/t4", schema, primary_keys=["id"],
+            range_partitions=["a", "b"],
+        )
+        append_files(client, info, "a=1,b=2", ["/f/p1_0000.parquet"])
+        append_files(client, info, "b=4,a=3", ["/f/p2_0000.parquet"])  # wrong order
+        # fully specified → indexed point lookup
+        for f in ({"a": "1", "b": "2"}, {"b": "4", "a": "3"}):
+            plan = client.get_scan_plan_partitions("t4", partitions=f)
+            assert len(plan) == 1, f
+        # leading-prefix → indexed desc range; d1 must not match d10-style descs
+        append_files(client, info, "a=11,b=2", ["/f/p3_0000.parquet"])
+        plan = client.get_scan_plan_partitions("t4", partitions={"a": "1"})
+        assert {p.partition_desc for p in plan} == {"a=1,b=2"}
+        # non-leading column → full-scan filter path
+        plan = client.get_scan_plan_partitions("t4", partitions={"b": "2"})
+        assert {p.partition_desc for p in plan} == {"a=1,b=2", "a=11,b=2"}
+        # stored desc is the canonical form even for the out-of-order commit
+        assert client.store.get_latest_partition_info(info.table_id, "a=3,b=4")
+        # fully-specified miss is still just empty, not an error
+        assert client.get_scan_plan_partitions("t4", partitions={"a": "9", "b": "9"}) == []
+
 
 class TestTimeTravel:
     def test_snapshot_and_incremental(self, client):
