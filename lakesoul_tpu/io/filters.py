@@ -116,7 +116,7 @@ class Filter:
         if self.op == "substrait":
             d["substrait_b64"] = base64.b64encode(self.value).decode()
         elif self.value is not None or self.op == "eq":
-            d["value"] = self.value
+            d["value"] = _encode_value(self.value)
         if self.args:
             d["args"] = [a._to_dict() for a in self.args]
         return d
@@ -132,9 +132,55 @@ class Filter:
         return cls(
             op=d["op"],
             col=d.get("col"),
-            value=d.get("value"),
+            value=_decode_value(d.get("value")),
             args=tuple(cls._from_dict(a) for a in d.get("args", ())),
         )
+
+
+def _encode_value(v):
+    """JSON-portable encoding for non-native scalar types so temporal/decimal
+    /binary predicates survive the wire (Flight tickets, checkpointed scans).
+    Tagged single-key dicts keep plain values untouched."""
+    import datetime
+    import decimal
+
+    if isinstance(v, list):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, datetime.datetime):
+        return {"$ts": v.isoformat()}
+    if isinstance(v, datetime.date):
+        return {"$date": v.isoformat()}
+    if isinstance(v, datetime.timedelta):
+        # integer math: total_seconds() is a float and drops microseconds
+        # once the duration exceeds float64's exact-integer range
+        us = (v.days * 86_400 + v.seconds) * 1_000_000 + v.microseconds
+        return {"$dur_us": us}
+    if isinstance(v, decimal.Decimal):
+        return {"$dec": str(v)}
+    if isinstance(v, (bytes, bytearray)):
+        return {"$b64": base64.b64encode(v).decode()}
+    return v
+
+
+def _decode_value(v):
+    import datetime
+    import decimal
+
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    if isinstance(v, dict) and len(v) == 1:
+        ((tag, x),) = v.items()
+        if tag == "$ts":
+            return datetime.datetime.fromisoformat(x)
+        if tag == "$date":
+            return datetime.date.fromisoformat(x)
+        if tag == "$dur_us":
+            return datetime.timedelta(microseconds=x)
+        if tag == "$dec":
+            return decimal.Decimal(x)
+        if tag == "$b64":
+            return base64.b64decode(x)
+    return v
 
 
 class col:

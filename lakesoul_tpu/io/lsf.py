@@ -237,6 +237,11 @@ def _encode_column(arr: pa.Array, field: pa.Field, w: _BufferWriter) -> dict:
             lo, hi = float(np.min(vals)), float(np.max(vals))
             if np.isfinite([lo, hi]).all():  # NaN or ±inf anywhere → no stats
                 meta["stats"] = [lo, hi]
+        elif n:
+            # temporal types are ints on the wire (vals is already the int
+            # view); stats enable zone pruning on timestamp/date predicates.
+            # The 0 null-fill (= epoch) can only widen the range — sound.
+            meta["stats"] = [int(vals.min()), int(vals.max())]
         return meta
 
     if pa.types.is_string(t) or pa.types.is_large_string(t) \
@@ -520,8 +525,33 @@ class LsfFile:
             return pa.table({"__dummy": pa.nulls(n)}).select([])
         return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
+    def _normalize_zone(self, zone_predicates):
+        """Convert temporal predicate values (datetime/date/timedelta) to the
+        column's wire integers so they compare against the int stats; arrow's
+        own scalar conversion keeps semantics (units, tz) identical to the
+        exact filter.  Unconvertible values pass through — _zone_refutes
+        already treats cross-type comparisons as non-refuting."""
+        if not zone_predicates:
+            return zone_predicates
+        out = []
+        for col, op, value in zone_predicates:
+            try:
+                t = self.schema.field(col).type
+                if pa.types.is_timestamp(t) or pa.types.is_date(t) \
+                        or pa.types.is_time(t) or pa.types.is_duration(t):
+                    as_int = pa.int32() if t.bit_width == 32 else pa.int64()
+                    if op == "in":
+                        value = [pa.scalar(v, type=t).cast(as_int).as_py() for v in value]
+                    else:
+                        value = pa.scalar(value, type=t).cast(as_int).as_py()
+            except (KeyError, pa.ArrowInvalid, pa.ArrowNotImplementedError, TypeError):
+                pass
+            out.append((col, op, value))
+        return out
+
     def read(self, columns: list[str] | None = None, arrow_filter=None,
              zone_predicates=None) -> pa.Table:
+        zone_predicates = self._normalize_zone(zone_predicates)
         chunks = [
             c for c in self._footer["chunks"]
             if not self._zone_refutes(c, zone_predicates)
@@ -546,6 +576,7 @@ class LsfFile:
 
     def iter_batches(self, columns=None, arrow_filter=None, batch_size=65_536,
                      zone_predicates=None):
+        zone_predicates = self._normalize_zone(zone_predicates)
         for chunk in self._footer["chunks"]:
             if self._zone_refutes(chunk, zone_predicates):
                 continue

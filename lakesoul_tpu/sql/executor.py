@@ -657,7 +657,22 @@ class SqlSession:
                 seen.add(k)
                 call_specs.append((target, fn))
 
-        grouped = work.group_by(list(stmt.group_by)).aggregate(call_specs)
+        # ROLLUP/CUBE/GROUPING SETS: aggregate once per set; grouping columns
+        # absent from a set surface as NULL in its (subtotal) rows
+        sets = (
+            stmt.grouping_sets if stmt.grouping_sets is not None else [list(stmt.group_by)]
+        )
+        agg_names = [
+            "count_all" if not target else f"{target}_{fn}" for target, fn in call_specs
+        ]
+        parts = []
+        for s in sets:
+            g = work.group_by(list(s)).aggregate(call_specs)
+            for c in stmt.group_by:
+                if c not in s:
+                    g = g.append_column(c, pa.nulls(len(g), type=work.schema.field(c).type))
+            parts.append(g.select(agg_names + list(stmt.group_by)))
+        grouped = parts[0] if len(parts) == 1 else pa.concat_tables(parts)
 
         if having is not None:
             mask = self._eval_bool(_subst_aggs_bool(having, agg_col), grouped)

@@ -227,6 +227,9 @@ class Select:
     joins: list = field(default_factory=list)
     where: Any = None
     group_by: list[str] = field(default_factory=list)
+    # ROLLUP/CUBE/GROUPING SETS: the list of grouping sets (each a subset of
+    # group_by); None = plain GROUP BY (one set = group_by itself)
+    grouping_sets: list | None = None
     having: Any = None
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
     limit: int | None = None
@@ -502,9 +505,7 @@ class Parser:
             sel.where = self._bool_expr()
         if self.accept("kw", "group"):
             self.expect("kw", "by")
-            sel.group_by.append(self._qualified_ident()[1])
-            while self.accept("op", ","):
-                sel.group_by.append(self._qualified_ident()[1])
+            self._group_by_clause(sel)
         if self.accept("kw", "having"):
             sel.having = self._bool_expr()
         if self.accept("kw", "order"):
@@ -522,6 +523,71 @@ class Parser:
         if self.accept("kw", "limit"):
             sel.limit = int(self.expect("number").value)
         return sel
+
+    def _group_by_clause(self, sel: Select) -> None:
+        """Plain column list, or ROLLUP(...) / CUBE(...) / GROUPING SETS
+        ((...), ...).  The analytic forms expand to explicit grouping sets
+        here, like DataFusion's planner; missing grouping columns surface as
+        NULL in the subtotal rows.  The words are soft (idents) so columns
+        named rollup/cube/grouping still work in plain GROUP BY."""
+        tok = self.peek()
+        word = tok.value.lower() if tok is not None and tok.kind == "ident" else None
+
+        def _nth_is(n, kind, value=None):
+            i = self.pos + n
+            return i < len(self.tokens) and self.tokens[i].kind == kind and (
+                value is None or self.tokens[i].value.lower() == value
+            )
+
+        if word in ("rollup", "cube") and _nth_is(1, "op", "("):
+            self.next()
+            self.expect("op", "(")
+            cols = [self._qualified_ident()[1]]
+            while self.accept("op", ","):
+                cols.append(self._qualified_ident()[1])
+            self.expect("op", ")")
+            sel.group_by = cols
+            if word == "rollup":
+                sel.grouping_sets = [cols[:i] for i in range(len(cols), -1, -1)]
+            else:
+                from itertools import combinations
+
+                sel.grouping_sets = [
+                    list(c)
+                    for r in range(len(cols), -1, -1)
+                    for c in combinations(cols, r)
+                ]
+            return
+        if word == "grouping" and _nth_is(1, "ident", "sets") and _nth_is(2, "op", "("):
+            self.next()
+            self.next()
+            self.expect("op", "(")
+            sets: list[list[str]] = []
+            while True:
+                if self.accept("op", "("):
+                    s: list[str] = []
+                    if not self.accept("op", ")"):
+                        s.append(self._qualified_ident()[1])
+                        while self.accept("op", ","):
+                            s.append(self._qualified_ident()[1])
+                        self.expect("op", ")")
+                    sets.append(s)
+                else:
+                    sets.append([self._qualified_ident()[1]])
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+            seen: list[str] = []
+            for s in sets:
+                for c in s:
+                    if c not in seen:
+                        seen.append(c)
+            sel.group_by = seen
+            sel.grouping_sets = sets
+            return
+        sel.group_by.append(self._qualified_ident()[1])
+        while self.accept("op", ","):
+            sel.group_by.append(self._qualified_ident()[1])
 
     def _qualified_ident(self) -> tuple[str | None, str]:
         """→ (qualifier or None, column)."""
@@ -572,7 +638,10 @@ class Parser:
                 return Literal(left.value / right.value)
             py = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
                   "*": lambda a, b: a * b}[op]
-            return Literal(py(left.value, right.value))
+            try:
+                return Literal(py(left.value, right.value))
+            except TypeError as e:  # e.g. DATE '...' + 1
+                raise SqlError(f"invalid literal arithmetic: {e}")
         return Arith(op, left, right)
 
     def _arith_expr(self):
@@ -632,6 +701,21 @@ class Parser:
                 and self.tokens[self.pos + 1].kind == "op" \
                 and self.tokens[self.pos + 1].value == "(":
             return self._window_call()
+        if tok.kind == "ident" and tok.value.lower() in ("timestamp", "date") \
+                and self.pos + 1 < len(self.tokens) \
+                and self.tokens[self.pos + 1].kind == "string":
+            # typed temporal literals: TIMESTAMP '2026-07-02 00:00:00',
+            # DATE '2026-07-02' (standard SQL; DataFusion accepts the same)
+            kind = self.next().value.lower()
+            raw = self._value()
+            import datetime as _dt
+
+            try:
+                if kind == "date":
+                    return Literal(_dt.date.fromisoformat(raw))
+                return Literal(_dt.datetime.fromisoformat(raw))
+            except ValueError as e:
+                raise SqlError(f"invalid {kind.upper()} literal {raw!r}: {e}")
         _, name = self._qualified_ident()
         return Column(name)
 

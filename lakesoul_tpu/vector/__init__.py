@@ -1,4 +1,5 @@
 from lakesoul_tpu.vector.config import VectorIndexConfig
 from lakesoul_tpu.vector.index import IvfRabitqIndex, SearchParams
+from lakesoul_tpu.vector.serving import AnnEndpoint
 
-__all__ = ["VectorIndexConfig", "IvfRabitqIndex", "SearchParams"]
+__all__ = ["VectorIndexConfig", "IvfRabitqIndex", "SearchParams", "AnnEndpoint"]

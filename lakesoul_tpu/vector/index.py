@@ -458,6 +458,55 @@ class IvfRabitqIndex:
         return [o[0] for o in results], [o[1] for o in results]
 
     def _batch_search_device_resident(self, queries: np.ndarray, params: SearchParams):
+        nq = len(queries)
+        # chunk oversized batches: the kernel holds the (Q, 8*d8) query block
+        # and (tile, Q) output tile in VMEM, so Q is capped per call
+        MAX_Q = 256
+        if nq > MAX_Q:
+            bundle = self._get_device_bundle()
+            if bundle is None or (self._ex_bits and bundle["scales"] is None):
+                return None  # same guards as _dispatch_resident, pre-chunking
+            ids_all, d_all = [], []
+            for start in range(0, nq, MAX_Q):
+                ids_c, d_c = self._batch_search_device_resident(
+                    queries[start : start + MAX_Q], params
+                )
+                ids_all.extend(ids_c)
+                d_all.extend(d_c)
+            return ids_all, d_all
+        disp = self._dispatch_resident(queries, params)
+        if disp is None:
+            return None
+        return self._resolve_resident(*disp, params)
+
+    def search_async(self, query: np.ndarray, params: SearchParams = SearchParams()):
+        """Dispatch ONE query on the device-resident bundle WITHOUT waiting
+        and return a zero-arg resolver yielding (ids, dists).
+
+        JAX dispatch is asynchronous, so a serving loop overlaps the chip
+        round-trip by dispatching query i+1 before resolving query i — the
+        per-call link latency then bounds *latency*, not throughput.  Falls
+        back to the synchronous path (resolver returns a precomputed result)
+        when no resident bundle applies."""
+        query = np.asarray(query, dtype=np.float32)
+        disp = None
+        if getattr(self, "_device_cache_enabled", False):
+            disp = self._dispatch_resident(query[None, :], params)
+        if disp is None:
+            out = self.search(query, params)
+            return lambda: out
+        dists, idx, nq, bundle = disp
+
+        def resolve():
+            ids_b, d_b = self._resolve_resident(dists, idx, nq, bundle, params)
+            return ids_b[0], d_b[0]
+
+        return resolve
+
+    def _dispatch_resident(self, queries: np.ndarray, params: SearchParams):
+        """Device dispatch of a ≤MAX_Q query block against the resident
+        bundle; returns (device dists, device idx, nq, bundle) or None when
+        the resident path doesn't apply.  Does NOT block on the result."""
         import jax.numpy as jnp
 
         from lakesoul_tpu.vector.kernels import _fused_search_resident_batch, _on_tpu
@@ -468,18 +517,6 @@ class IvfRabitqIndex:
         if self._ex_bits and bundle["scales"] is None:
             return None  # legacy segments without scales: non-resident path
         nq = len(queries)
-        # chunk oversized batches: the kernel holds the (Q, 8*d8) query block
-        # and (tile, Q) output tile in VMEM, so Q is capped per call
-        MAX_Q = 256
-        if nq > MAX_Q:
-            ids_all, d_all = [], []
-            for start in range(0, nq, MAX_Q):
-                ids_c, d_c = self._batch_search_device_resident(
-                    queries[start : start + MAX_Q], params
-                )
-                ids_all.extend(ids_c)
-                d_all.extend(d_c)
-            return ids_all, d_all
         # bucket Q to a pow2 so variable batch sizes reuse compiled shapes
         nq_pad = 8
         while nq_pad < nq:
@@ -533,6 +570,12 @@ class IvfRabitqIndex:
                 d=self.quantizer.padded_dim, s=s, k=k,
                 use_pallas=_on_tpu(), do_rerank=do_rerank,
             )
+        return dists, idx, nq, bundle
+
+    @staticmethod
+    def _resolve_resident(dists, idx, nq, bundle, params):
+        """Host-side tail of a resident search: blocks on the device values
+        (np.asarray) and maps kernel row indices back to caller ids."""
         dists, idx = np.asarray(dists), np.asarray(idx)
         ids_out, d_out = [], []
         for qi in range(nq):

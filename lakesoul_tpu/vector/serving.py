@@ -1,0 +1,150 @@
+"""Micro-batching ANN serving endpoint.
+
+The resident Pallas kernel amortizes its fixed dispatch + link cost over the
+query axis (vector/kernels.py scans every packed code once per CALL, not per
+query), so the serving-side answer to "requests arrive one at a time" is the
+standard accelerator pattern: collect requests for up to ``max_wait_ms`` (or
+``max_batch``), run ONE fused batch search, fan results back out.  Throughput
+then tracks the batch kernel; per-request latency is bounded by the wait
+window plus one device round trip.
+
+The reference serves searches per-call from each engine thread
+(lakesoul-vector has no serving layer; vector_index.py:263 re-ranks caller
+side) — this endpoint is the TPU-native replacement for that role.
+
+    ep = AnnEndpoint(index, SearchParams(top_k=10), max_wait_ms=2.0)
+    ids, dists = ep.search(q)          # blocking, thread-safe
+    fut = ep.submit(q); ids, d = fut.result()   # async
+    ep.stats()                         # requests / batches / mean batch size
+    ep.close()
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from lakesoul_tpu.vector.index import SearchParams
+
+
+class AnnEndpoint:
+    """Thread-safe micro-batching front end over one ``IvfRabitqIndex``."""
+
+    def __init__(
+        self,
+        index,
+        params: SearchParams | None = None,
+        *,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.index = index
+        self.params = params or SearchParams()
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: list[tuple[np.ndarray, Future]] = []
+        self._closed = False
+        self._n_requests = 0
+        self._n_batches = 0
+        self._n_batched_requests = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------ API
+    def submit(self, query: np.ndarray) -> Future:
+        """Enqueue one query; the Future resolves to (ids, dists)."""
+        q = np.asarray(query, dtype=np.float32)
+        if q.ndim != 1:
+            raise ValueError("submit() takes a single [d] query")
+        dim = getattr(getattr(self.index, "config", None), "dim", None)
+        if dim is not None and len(q) != dim:
+            # reject here: a wrong-width query inside a batch would otherwise
+            # fail np.stack and take the whole batch down with it
+            raise ValueError(f"query has dim {len(q)}, index expects {dim}")
+        fut: Future = Future()
+        with self._wake:
+            if self._closed:
+                raise RuntimeError("endpoint is closed")
+            self._pending.append((q, fut))
+            self._n_requests += 1
+            self._wake.notify()
+        return fut
+
+    def search(self, query: np.ndarray, timeout: float | None = None):
+        """Blocking single-query search through the batching window."""
+        return self.submit(query).result(timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "requests": self._n_requests,
+                "batches": self._n_batches,
+                "mean_batch": (
+                    self._n_batched_requests / self._n_batches if self._n_batches else 0.0
+                ),
+            }
+
+    def close(self) -> None:
+        """Drain pending requests, then stop the worker."""
+        with self._wake:
+            self._closed = True
+            self._wake.notify()
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --------------------------------------------------------------- worker
+    def _take_batch(self) -> list[tuple[np.ndarray, Future]]:
+        """Block until work exists, then hold the window open for stragglers
+        up to max_wait_s (or until max_batch queue up)."""
+        with self._wake:
+            while not self._pending and not self._closed:
+                self._wake.wait()
+            if not self._pending:
+                return []  # closed and drained
+            deadline = time.monotonic() + self.max_wait_s
+            while len(self._pending) < self.max_batch and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._wake.wait(remaining)
+            batch = self._pending[: self.max_batch]
+            del self._pending[: self.max_batch]
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            # everything below is fenced: the worker must survive ANY per-
+            # batch failure (a dead worker would hang every future request)
+            try:
+                queries = np.stack([q for q, _ in batch])
+                ids, dists = self.index.batch_search(queries, self.params)
+            except Exception as e:  # fan the failure out to every waiter
+                for _, fut in batch:
+                    try:
+                        fut.set_exception(e)
+                    except Exception:  # cancelled/raced: nobody is waiting
+                        pass
+                continue
+            with self._lock:
+                self._n_batches += 1
+                self._n_batched_requests += len(batch)
+            for i, (_, fut) in enumerate(batch):
+                try:
+                    fut.set_result((ids[i], dists[i]))
+                except Exception:  # cancelled between check and set: ignore
+                    pass

@@ -854,3 +854,115 @@ class TestWindowFunctions:
     def test_window_requires_order(self, wsession):
         with pytest.raises(SqlError, match="requires ORDER BY"):
             wsession.execute("SELECT rank() OVER (PARTITION BY region) FROM sales")
+
+
+class TestGroupingSets:
+    """ROLLUP / CUBE / GROUPING SETS expansion (the DataFusion planner role);
+    subtotal rows surface missing grouping columns as NULL."""
+
+    @pytest.fixture()
+    def gsession(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(catalog)
+        s.execute(
+            "CREATE TABLE g (id bigint PRIMARY KEY, r string, c string, v bigint)"
+            " WITH (hashBucketNum = '1')"
+        )
+        s.execute(
+            "INSERT INTO g VALUES (1,'a','x',1), (2,'a','y',2), (3,'b','x',4), (4,'b','y',8)"
+        )
+        return s
+
+    def test_rollup(self, gsession):
+        out = gsession.execute(
+            "SELECT r, c, sum(v) AS s FROM g GROUP BY ROLLUP(r, c)"
+        )
+        rows = {(x["r"], x["c"]): x["s"] for x in out.to_pylist()}
+        assert rows == {
+            ("a", "x"): 1, ("a", "y"): 2, ("b", "x"): 4, ("b", "y"): 8,
+            ("a", None): 3, ("b", None): 12, (None, None): 15,
+        }
+
+    def test_cube(self, gsession):
+        out = gsession.execute("SELECT r, c, sum(v) AS s FROM g GROUP BY CUBE(r, c)")
+        rows = {(x["r"], x["c"]): x["s"] for x in out.to_pylist()}
+        # rollup rows plus the (None, c) slices
+        assert rows[(None, "x")] == 5 and rows[(None, "y")] == 10
+        assert rows[(None, None)] == 15 and len(rows) == 9
+
+    def test_grouping_sets_explicit(self, gsession):
+        out = gsession.execute(
+            "SELECT r, c, sum(v) AS s FROM g GROUP BY GROUPING SETS ((r), (c), ())"
+        )
+        rows = {(x["r"], x["c"]): x["s"] for x in out.to_pylist()}
+        assert rows == {
+            ("a", None): 3, ("b", None): 12,
+            (None, "x"): 5, (None, "y"): 10, (None, None): 15,
+        }
+
+    def test_rollup_with_having_and_count(self, gsession):
+        out = gsession.execute(
+            "SELECT r, c, count(*) AS n FROM g GROUP BY ROLLUP(r, c) HAVING n > 1"
+        )
+        rows = {(x["r"], x["c"]): x["n"] for x in out.to_pylist()}
+        assert rows == {("a", None): 2, ("b", None): 2, (None, None): 4}
+
+    def test_plain_group_by_columns_named_rollup(self, gsession):
+        """rollup/cube/grouping stay usable as plain identifiers."""
+        gsession.execute(
+            "CREATE TABLE rb (id bigint PRIMARY KEY, rollup string, v bigint)"
+            " WITH (hashBucketNum = '1')"
+        )
+        gsession.execute("INSERT INTO rb VALUES (1, 'p', 2), (2, 'p', 3), (3, 'q', 5)")
+        out = gsession.execute("SELECT rollup, sum(v) AS s FROM rb GROUP BY rollup")
+        rows = {x["rollup"]: x["s"] for x in out.to_pylist()}
+        assert rows == {"p": 5, "q": 5}
+
+
+class TestTemporalLiterals:
+    @pytest.fixture()
+    def tsession(self, tmp_warehouse):
+        import datetime
+
+        import numpy as np
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table(
+            "ev",
+            pa.schema([("id", pa.int64()), ("ts", pa.timestamp("us")), ("d", pa.date32())]),
+            primary_keys=["id"],
+        )
+        base = datetime.datetime(2026, 7, 1)
+        t.write_arrow(
+            pa.table(
+                {
+                    "id": np.arange(48),
+                    "ts": pa.array([base + datetime.timedelta(hours=i) for i in range(48)]),
+                    "d": pa.array(
+                        [(base + datetime.timedelta(hours=i)).date() for i in range(48)]
+                    ),
+                }
+            )
+        )
+        return SqlSession(catalog)
+
+    def test_timestamp_literal_compare(self, tsession):
+        out = tsession.execute(
+            "SELECT count(*) AS c FROM ev WHERE ts >= TIMESTAMP '2026-07-02 00:00:00'"
+        )
+        assert out.column("c").to_pylist() == [24]
+
+    def test_date_literal_equality(self, tsession):
+        out = tsession.execute("SELECT count(*) AS c FROM ev WHERE d = DATE '2026-07-02'")
+        assert out.column("c").to_pylist() == [24]
+
+    def test_timestamp_between(self, tsession):
+        out = tsession.execute(
+            "SELECT count(*) AS c FROM ev WHERE ts BETWEEN"
+            " TIMESTAMP '2026-07-01 05:00:00' AND TIMESTAMP '2026-07-01 10:00:00'"
+        )
+        assert out.column("c").to_pylist() == [6]
+
+    def test_bad_literal_raises(self, tsession):
+        with pytest.raises(SqlError, match="TIMESTAMP literal"):
+            tsession.execute("SELECT count(*) FROM ev WHERE ts > TIMESTAMP 'not-a-time'")

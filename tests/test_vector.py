@@ -604,3 +604,85 @@ class TestStreamingShardBuild:
             ids, _ = index.search(vecs[i], SearchParams(top_k=1, nprobe=8))
             hits += int(ids[0]) == i
         assert hits >= 45  # self-recall with exact re-rank
+
+
+class TestAsyncAndServing:
+    def _index(self, n=1200, d=32, seed=0):
+        rng = np.random.default_rng(seed)
+        vecs = rng.normal(size=(n, d)).astype(np.float32)
+        cfg = VectorIndexConfig(column="e", dim=d, nlist=8)
+        idx = IvfRabitqIndex.train(vecs, np.arange(n, dtype=np.uint64), cfg)
+        idx.enable_device_cache()
+        return idx, vecs
+
+    def test_search_async_matches_sync(self):
+        idx, vecs = self._index()
+        p = SearchParams(top_k=5, nprobe=8)
+        resolver = idx.search_async(vecs[17], p)
+        a_ids, a_d = resolver()
+        s_ids, s_d = idx.search(vecs[17], p)
+        np.testing.assert_array_equal(a_ids, s_ids)
+        np.testing.assert_allclose(a_d, s_d, rtol=1e-4, atol=1e-4)
+
+    def test_search_async_pipelined_order_independent(self):
+        """Resolvers can be called out of dispatch order (client pipelining)."""
+        idx, vecs = self._index()
+        p = SearchParams(top_k=1, nprobe=8)
+        resolvers = [idx.search_async(vecs[i], p) for i in range(8)]
+        outs = [r() for r in reversed(resolvers)]
+        for i, (ids, _) in zip(reversed(range(8)), outs):
+            assert int(ids[0]) == i  # self-NN
+
+    def test_endpoint_results_match_direct(self):
+        from lakesoul_tpu.vector.serving import AnnEndpoint
+
+        idx, vecs = self._index()
+        p = SearchParams(top_k=5, nprobe=8)
+        with AnnEndpoint(idx, p, max_wait_ms=1.0) as ep:
+            futs = [ep.submit(vecs[i]) for i in range(32)]
+            for i, f in enumerate(futs):
+                ids, dists = f.result(timeout=30)
+                d_ids, d_d = idx.search(vecs[i], p)
+                np.testing.assert_array_equal(ids, d_ids)
+                np.testing.assert_allclose(dists, d_d, rtol=1e-4, atol=1e-4)
+            stats = ep.stats()
+        assert stats["requests"] == 32
+        assert stats["batches"] >= 1
+
+    def test_endpoint_concurrent_clients(self):
+        import threading
+
+        from lakesoul_tpu.vector.serving import AnnEndpoint
+
+        idx, vecs = self._index()
+        p = SearchParams(top_k=1, nprobe=8)
+        errors = []
+
+        def client(lo):
+            try:
+                for i in range(lo, lo + 10):
+                    ids, _ = ep.search(vecs[i], timeout=30)
+                    assert int(ids[0]) == i
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        with AnnEndpoint(idx, p, max_wait_ms=2.0) as ep:
+            threads = [threading.Thread(target=client, args=(lo,)) for lo in range(0, 80, 10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = ep.stats()
+        assert not errors
+        assert stats["requests"] == 80
+        # micro-batching actually batched concurrent clients together
+        assert stats["mean_batch"] > 1.0
+
+    def test_endpoint_close_rejects_new_work(self):
+        from lakesoul_tpu.vector.serving import AnnEndpoint
+
+        idx, vecs = self._index(n=300)
+        ep = AnnEndpoint(idx, SearchParams(top_k=1, nprobe=8))
+        ep.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            ep.submit(vecs[0])
