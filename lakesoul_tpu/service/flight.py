@@ -25,6 +25,7 @@ import json
 import threading
 from dataclasses import dataclass, field
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.flight as flight
 
@@ -334,6 +335,31 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             return [flight.Result(sink.getvalue().to_pybytes())]
         if action.type == "metrics_prometheus":
             return [flight.Result(self.metrics.prometheus_text().encode())]
+        if action.type == "vector_search":
+            # ANN serving over the gateway: any Flight client gets the same
+            # top-k the Python surface gets (reference engines call the
+            # vector index through their own bindings; the gateway is this
+            # framework's multi-engine surface)
+            ns = body.get("namespace", "default")
+            self._check(context, ns, body["table"])
+            query = np.asarray(body["query"], dtype=np.float32)
+            ids, dists = self.catalog.table(body["table"], ns).vector_search(
+                body["column"],
+                query,
+                top_k=int(body.get("top_k", 10)),
+                nprobe=int(body.get("nprobe", 8)),
+                partitions=body.get("partitions"),
+            )
+            return [
+                flight.Result(
+                    json.dumps(
+                        {
+                            "ids": [int(i) for i in ids],
+                            "distances": [float(x) for x in dists],
+                        }
+                    ).encode()
+                )
+            ]
         if action.type == "sql":
             # statement execution, Flight-SQL style: result as Arrow IPC bytes
             from lakesoul_tpu.sql import SqlSession
@@ -368,6 +394,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             ("compact", "compact a table; body: {table, namespace?, partitions?}"),
             ("metrics", "server stream metrics snapshot"),
             ("sql", "execute a SQL statement; body: {statement, namespace?}"),
+            ("vector_search", "ANN top-k; body: {table, column, query, top_k?, nprobe?, partitions?, namespace?}"),
             ("metrics_prometheus", "metrics in Prometheus exposition format"),
             ("data_assets", "per-table asset statistics as Arrow IPC"),
             ("login", "exchange authenticated identity for a bearer token"),

@@ -433,6 +433,13 @@ class SqlSession:
             out = out.slice(0, stmt.limit)
         return out
 
+    def _base_scan(self, stmt: ast.Select):
+        """Scan of the FROM table, positioned at AS OF when time-traveling."""
+        scan = self.catalog.table(stmt.table, self.namespace).scan()
+        if stmt.as_of_ms is not None:
+            scan = scan.snapshot_at(stmt.as_of_ms)
+        return scan
+
     def _select(self, stmt: ast.Select) -> pa.Table:
         # bare `SELECT count(*) FROM t`: metadata-only count, no decode
         # (reference: EmptyScanCountExec shortcut)
@@ -448,8 +455,9 @@ class SqlSession:
             and stmt.from_subquery is None
             and not stmt.distinct
             and not stmt.star
+            and (stmt.limit is None or stmt.limit >= 1)  # LIMIT 0 drops the row
         ):
-            n = self.catalog.table(stmt.table, self.namespace).scan().count_rows()
+            n = self._base_scan(stmt).count_rows()
             label = stmt.items[0].alias or "count(*)"
             return pa.table({label: pa.array([n], type=pa.int64())})
 
@@ -461,6 +469,8 @@ class SqlSession:
         residual_nodes: list = []
         key_renames: dict[str, str] = {}
         if stmt.from_subquery is not None:
+            if stmt.as_of_ms is not None:
+                raise SqlError("AS OF time travel requires a base table")
             table = self._query(stmt.from_subquery)
             if stmt.where is not None:
                 residual_nodes = [stmt.where]
@@ -468,7 +478,7 @@ class SqlSession:
             base_schema = set(
                 self.catalog.table(stmt.table, self.namespace).schema.names
             )
-            scan = self.catalog.table(stmt.table, self.namespace).scan()
+            scan = self._base_scan(stmt)
             push_nodes: list = []
             if stmt.where is not None:
                 push_nodes, residual_nodes = _split_where(stmt.where)

@@ -230,6 +230,9 @@ class Select:
     # ROLLUP/CUBE/GROUPING SETS: the list of grouping sets (each a subset of
     # group_by); None = plain GROUP BY (one set = group_by itself)
     grouping_sets: list | None = None
+    # time travel: epoch ms of `FROM t TIMESTAMP AS OF ...` (Spark style) or
+    # `FOR SYSTEM_TIME AS OF ...` (SQL:2011/Flink); None = latest snapshot
+    as_of_ms: int | None = None
     having: Any = None
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
     limit: int | None = None
@@ -465,6 +468,7 @@ class Parser:
                 sel.from_alias = self.ident()
         else:
             sel.table = self.ident()
+            self._maybe_time_travel(sel)
             # optional table alias (FROM lineitem l) — ignored for resolution,
             # accepted so qualified queries parse
             nxt = self.peek()
@@ -523,6 +527,50 @@ class Parser:
         if self.accept("kw", "limit"):
             sel.limit = int(self.expect("number").value)
         return sel
+
+    def _maybe_time_travel(self, sel: Select) -> None:
+        """``FROM t TIMESTAMP AS OF <ts>`` (Spark) or ``FROM t FOR
+        SYSTEM_TIME AS OF <ts>`` (SQL:2011/Flink) → snapshot read at that
+        instant via the scan's snapshot_at (the reference's Spark time-travel
+        read, SnapshotManagement readEndTime).  <ts> is a TIMESTAMP literal,
+        an ISO string, or an epoch-milliseconds number."""
+
+        def _nth_is(n, kind, value=None):
+            i = self.pos + n
+            return i < len(self.tokens) and self.tokens[i].kind == kind and (
+                value is None or self.tokens[i].value.lower() == value
+            )
+
+        if _nth_is(0, "kw", "for") and _nth_is(1, "ident", "system_time") \
+                and _nth_is(2, "kw", "as") and _nth_is(3, "ident", "of"):
+            self.next()
+            self.next()
+        elif _nth_is(0, "ident", "timestamp") and _nth_is(1, "kw", "as") \
+                and _nth_is(2, "ident", "of"):
+            self.next()
+        else:
+            return
+        self.expect("kw", "as")
+        self.next()  # 'of' (checked above)
+        val = self._arith_factor()
+        if not isinstance(val, Literal):
+            raise SqlError("AS OF requires a literal timestamp")
+        import datetime as _dt
+
+        v = val.value
+        if isinstance(v, str):
+            try:
+                v = _dt.datetime.fromisoformat(v)
+            except ValueError as e:
+                raise SqlError(f"invalid AS OF timestamp {val.value!r}: {e}")
+        if isinstance(v, _dt.date) and not isinstance(v, _dt.datetime):
+            v = _dt.datetime.combine(v, _dt.time())  # DATE '...' = midnight
+        if isinstance(v, _dt.datetime):
+            sel.as_of_ms = int(v.timestamp() * 1000)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            sel.as_of_ms = int(v)
+        else:
+            raise SqlError(f"invalid AS OF timestamp {val.value!r}")
 
     def _group_by_clause(self, sel: Select) -> None:
         """Plain column list, or ROLLUP(...) / CUBE(...) / GROUPING SETS

@@ -219,3 +219,34 @@ class TestLoginHandshake:
                 client.login()
         finally:
             server.shutdown()
+
+
+class TestVectorSearchAction:
+    def test_vector_search_over_flight(self, tmp_warehouse):
+        rng = np.random.default_rng(0)
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("id", pa.int64()), ("emb", pa.list_(pa.float32(), 16))])
+        t = catalog.create_table("docs", schema, primary_keys=["id"])
+        vecs = rng.normal(size=(400, 16)).astype(np.float32)
+        t.write_arrow(
+            pa.table({"id": np.arange(400),
+                      "emb": pa.array(list(vecs), type=pa.list_(pa.float32(), 16))})
+        )
+        t.build_vector_index("emb", nlist=4)
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0", jwt_secret="s3cr3t")
+        try:
+            token = server.jwt_server.create_token(Claims(sub="alice", group="public"))
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}", token=token)
+            out = json.loads(client.action(
+                "vector_search",
+                {"table": "docs", "column": "emb", "query": vecs[7].tolist(),
+                 "top_k": 3, "nprobe": 4},
+            )[0])
+            assert out["ids"][0] == 7  # self-NN through the gateway
+            assert len(out["ids"]) == 3 and len(out["distances"]) == 3
+            assert out["distances"][0] <= out["distances"][1]
+            # results match the local surface
+            ids_local, _ = t.vector_search("emb", vecs[7], top_k=3, nprobe=4)
+            assert [int(i) for i in ids_local] == out["ids"]
+        finally:
+            server.shutdown()

@@ -966,3 +966,50 @@ class TestTemporalLiterals:
     def test_bad_literal_raises(self, tsession):
         with pytest.raises(SqlError, match="TIMESTAMP literal"):
             tsession.execute("SELECT count(*) FROM ev WHERE ts > TIMESTAMP 'not-a-time'")
+
+
+class TestTimeTravelSql:
+    @pytest.fixture()
+    def ttsession(self, tmp_warehouse):
+        import time
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table(
+            "tt", pa.schema([("id", pa.int64()), ("v", pa.int64())]), primary_keys=["id"]
+        )
+        t.write_arrow(pa.table({"id": np.arange(10), "v": np.zeros(10, np.int64)}))
+        time.sleep(0.02)
+        mid = int(time.time() * 1000)
+        time.sleep(0.02)
+        t.write_arrow(pa.table({"id": np.arange(10, 20), "v": np.ones(10, np.int64)}))
+        return SqlSession(catalog), mid
+
+    def test_spark_style_timestamp_as_of(self, ttsession):
+        import datetime
+
+        s, mid = ttsession
+        iso = datetime.datetime.fromtimestamp(mid / 1000).isoformat()
+        out = s.execute(f"SELECT count(*) AS c FROM tt TIMESTAMP AS OF '{iso}'")
+        assert out.column("c").to_pylist() == [10]
+
+    def test_system_time_as_of_epoch_ms(self, ttsession):
+        s, mid = ttsession
+        out = s.execute(f"SELECT sum(v) AS sv FROM tt FOR SYSTEM_TIME AS OF {mid}")
+        assert out.column("sv").to_pylist() == [0]
+        # latest still sees both writes
+        out = s.execute("SELECT sum(v) AS sv FROM tt")
+        assert out.column("sv").to_pylist() == [10]
+
+    def test_as_of_with_where_and_alias(self, ttsession):
+        s, mid = ttsession
+        out = s.execute(
+            f"SELECT count(*) AS c FROM tt FOR SYSTEM_TIME AS OF {mid} x WHERE x.id >= 5"
+        )
+        assert out.column("c").to_pylist() == [5]
+
+    def test_bad_as_of_raises(self, ttsession):
+        s, _ = ttsession
+        with pytest.raises(SqlError, match="AS OF"):
+            s.execute("SELECT * FROM tt TIMESTAMP AS OF 'nope'")
+        with pytest.raises(SqlError, match="AS OF"):
+            s.execute("SELECT * FROM tt FOR SYSTEM_TIME AS OF id")
