@@ -733,6 +733,11 @@ class LakeSoulScan:
     def scan_plan(self) -> list[ScanPlanPartition]:
         if self._vector_search is not None:
             return self._resolve_vector_search().scan_plan()
+        return self._restrict_units(self._plan_units())
+
+    def _plan_units(self) -> list[ScanPlanPartition]:
+        """Scan units after partition selection, before bucket pruning and
+        rank sharding (metadata only)."""
         client = self._table.catalog.client
         info = self._table.info
         if self._incremental is not None:
@@ -740,20 +745,73 @@ class LakeSoulScan:
                 info.table_name, self._incremental[0], self._incremental[1],
                 namespace=info.table_namespace,
             )
-            units = self._filter_partitions(units)
-        elif self._snapshot_ts is not None:
+            return self._filter_partitions(units)
+        if self._snapshot_ts is not None:
             snapshot = client.get_snapshot_at_timestamp(
                 info.table_name, self._snapshot_ts, namespace=info.table_namespace
             )
-            units = client.get_scan_plan_partitions(
+            return client.get_scan_plan_partitions(
                 info.table_name, self._partitions, namespace=info.table_namespace,
                 snapshot=snapshot,
             )
-        else:
-            units = client.get_scan_plan_partitions(
-                info.table_name, self._partitions, namespace=info.table_namespace
-            )
-        return self._restrict_units(units)
+        return client.get_scan_plan_partitions(
+            info.table_name, self._partitions, namespace=info.table_namespace
+        )
+
+    def explain(self) -> dict:
+        """What this scan WILL do, from metadata alone — no data is read and
+        a pending vector search is not executed.  The observability role of
+        the reference's EXPLAIN over its TableProvider (DataFusion shows
+        pushed filters and file groups); here the plan also quantifies
+        partition/bucket pruning and merge work."""
+        from lakesoul_tpu.io.filters import zone_conjuncts
+
+        info = self._table.info
+        out: dict[str, Any] = {
+            "table": info.table_name,
+            "columns": list(self._columns) if self._columns is not None else None,
+            "filter": self._filter._to_dict() if self._filter is not None else None,
+            "zone_predicates": [
+                {"col": c, "op": op, "value": v}
+                for c, op, v in zone_conjuncts(self._filter)
+            ],
+            "partitions": dict(self._partitions) or None,
+            "snapshot_ts": self._snapshot_ts,
+            "incremental": self._incremental,
+            "limit": self._limit,
+            "shard": (
+                {"rank": self._rank, "world": self._world}
+                if self._rank is not None
+                else None
+            ),
+        }
+        if self._vector_search is not None:
+            col, _, top_k, nprobe = self._vector_search
+            out["vector_search"] = {"column": col, "top_k": top_k, "nprobe": nprobe}
+            out["note"] = "vector search resolves at read time to a pk IN filter"
+            return out
+        base = self._plan_units()
+        pruned = self._prune_buckets(base)
+        final = (
+            pruned
+            if self._rank is None
+            else [u for i, u in enumerate(pruned) if i % self._world == self._rank]
+        )
+        files = [f for u in final for f in u.data_files]
+        sizes = [s for u in final for s in (u.file_sizes or [])]
+        by_ext: dict[str, int] = {}
+        for f in files:
+            by_ext[f.rsplit(".", 1)[-1]] = by_ext.get(f.rsplit(".", 1)[-1], 0) + 1
+        out.update(
+            units=len(final),
+            units_before_bucket_prune=len(base),
+            buckets_pruned=len(base) - len(pruned),
+            merge_units=sum(1 for u in final if u.primary_keys),
+            files=len(files),
+            bytes_known=sum(sizes) if sizes else None,
+            file_formats=by_ext,
+        )
+        return out
 
     def _filter_partitions(self, units: list[ScanPlanPartition]) -> list[ScanPlanPartition]:
         if not self._partitions:

@@ -274,30 +274,43 @@ def conjoin(filters: list[Filter]) -> Filter | None:
 
 
 def extract_pk_equalities(flt: Filter | None, primary_keys: list[str]) -> list[tuple[str, Any]]:
-    """If the filter is a pure OR-tree of PK equality comparisons (or a single
-    equality / IN on a PK), return the (col, value) pairs — the reader can
-    then prune whole hash buckets.  Any non-conforming node → [] (no pruning).
-    Mirrors helpers/mod.rs:collect_or_conjunctive_filter_expressions."""
+    """(col, value) pairs a row MUST match one of — the reader prunes hash
+    buckets to the values' hashes.  Conforming shapes: a pure OR-tree of PK
+    equality / IN nodes (helpers/mod.rs:collect_or_conjunctive_filter_
+    expressions), possibly sitting as ONE conjunct of a top-level AND — an
+    AND only narrows, so any conforming conjunct alone justifies the prune
+    (``id = 7 AND ts > x`` point lookups).  Anything else → [] (no pruning)."""
     if flt is None:
         return []
 
-    out: list[tuple[str, Any]] = []
-
-    def walk(f: Filter) -> bool:
+    def collect(f: Filter) -> list[tuple[str, Any]] | None:
+        """The pure OR/eq/in walk; None when the subtree doesn't conform."""
         if f.op == "or":
-            return all(walk(a) for a in f.args)
-        if f.op == "eq":
-            if f.col in primary_keys:
-                out.append((f.col, f.value))
-                return True
-            return False
-        if f.op == "in":
-            if f.col in primary_keys:
-                out.extend((f.col, v) for v in f.value)
-                return True
-            return False
-        return False
+            out: list[tuple[str, Any]] = []
+            for a in f.args:
+                sub = collect(a)
+                if sub is None:
+                    return None
+                out.extend(sub)
+            return out
+        if f.op == "eq" and f.col in primary_keys:
+            return [(f.col, f.value)]
+        if f.op == "in" and f.col in primary_keys:
+            return [(f.col, v) for v in f.value]
+        return None
 
-    if walk(flt):
-        return out
-    return []
+    def conjuncts(f: Filter):
+        if f.op == "and":
+            for a in f.args:
+                yield from conjuncts(a)
+        else:
+            yield f
+
+    best: list[tuple[str, Any]] = []
+    for c in conjuncts(flt):
+        got = collect(c)
+        if got and (not best or len(got) < len(best)):
+            # smallest conforming conjunct = fewest candidate buckets
+            # (id IN (1..1000) AND id = 5 must prune on the equality)
+            best = got
+    return best

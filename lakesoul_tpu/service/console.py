@@ -16,7 +16,7 @@ import sys
 class Console:
     SQL_STARTS = (
         "select", "insert", "create", "drop", "show", "describe", "alter",
-        "call", "update", "delete", "with",
+        "call", "update", "delete", "with", "explain",
     )
 
     def __init__(self, catalog):

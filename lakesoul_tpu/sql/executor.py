@@ -308,6 +308,8 @@ class SqlSession:
 
     def execute(self, sql: str) -> pa.Table:
         stmt = parse(sql)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt.stmt)
         if isinstance(stmt, ast.Select):
             return self._select(stmt)
         if isinstance(stmt, ast.SetOp):
@@ -440,10 +442,141 @@ class SqlSession:
             scan = scan.snapshot_at(stmt.as_of_ms)
         return scan
 
-    def _select(self, stmt: ast.Select) -> pa.Table:
-        # bare `SELECT count(*) FROM t`: metadata-only count, no decode
-        # (reference: EmptyScanCountExec shortcut)
+    def _plan_base(self, stmt: ast.Select, has_aggs: bool):
+        """Base-table scan with every pushdown decision applied — filter
+        split, projection, early-stop LIMIT.  Shared by execution and
+        EXPLAIN so the plan shown IS the plan run.  → (scan, residual)."""
+        base_schema = set(
+            self.catalog.table(stmt.table, self.namespace).schema.names
+        )
+        scan = self._base_scan(stmt)
+        residual_nodes: list = []
+        push_nodes: list = []
+        if stmt.where is not None:
+            push_nodes, residual_nodes = _split_where(stmt.where)
+            if stmt.joins:
+                # only base-table conjuncts may push below the join
+                spill = [
+                    n for n in push_nodes if not _node_columns(n) <= base_schema
+                ]
+                push_nodes = [n for n in push_nodes if _node_columns(n) <= base_schema]
+                residual_nodes = residual_nodes + spill
+        if push_nodes:
+            flt = _where_to_filter(push_nodes[0])
+            for n in push_nodes[1:]:
+                flt = flt & _where_to_filter(n)
+            scan = scan.filter(flt)
+        if not stmt.joins and not stmt.star:
+            needed = self._needed_columns(stmt, residual_nodes)
+            refs = sorted(needed & base_schema)
+            if refs:
+                scan = scan.select(refs)
+            # no refs → full scan keeps the row count for literal selects
         if (
+            stmt.limit is not None
+            and not stmt.joins
+            and not residual_nodes
+            and not stmt.order_by
+            and not has_aggs
+            and not stmt.distinct
+        ):
+            # LIMIT without ORDER BY returns arbitrary rows, so the scan
+            # can stop early (unread units are skipped entirely)
+            scan = scan.limit(stmt.limit)
+        return scan, residual_nodes
+
+    def _explain(self, stmt) -> pa.Table:
+        """EXPLAIN: the plan as text lines, nothing executed.  For base-table
+        selects the scan line comes from the SAME _plan_base/scan.explain
+        decisions execution uses; other statements get a structural sketch."""
+        import json as _json
+
+        lines: list[str] = []
+
+        def describe(s, indent=""):
+            if isinstance(s, ast.SetOp):
+                lines.append(f"{indent}SetOp: {s.op}{' all' if s.all else ''}")
+                describe(s.left, indent + "  ")
+                describe(s.right, indent + "  ")
+                if s.order_by:
+                    lines.append(f"{indent}  order_by={s.order_by} limit={s.limit}")
+                return
+            if not isinstance(s, ast.Select):
+                lines.append(f"{indent}{type(s).__name__}")
+                return
+            if s.from_subquery is not None:
+                lines.append(f"{indent}DerivedTable{f' {s.from_alias}' if s.from_alias else ''}:")
+                describe(s.from_subquery, indent + "  ")
+                if s.where is not None:
+                    # derived tables take no pushdown: the whole WHERE is a
+                    # post-materialization filter (same as _select)
+                    lines.append(f"{indent}Filter (post-materialization): WHERE clause")
+                has_aggs = bool(s.group_by) or s.having is not None or any(
+                    _contains_agg(it.expr) for it in s.items
+                )
+            elif self._count_shortcut_applies(s):
+                lines.append(
+                    f"{indent}MetadataCount: table={s.table} — row count from"
+                    " file metadata, no data files read"
+                )
+                return
+            else:
+                has_aggs = bool(s.group_by) or s.having is not None or any(
+                    _contains_agg(it.expr) for it in s.items
+                )
+                scan, residual = self._plan_base(s, has_aggs)
+                d = scan.explain()
+                lines.append(
+                    f"{indent}Scan: table={d['table']}"
+                    + (f" columns={d['columns']}" if d["columns"] is not None else " columns=*")
+                    + (f" snapshot_ts={d['snapshot_ts']}" if d["snapshot_ts"] else "")
+                )
+                if d["filter"] is not None:
+                    lines.append(f"{indent}  pushdown: {_json.dumps(d['filter'])}")
+                if d.get("zone_predicates"):
+                    lines.append(
+                        f"{indent}  zone-map conjuncts: {len(d['zone_predicates'])}"
+                    )
+                if d["partitions"]:
+                    lines.append(f"{indent}  partition filter: {d['partitions']}")
+                lines.append(
+                    f"{indent}  units={d['units']} (merge-on-read {d['merge_units']},"
+                    f" bucket-pruned {d['buckets_pruned']} of"
+                    f" {d['units_before_bucket_prune']}) files={d['files']}"
+                    + (f" bytes={d['bytes_known']}" if d["bytes_known"] else "")
+                    + (f" formats={d['file_formats']}" if d["file_formats"] else "")
+                )
+                if d["limit"] is not None:
+                    lines.append(f"{indent}  early-stop limit: {d['limit']}")
+                if residual:
+                    lines.append(f"{indent}Residual filter: {len(residual)} predicate(s) post-scan")
+            for j in s.joins:
+                target = j.alias or j.table or "(subquery)"
+                lines.append(f"{indent}Join: {j.kind} {target} ON {j.left_on} = {j.right_on}")
+                if j.subquery is not None:
+                    describe(j.subquery, indent + "  ")
+            if has_aggs:
+                n_sets = len(s.grouping_sets) if s.grouping_sets is not None else 1
+                lines.append(
+                    f"{indent}Aggregate: group_by={s.group_by} sets={n_sets}"
+                    + (" having" if s.having is not None else "")
+                )
+            if s.distinct:
+                lines.append(f"{indent}Distinct")
+            if s.order_by:
+                lines.append(f"{indent}Sort: {s.order_by}")
+            if s.limit is not None:
+                lines.append(f"{indent}Limit: {s.limit}")
+
+        describe(stmt)
+        return pa.table({"plan": lines})
+
+    @staticmethod
+    def _count_shortcut_applies(stmt: ast.Select) -> bool:
+        """Bare ``SELECT count(*) FROM t``: metadata-only count, no decode
+        (reference: EmptyScanCountExec shortcut).  Shared with EXPLAIN so the
+        plan shown is the plan run."""
+        return (
             len(stmt.items) == 1
             and isinstance(stmt.items[0].expr, ast.Agg)
             and stmt.items[0].expr.fn == "count"
@@ -456,7 +589,10 @@ class SqlSession:
             and not stmt.distinct
             and not stmt.star
             and (stmt.limit is None or stmt.limit >= 1)  # LIMIT 0 drops the row
-        ):
+        )
+
+    def _select(self, stmt: ast.Select) -> pa.Table:
+        if self._count_shortcut_applies(stmt):
             n = self._base_scan(stmt).count_rows()
             label = stmt.items[0].alias or "count(*)"
             return pa.table({label: pa.array([n], type=pa.int64())})
@@ -475,42 +611,7 @@ class SqlSession:
             if stmt.where is not None:
                 residual_nodes = [stmt.where]
         else:
-            base_schema = set(
-                self.catalog.table(stmt.table, self.namespace).schema.names
-            )
-            scan = self._base_scan(stmt)
-            push_nodes: list = []
-            if stmt.where is not None:
-                push_nodes, residual_nodes = _split_where(stmt.where)
-                if stmt.joins:
-                    # only base-table conjuncts may push below the join
-                    spill = [
-                        n for n in push_nodes if not _node_columns(n) <= base_schema
-                    ]
-                    push_nodes = [n for n in push_nodes if _node_columns(n) <= base_schema]
-                    residual_nodes = residual_nodes + spill
-            if push_nodes:
-                flt = _where_to_filter(push_nodes[0])
-                for n in push_nodes[1:]:
-                    flt = flt & _where_to_filter(n)
-                scan = scan.filter(flt)
-            if not stmt.joins and not stmt.star:
-                needed = self._needed_columns(stmt, residual_nodes)
-                refs = sorted(needed & base_schema)
-                if refs:
-                    scan = scan.select(refs)
-                # no refs → full scan keeps the row count for literal selects
-            if (
-                stmt.limit is not None
-                and not stmt.joins
-                and not residual_nodes
-                and not stmt.order_by
-                and not has_aggs
-                and not stmt.distinct
-            ):
-                # LIMIT without ORDER BY returns arbitrary rows, so the scan
-                # can stop early (unread units are skipped entirely)
-                scan = scan.limit(stmt.limit)
+            scan, residual_nodes = self._plan_base(stmt, has_aggs)
             table = scan.to_arrow()
 
         # ---- joins (hash joins on Arrow compute; right side may be derived)

@@ -239,6 +239,13 @@ class Select:
 
 
 @dataclass
+class Explain:
+    """EXPLAIN <statement>: plan description, nothing executed."""
+
+    stmt: object
+
+
+@dataclass
 class SetOp:
     """UNION [ALL] / INTERSECT / EXCEPT over two selects (or nested set
     ops).  ORDER BY / LIMIT written after the chain bind to the whole."""
@@ -364,6 +371,9 @@ class Parser:
         tok = self.peek()
         if tok is None:
             raise SqlError("empty statement")
+        if tok.kind == "ident" and tok.value.lower() == "explain":
+            self.next()
+            return Explain(self.parse())
         dispatch = {
             "select": self.parse_query,
             "with": self.parse_with,

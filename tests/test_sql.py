@@ -1013,3 +1013,86 @@ class TestTimeTravelSql:
             s.execute("SELECT * FROM tt TIMESTAMP AS OF 'nope'")
         with pytest.raises(SqlError, match="AS OF"):
             s.execute("SELECT * FROM tt FOR SYSTEM_TIME AS OF id")
+
+
+class TestExplain:
+    @pytest.fixture()
+    def esession(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(catalog)
+        s.execute(
+            "CREATE TABLE ord (id bigint PRIMARY KEY, region string, amt double)"
+            " WITH (hashBucketNum = '4')"
+        )
+        s.execute(
+            "INSERT INTO ord VALUES (1,'e',10.0), (2,'w',20.0), (3,'e',30.0), (4,'w',40.0)"
+        )
+        return s
+
+    def test_explain_runs_nothing_and_shows_plan(self, esession):
+        out = esession.execute(
+            "EXPLAIN SELECT region, sum(amt) AS s FROM ord WHERE amt > 0"
+            " GROUP BY ROLLUP(region) ORDER BY s LIMIT 5"
+        )
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "Scan: table=ord" in plan
+        assert '"op": "gt"' in plan  # pushdown shown
+        assert "Aggregate: group_by=['region'] sets=2" in plan
+        assert "Sort:" in plan and "Limit: 5" in plan
+
+    def test_explain_shows_bucket_pruning(self, esession):
+        out = esession.execute("EXPLAIN SELECT amt FROM ord WHERE id = 3 AND amt > 0")
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "units=1" in plan and "bucket-pruned 2 of 3" in plan  # 4 rows land in 3 buckets
+
+    def test_explain_mirrors_count_shortcut_and_bare_aggregates(self, esession):
+        out = esession.execute("EXPLAIN SELECT count(*) FROM ord")
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "MetadataCount" in plan and "Scan" not in plan
+        out = esession.execute("EXPLAIN SELECT sum(amt) FROM ord")
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "Aggregate" in plan  # bare aggregate still reduces
+
+    def test_explain_early_stop_limit(self, esession):
+        out = esession.execute("EXPLAIN SELECT * FROM ord LIMIT 2")
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "early-stop limit: 2" in plan
+
+    def test_explain_setop_and_derived(self, esession):
+        out = esession.execute(
+            "EXPLAIN SELECT id FROM ord WHERE region = 'e'"
+            " UNION SELECT id FROM ord WHERE region = 'w'"
+        )
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "SetOp: union" in plan and plan.count("Scan: table=ord") == 2
+        out = esession.execute(
+            "EXPLAIN SELECT t.r FROM (SELECT region AS r FROM ord) t WHERE t.r = 'e'"
+        )
+        plan = "\n".join(out.column("plan").to_pylist())
+        assert "DerivedTable" in plan
+
+
+class TestAndConjunctBucketPruning:
+    def test_point_lookup_with_extra_predicates_prunes(self, tmp_warehouse):
+        """id = K AND <anything> prunes to one bucket and stays correct."""
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table(
+            "pt", pa.schema([("id", pa.int64()), ("v", pa.float64())]),
+            primary_keys=["id"], hash_bucket_num=8,
+        )
+        t.write_arrow(pa.table({"id": np.arange(800), "v": np.arange(800, dtype=np.float64)}))
+        from lakesoul_tpu.io.filters import col, extract_pk_equalities
+
+        f = (col("v") > -1.0) & (col("id") == 123)
+        assert extract_pk_equalities(f, ["id"]) == [("id", 123)]
+        scan = t.scan().filter(f)
+        assert scan.explain()["units"] == 1
+        out = scan.to_arrow()
+        assert out.column("id").to_pylist() == [123]
+        # OR across non-PK disables pruning (not provably narrowing)
+        g = (col("id") == 123) | (col("v") > 1.0)
+        assert extract_pk_equalities(g, ["id"]) == []
+        # IN-list inside AND prunes; results complete
+        h = col("id").is_in([5, 600]) & (col("v") >= 0)
+        rows = t.scan().filter(h).to_arrow().column("id").to_pylist()
+        assert sorted(rows) == [5, 600]
