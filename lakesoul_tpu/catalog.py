@@ -802,10 +802,18 @@ class LakeSoulScan:
         by_ext: dict[str, int] = {}
         for f in files:
             by_ext[f.rsplit(".", 1)[-1]] = by_ext.get(f.rsplit(".", 1)[-1], 0) + 1
+        # prune accounting: units are (partition × bucket) entries; on
+        # multi-partition tables len(base)-len(pruned) overstates *bucket*
+        # pruning (ADVICE r2), so report units_pruned plus the distinct
+        # bucket ids that vanished entirely
+        kept_buckets = {u.bucket_id for u in pruned}
         out.update(
             units=len(final),
             units_before_bucket_prune=len(base),
-            buckets_pruned=len(base) - len(pruned),
+            units_pruned=len(base) - len(pruned),
+            buckets_pruned=len(
+                {u.bucket_id for u in base if u.bucket_id not in kept_buckets}
+            ),
             merge_units=sum(1 for u in final if u.primary_keys),
             files=len(files),
             bytes_known=sum(sizes) if sizes else None,

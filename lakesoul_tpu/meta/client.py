@@ -38,7 +38,11 @@ from lakesoul_tpu.meta.entity import (
     schema_to_ipc,
     schema_to_json,
 )
-from lakesoul_tpu.meta.store import MetadataStore, SqliteMetadataStore
+from lakesoul_tpu.meta.store import (
+    DESCS_VERIFIED_KEY,
+    MetadataStore,
+    SqliteMetadataStore,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -142,6 +146,8 @@ class MetaDataClient:
         if store is None:
             store = SqliteMetadataStore(db_path or ":memory:")
         self.store = store
+        # table_id → (desc epoch at verification time, all-canonical)
+        self._canonical_desc_cache: dict[str, tuple[str, bool]] = {}
 
     # ------------------------------------------------------------------ DDL
     def create_namespace(self, name: str, properties: str = "{}", comment: str = "") -> None:
@@ -364,7 +370,18 @@ class MetaDataClient:
         else:
             raise MetadataError(f"unsupported commit op {commit_op}")
 
-        self.store.transaction_insert_partition_info(new_partition_list)
+        range_cols = table_info.range_partition_columns
+        self.store.transaction_insert_partition_info(
+            new_partition_list,
+            # attest canonicality so the store can CAS the verified flag
+            # forward atomically with the epoch bump — a new canonical desc
+            # then costs O(1) at plan time instead of a full desc re-scan
+            descs_canonical=all(
+                self._is_canonical_desc(p.partition_desc, range_cols)
+                for p in new_partition_list
+                if p.version >= 0
+            ),
+        )
 
     def commit_data_files(
         self,
@@ -469,6 +486,76 @@ class MetaDataClient:
                     pass  # cleanup is advisory; never fail a successful replay
 
     # ------------------------------------------------------------ scan plans
+    _CANONICAL_FLAG = DESCS_VERIFIED_KEY
+
+    @staticmethod
+    def _is_canonical_desc(desc: str, range_cols: list[str]) -> bool:
+        """Canonical = exactly the table's range columns, in order.  A desc
+        with a key SUBSET (``a=1`` on an (a, b) table) must count as
+        non-canonical too: it sorts below the ``a=1,`` prefix bound and would
+        be dropped by the prefix range even though the full-scan filter
+        matches it."""
+        if not desc or desc == NO_PARTITION_DESC:
+            return True
+        keys = [kv.split("=", 1)[0] for kv in desc.split(",")]
+        return keys == list(range_cols)
+
+    def _descs_all_canonical(self, table_info: TableInfo) -> bool:
+        """Whether every partition desc in the store is in canonical
+        range-column order — the precondition for the indexed desc-prefix and
+        point-lookup fast paths (ADVICE r2, medium).  Verified by one
+        index-only desc scan; the result is keyed to the store's desc EPOCH
+        both in memory and in ``global_config`` (so other clients skip the
+        scan too).  The epoch is bumped transactionally by every store-API
+        writer that adds a new desc or rewrites one — including external
+        hand-committers going through ``transaction_insert_partition_info``
+        — so any desc-set change after verification forces a re-check, while
+        the steady-state cost per scan plan is a single O(1) epoch lookup."""
+        table_id = table_info.table_id
+        epoch = self.store.get_desc_epoch(table_id)
+        cached = self._canonical_desc_cache.get(table_id)
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        if self.store.get_global_config(self._CANONICAL_FLAG + table_id) == epoch:
+            self._canonical_desc_cache[table_id] = (epoch, True)
+            return True
+        range_cols = list(table_info.range_partition_columns)
+        ok = all(
+            self._is_canonical_desc(d, range_cols)
+            for d in self.store.get_partition_descs(table_id)
+        )
+        self._canonical_desc_cache[table_id] = (epoch, ok)
+        if ok:
+            self.store.set_global_config(self._CANONICAL_FLAG + table_id, epoch)
+        return ok
+
+    def canonicalize_partition_descs(self, table_name: str, namespace: str = "default") -> int:
+        """Migration: rewrite legacy non-canonical descs (``b=2,a=1``) into
+        canonical range-column order across partition_info/data_commit_info
+        so the indexed prefix fast path is sound again.  Returns the number
+        of descs rewritten.  Two kinds of desc are left in place (keeping the
+        full-scan fallback active, so correctness never depends on this
+        migration finishing clean): descs whose keys don't match the table's
+        range columns (caller-owned formats), and descs whose canonical
+        spelling ALREADY exists as a separate partition — that is two version
+        chains for one logical partition, and merging them is ambiguous, so
+        it is logged and skipped rather than guessed at."""
+        table_info = self.get_table_info_by_name(table_name, namespace)
+        range_cols = list(table_info.range_partition_columns)
+        n = 0
+        for desc in self.store.get_partition_descs(table_info.table_id):
+            new_desc = canonical_partition_desc(desc, range_cols)
+            if new_desc == desc:
+                continue
+            try:
+                self.store.rewrite_partition_desc(table_info.table_id, desc, new_desc)
+                n += 1
+            except MetadataError as e:
+                logger.warning("canonicalize %s: skipping %r: %s", table_name, desc, e)
+        self._canonical_desc_cache.pop(table_info.table_id, None)
+        self._descs_all_canonical(table_info)  # re-verify; sets flag if clean
+        return n
+
     def _select_partitions(
         self, table_info: TableInfo, partitions: dict[str, str] | None
     ) -> list[PartitionInfo]:
@@ -479,12 +566,15 @@ class MetaDataClient:
         if set(partitions) == set(range_cols):
             # fully-specified filter: one indexed point lookup, O(1) in the
             # partition count — this is the shape behind the reference 3.0
-            # "~50 ms plan over millions of partitions" claim.  A miss falls
-            # through to the scan below: stores written before descs were
-            # canonicalized on commit may hold the k=v pairs in another order.
+            # "~50 ms plan over millions of partitions" claim.  The hit is
+            # only trusted when the store is verified all-canonical: a legacy
+            # spelling of the SAME logical partition ('b=1,a=1' beside
+            # 'a=1,b=1') could otherwise hold data the point lookup would
+            # silently drop.  A miss (or unverified store) falls through to
+            # the full scan below.
             desc = dict_to_partition_desc(partitions, range_cols)
             p = self.store.get_latest_partition_info(table_info.table_id, desc)
-            if p is not None:
+            if p is not None and self._descs_all_canonical(table_info):
                 return [p]
         wanted = [f"{k}={v}" for k, v in partitions.items()]
         n_lead = 0
@@ -493,6 +583,13 @@ class MetaDataClient:
         if n_lead == len(range_cols):
             # point lookup above missed: only a legacy non-canonical desc can
             # still match, and it won't start with the canonical prefix either
+            n_lead = 0
+        if n_lead and not self._descs_all_canonical(table_info):
+            # the indexed prefix range only matches canonically-ordered descs;
+            # a legacy/hand-committed desc like 'b=2,a=1' would silently
+            # vanish from the scan (ADVICE r2, medium).  Mirror the
+            # point-lookup fallback above: full scan when the store may hold
+            # non-canonical descs.
             n_lead = 0
         if n_lead:
             # leading range columns pinned: push an indexed desc-prefix range

@@ -46,6 +46,14 @@ from lakesoul_tpu.meta.entity import (
 
 COMPACTION_TRIGGER_VERSION_GAP = 10  # matches meta_init.sql trigger (version % gap)
 
+# global_config keys maintained by the store / metadata client per table:
+# DESC_EPOCH_KEY counts desc-set changes (new desc inserted, desc rewritten)
+# and is bumped transactionally by every store-API writer;
+# DESCS_VERIFIED_KEY records the epoch at which a client verified that all
+# descs are canonically ordered (the desc-prefix fast-path precondition).
+DESC_EPOCH_KEY = "desc_epoch:"
+DESCS_VERIFIED_KEY = "descs_verified_canonical:"
+
 
 @dataclass(frozen=True)
 class CompactionEvent:
@@ -131,12 +139,36 @@ CREATE TABLE IF NOT EXISTS discard_compressed_file_info (
 class MetadataStore:
     """Abstract metadata backend. All methods are synchronous and thread-safe."""
 
-    def transaction_insert_partition_info(self, partitions: list[PartitionInfo]) -> None:
+    def transaction_insert_partition_info(
+        self, partitions: list[PartitionInfo], *, descs_canonical: bool = False
+    ) -> None:
         raise NotImplementedError
 
     # ... the concrete store defines the full DAO surface; kept on one class
     # rather than the reference's numbered DaoType dispatch (lib.rs:122) —
     # Python needs no prepared-statement indirection.
+
+
+def desc_prefix_upper_bound(prefix: str) -> str | None:
+    """Exclusive upper bound covering *every* string that starts with
+    ``prefix``: the next prefix by codepoint increment with carry.  The
+    previous ``prefix + '\\uffff'`` bound dropped descs whose next character
+    is a supplementary-plane codepoint (ADVICE r2): those sort above U+FFFF
+    in both Python str (codepoint) and SQLite UTF-8 byte order, which agree.
+    Skips the unencodable surrogate block; returns None when no finite bound
+    exists (prefix is all U+10FFFF — the range is then open above)."""
+    chars = list(prefix)
+    while chars:
+        cp = ord(chars[-1])
+        if cp >= 0x10FFFF:
+            chars.pop()  # carry into the preceding position
+            continue
+        nxt = cp + 1
+        if 0xD800 <= nxt <= 0xDFFF:
+            nxt = 0xE000  # surrogates cannot appear in UTF-8 storage
+        chars[-1] = chr(nxt)
+        return "".join(chars)
+    return None
 
 
 def translate_sql(sql: str, paramstyle: str) -> str:
@@ -156,6 +188,9 @@ class SqlMetadataStore(MetadataStore):
     the driver's integrity-error types; every DAO method below is shared."""
 
     PARAMSTYLE = "qmark"
+    # appended to partition_desc in range predicates; SQLite's default BINARY
+    # collation is already byte order, PG overrides with COLLATE "C"
+    DESC_RANGE_COLLATION = ""
     INTEGRITY_ERRORS: tuple = (sqlite3.IntegrityError,)
 
     def _exec(self, conn, sql: str, params=()):
@@ -307,6 +342,11 @@ class SqlMetadataStore(MetadataStore):
             self._exec(conn, "DELETE FROM partition_info WHERE table_id=?", (table_id,))
             self._exec(conn, "DELETE FROM data_commit_info WHERE table_id=?", (table_id,))
             self._exec(conn, "DELETE FROM table_info WHERE table_id=?", (table_id,))
+            # per-table bookkeeping keys must not outlive the table
+            self._exec(conn,
+                "DELETE FROM global_config WHERE key IN (?, ?)",
+                (DESC_EPOCH_KEY + table_id, DESCS_VERIFIED_KEY + table_id),
+            )
 
     # -- data commit info ----------------------------------------------------
     def insert_data_commit_info(self, commits: list[DataCommitInfo]) -> int:
@@ -417,16 +457,40 @@ class SqlMetadataStore(MetadataStore):
 
     _PI_COLS = "table_id, partition_desc, version, commit_op, timestamp, snapshot, expression, domain"
 
-    def transaction_insert_partition_info(self, partitions: list[PartitionInfo]) -> None:
+    def transaction_insert_partition_info(
+        self, partitions: list[PartitionInfo], *, descs_canonical: bool = False
+    ) -> None:
         """Atomically insert new partition versions.  A PK conflict on
         (table_id, partition_desc, version) raises CommitConflictError —
-        the optimistic-concurrency mechanism of the reference."""
+        the optimistic-concurrency mechanism of the reference.
+
+        ``descs_canonical=True`` is the caller's attestation that every desc
+        in this batch is in canonical range-column order; a currently-valid
+        verified-canonical flag is then moved forward to the new epoch in the
+        same transaction (CAS), so client commits of new canonical
+        partitions keep plan-time verification O(1).  Hand-committers that
+        don't attest leave the flag behind the epoch, forcing the client's
+        full re-verification — the safe direction."""
+        live = [p for p in partitions if p.version >= 0]
+        descs_by_table: dict[str, set[str]] = {}
+        for p in live:  # sentinel Default rows (version<0) are skipped
+            descs_by_table.setdefault(p.table_id, set()).add(p.partition_desc)
         try:
             with self._txn() as conn:
-                for p in partitions:
-                    if p.version < 0:  # skip the sentinel Default row the protocol appends
-                        continue
-                    self._exec(conn, 
+                # one batched existence probe per table (not per partition):
+                # which of this batch's descs are NEW to the desc set
+                new_desc_tables: set[str] = set()
+                for table_id, descs in descs_by_table.items():
+                    dl = sorted(descs)
+                    rows = self._exec(conn,
+                        "SELECT DISTINCT partition_desc FROM partition_info"
+                        f" WHERE table_id=? AND partition_desc IN ({','.join('?' * len(dl))})",
+                        (table_id, *dl),
+                    ).fetchall()
+                    if descs - {r[0] for r in rows}:
+                        new_desc_tables.add(table_id)
+                for p in live:
+                    self._exec(conn,
                         "INSERT INTO partition_info(table_id, partition_desc, version, commit_op,"
                         " timestamp, snapshot, expression, domain) VALUES (?,?,?,?,?,?,?,?)",
                         (
@@ -440,6 +504,26 @@ class SqlMetadataStore(MetadataStore):
                             p.domain,
                         ),
                     )
+                for table_id in new_desc_tables:
+                    # first version of a new desc changes the table's desc
+                    # SET → bump the epoch in the same transaction, so
+                    # clients' canonical-desc verification (keyed to the
+                    # epoch) re-runs instead of trusting a stale result
+                    old_epoch = self.get_global_config(
+                        DESC_EPOCH_KEY + table_id, "0", conn=conn
+                    )
+                    self._bump_desc_epoch(conn, table_id)
+                    if descs_canonical:
+                        # CAS: only a flag valid at the pre-bump epoch moves
+                        # forward; an invalid/absent flag stays invalid
+                        self._exec(conn,
+                            "UPDATE global_config SET value=? WHERE key=? AND value=?",
+                            (
+                                str(int(old_epoch) + 1),
+                                DESCS_VERIFIED_KEY + table_id,
+                                old_epoch,
+                            ),
+                        )
         except self.INTEGRITY_ERRORS as e:
             raise CommitConflictError(
                 f"concurrent commit conflict on {[(p.partition_desc, p.version) for p in partitions]}"
@@ -511,12 +595,79 @@ class SqlMetadataStore(MetadataStore):
             "  AND p2.partition_desc=partition_info.partition_desc)"
         params: tuple = (table_id,)
         if desc_prefix is not None:
-            # half-open range [prefix, prefix+U+FFFF) rides the PK index where
-            # LIKE would not (sqlite case_sensitive_like, PG collations)
-            sql += " AND partition_desc >= ? AND partition_desc < ?"
-            params += (desc_prefix, desc_prefix + "￿")
+            # half-open range [prefix, next-prefix).  The bound math assumes
+            # codepoint/byte ordering, which the default SQLite BINARY
+            # collation gives but a PG cluster under a linguistic collation
+            # (en_US.UTF-8 treats ',' as primary-ignorable) does NOT — so the
+            # comparison names the byte collation explicitly where needed
+            # (DESC_RANGE_COLLATION, '' on SQLite / ' COLLATE "C"' on PG).
+            col = "partition_desc" + self.DESC_RANGE_COLLATION
+            sql += f" AND {col} >= ?"
+            params += (desc_prefix,)
+            upper = desc_prefix_upper_bound(desc_prefix)
+            if upper is not None:
+                sql += f" AND {col} < ?"
+                params += (upper,)
         rows = self._exec(self._conn(), sql, params).fetchall()
         return [self._row_to_partition(r) for r in rows]
+
+    def get_partition_descs(self, table_id: str) -> list[str]:
+        """All distinct partition descs for a table — an index-only scan the
+        client uses to verify descs are canonically ordered before trusting
+        the desc-prefix fast path (ADVICE r2)."""
+        rows = self._exec(self._conn(),
+            "SELECT DISTINCT partition_desc FROM partition_info WHERE table_id=?",
+            (table_id,),
+        ).fetchall()
+        return [r[0] for r in rows]
+
+    def _bump_desc_epoch(self, conn, table_id: str) -> None:
+        key = DESC_EPOCH_KEY + table_id
+        self._exec(conn,
+            "INSERT OR IGNORE INTO global_config(key, value) VALUES (?, '0')", (key,)
+        )
+        self._exec(conn,
+            "UPDATE global_config SET value = CAST(CAST(value AS INTEGER) + 1 AS TEXT)"
+            " WHERE key=?",
+            (key,),
+        )
+
+    def get_desc_epoch(self, table_id: str) -> str:
+        """Monotonic token for the table's desc SET (not its versions): any
+        new desc or desc rewrite through the store API changes it.  O(1) —
+        one global_config point lookup."""
+        return self.get_global_config(DESC_EPOCH_KEY + table_id, "0") or "0"
+
+    def rewrite_partition_desc(self, table_id: str, old_desc: str, new_desc: str) -> None:
+        """Migration support: rename a partition desc across partition_info
+        and data_commit_info in one transaction.  Used to canonicalize legacy
+        descs (``b=2,a=1`` → ``a=1,b=2``) so the indexed prefix fast path is
+        sound again; file paths are stored explicitly in file_ops and are
+        unaffected."""
+        if old_desc == new_desc:
+            return
+        with self._txn() as conn:
+            # refuse to merge two version chains: if the target desc already
+            # has partition_info rows, the UPDATE would collide on the
+            # (table_id, partition_desc, version) PK — and which chain wins
+            # is not ours to guess
+            row = self._exec(conn,
+                "SELECT 1 FROM partition_info WHERE table_id=? AND partition_desc=? LIMIT 1",
+                (table_id, new_desc),
+            ).fetchone()
+            if row is not None:
+                raise MetadataError(
+                    f"target desc {new_desc!r} already exists as its own partition"
+                )
+            self._exec(conn,
+                "UPDATE partition_info SET partition_desc=? WHERE table_id=? AND partition_desc=?",
+                (new_desc, table_id, old_desc),
+            )
+            self._exec(conn,
+                "UPDATE data_commit_info SET partition_desc=? WHERE table_id=? AND partition_desc=?",
+                (new_desc, table_id, old_desc),
+            )
+            self._bump_desc_epoch(conn, table_id)
 
     def get_partition_versions(
         self, table_id: str, partition_desc: str, start_version: int = 0, end_version: int | None = None
@@ -567,8 +718,10 @@ class SqlMetadataStore(MetadataStore):
         return [self._row_to_partition(r) for r in rows]
 
     # -- global config -------------------------------------------------------
-    def get_global_config(self, key: str, default: str | None = None) -> str | None:
-        row = self._exec(self._conn(), "SELECT value FROM global_config WHERE key=?", (key,)).fetchone()
+    def get_global_config(self, key: str, default: str | None = None, *, conn=None) -> str | None:
+        row = self._exec(conn or self._conn(),
+            "SELECT value FROM global_config WHERE key=?", (key,)
+        ).fetchone()
         return row[0] if row else default
 
     def set_global_config(self, key: str, value: str) -> None:
@@ -719,6 +872,9 @@ class PostgresMetadataStore(SqlMetadataStore):
     driver (not bundled in TPU images — import-gated)."""
 
     PARAMSTYLE = "format"
+    # a linguistic cluster collation (en_US.UTF-8) breaks the prefix-range
+    # bound math; "C" is byte order and always present in PG
+    DESC_RANGE_COLLATION = ' COLLATE "C"'
 
     _PG_SCHEMA = re.sub(
         r"timestamp(\s+)INTEGER", r"timestamp\1BIGINT",

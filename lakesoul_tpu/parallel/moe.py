@@ -62,12 +62,16 @@ def moe_ffn(
     expert = jnp.argmax(probs, axis=-1)  # [N]
     gate = jnp.max(probs, axis=-1)  # [N]
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N, E]
+    onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [N, E]
     # rank of each token within its expert (0-based), in token order —
-    # deterministic tie-breaking, like the reference Switch implementation
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [N, E]
-    keep = (pos < C) * onehot  # tokens beyond capacity drop from the MoE path
-    pos_c = jax.nn.one_hot(jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), C,
+    # deterministic tie-breaking, like the reference Switch implementation.
+    # int32 cumsum: a float32 cumsum loses integer exactness past ~2^24
+    # tokens routed to one expert, silently corrupting keep/drop decisions
+    # (ADVICE r2); exact up to 2^31 here, cast to float only for the einsum.
+    pos_i = jnp.cumsum(onehot_i, axis=0) * onehot_i - onehot_i  # [N, E]
+    onehot = onehot_i.astype(jnp.float32)
+    keep = (pos_i < C).astype(jnp.float32) * onehot  # beyond capacity drops
+    pos_c = jax.nn.one_hot(jnp.sum(pos_i * onehot_i, axis=-1), C,
                            dtype=jnp.float32)  # [N, C]
     dispatch = keep[:, :, None] * pos_c[:, None, :]  # [N, E, C] 0/1
 

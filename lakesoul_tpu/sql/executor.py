@@ -541,7 +541,7 @@ class SqlSession:
                     lines.append(f"{indent}  partition filter: {d['partitions']}")
                 lines.append(
                     f"{indent}  units={d['units']} (merge-on-read {d['merge_units']},"
-                    f" bucket-pruned {d['buckets_pruned']} of"
+                    f" unit-pruned {d['units_pruned']} of"
                     f" {d['units_before_bucket_prune']}) files={d['files']}"
                     + (f" bytes={d['bytes_known']}" if d["bytes_known"] else "")
                     + (f" formats={d['file_formats']}" if d["file_formats"] else "")

@@ -576,6 +576,12 @@ class Parser:
         if isinstance(v, _dt.date) and not isinstance(v, _dt.datetime):
             v = _dt.datetime.combine(v, _dt.time())  # DATE '...' = midnight
         if isinstance(v, _dt.datetime):
+            if v.tzinfo is None:
+                # naive literals are UTC: commit timestamps are UTC epoch ms,
+                # and .timestamp() on a naive datetime would bake in the
+                # server host's local zone — same query, host-dependent
+                # snapshot (ADVICE r2).  Explicit offsets still win.
+                v = v.replace(tzinfo=_dt.timezone.utc)
             sel.as_of_ms = int(v.timestamp() * 1000)
         elif isinstance(v, (int, float)) and not isinstance(v, bool):
             sel.as_of_ms = int(v)

@@ -120,6 +120,12 @@ class Connection:
         )
         self._sqlite.execute("PRAGMA journal_mode=WAL")
         self._sqlite.execute("PRAGMA busy_timeout=10000")
+        # PG always has the byte-order "C" collation; the store's desc range
+        # predicates name it explicitly (COLLATE "C") to defeat linguistic
+        # collations, so the fake must know it too
+        self._sqlite.create_collation(
+            "C", lambda a, b: -1 if a < b else (0 if a == b else 1)
+        )
         self.autocommit = False
         self.closed = 0
 

@@ -29,11 +29,11 @@ class TestCommitLogging:
         real_insert = store.transaction_insert_partition_info
         failed = {"n": 0}
 
-        def flaky_insert(parts):
+        def flaky_insert(parts, **kwargs):
             if failed["n"] == 0:
                 failed["n"] = 1
                 raise CommitConflictError("version taken by a concurrent committer")
-            return real_insert(parts)
+            return real_insert(parts, **kwargs)
 
         store.transaction_insert_partition_info = flaky_insert
         try:

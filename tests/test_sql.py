@@ -988,7 +988,11 @@ class TestTimeTravelSql:
         import datetime
 
         s, mid = ttsession
-        iso = datetime.datetime.fromtimestamp(mid / 1000).isoformat()
+        # aware UTC literal: naive AS OF strings are interpreted as UTC (not
+        # host-local), pinned separately in test_advice_r2.py
+        iso = datetime.datetime.fromtimestamp(
+            mid / 1000, tz=datetime.timezone.utc
+        ).isoformat()
         out = s.execute(f"SELECT count(*) AS c FROM tt TIMESTAMP AS OF '{iso}'")
         assert out.column("c").to_pylist() == [10]
 
@@ -1043,7 +1047,7 @@ class TestExplain:
     def test_explain_shows_bucket_pruning(self, esession):
         out = esession.execute("EXPLAIN SELECT amt FROM ord WHERE id = 3 AND amt > 0")
         plan = "\n".join(out.column("plan").to_pylist())
-        assert "units=1" in plan and "bucket-pruned 2 of 3" in plan  # 4 rows land in 3 buckets
+        assert "units=1" in plan and "unit-pruned 2 of 3" in plan  # 4 rows land in 3 buckets
 
     def test_explain_mirrors_count_shortcut_and_bare_aggregates(self, esession):
         out = esession.execute("EXPLAIN SELECT count(*) FROM ord")
