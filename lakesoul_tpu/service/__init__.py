@@ -1,4 +1,18 @@
 from lakesoul_tpu.service.jwt import JwtServer
 from lakesoul_tpu.service.rbac import RbacVerifier
 
-__all__ = ["JwtServer", "RbacVerifier"]
+__all__ = ["JwtServer", "RbacVerifier", "LakeSoulFlightSqlServer", "FlightSqlClient"]
+
+
+def __getattr__(name):
+    # pyarrow.flight imports are deferred: metadata/RBAC users shouldn't pay
+    # for (or require) the Flight stack
+    if name in ("LakeSoulFlightSqlServer", "FlightSqlClient"):
+        from lakesoul_tpu.service import flight_sql
+
+        return getattr(flight_sql, name)
+    if name in ("LakeSoulFlightServer", "LakeSoulFlightClient"):
+        from lakesoul_tpu.service import flight
+
+        return getattr(flight, name)
+    raise AttributeError(name)

@@ -114,10 +114,13 @@ class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
             raise flight.FlightUnauthenticatedError("missing authorization header")
         token = auth[0]
         if token.lower().startswith("basic ") and self.user_registry is not None:
-            # handshake role: user/password authenticates this call; the
-            # `login` action then mints a bearer token for the session
+            # handshake role: user/password authenticates this call; a fresh
+            # bearer rides back in the response headers so standard clients
+            # (`authenticate_basic_token`, ADBC) switch to it — the `login`
+            # action remains for explicit TTL control
             user, group = self._verify_basic(token)
-            return _AuthMiddleware(user, group)
+            bearer = self.jwt_server.create_token(Claims(sub=user, group=group))
+            return _AuthMiddleware(user, group, bearer=bearer)
         if token.lower().startswith("bearer "):
             token = token[7:]
         try:
@@ -128,9 +131,15 @@ class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
 
 
 class _AuthMiddleware(flight.ServerMiddleware):
-    def __init__(self, user: str, group: str):
+    def __init__(self, user: str, group: str, bearer: str | None = None):
         self.user = user
         self.group = group
+        self.bearer = bearer
+
+    def sending_headers(self):
+        if self.bearer is not None:
+            return {"authorization": f"Bearer {self.bearer}"}
+        return {}
 
 
 class LakeSoulFlightServer(flight.FlightServerBase):
