@@ -86,6 +86,166 @@ def _node_columns(node) -> set[str]:
     return set()
 
 
+def _flatten_and(node) -> list:
+    """AND tree → conjunct list (single node when not an AND)."""
+    if isinstance(node, ast.BoolOp) and node.op == "and":
+        out: list = []
+        for a in node.args:
+            out.extend(_flatten_and(a))
+        return out
+    return [node]
+
+
+def _subquery_outer_candidates(node) -> set[str]:
+    """Every column name referenced anywhere inside subqueries of a boolean
+    tree OR value expression (any depth).  Correlated subqueries resolve
+    some of these against the OUTER table, so scan projection must keep any
+    that match the base schema — over-collection only retains a column the
+    planner could have dropped, never changes results."""
+    subs: list = []
+
+    def walk(n):
+        if isinstance(n, (ast.Exists, ast.InSubquery)):
+            subs.append(n.select)
+        elif isinstance(n, ast.Compare) and not n.simple:
+            walk_expr(n.left)
+            walk_expr(n.right)
+        elif isinstance(n, ast.BoolOp):
+            for a in n.args:
+                walk(a)
+        elif isinstance(n, ast.NotOp):
+            walk(n.arg)
+
+    def walk_expr(e):
+        if isinstance(e, ast.ScalarSubquery):
+            subs.append(e.select)
+        elif isinstance(e, ast.Arith):
+            walk_expr(e.left)
+            walk_expr(e.right)
+        elif isinstance(e, ast.Agg):
+            if e.arg is not None:
+                walk_expr(e.arg)
+        elif isinstance(e, ast.Func):
+            for a in e.args:
+                if a is not None:
+                    walk_expr(a)
+        elif isinstance(e, ast.Case):
+            for cond, val in e.whens:
+                walk(cond)
+                walk_expr(val)
+            if e.default is not None:
+                walk_expr(e.default)
+
+    # accept either a boolean node or a bare value expression
+    if isinstance(e := node, (ast.ScalarSubquery, ast.Arith, ast.Agg, ast.Func,
+                              ast.Case, ast.Column)):
+        walk_expr(e)
+    else:
+        walk(node)
+    cols: set[str] = set()
+    while subs:
+        sel = subs.pop()
+        if isinstance(sel, ast.SetOp):
+            subs.extend([sel.left, sel.right])
+            continue
+        if sel.where is not None:
+            cols |= _node_columns(sel.where)
+            walk(sel.where)
+    return cols
+
+
+def _node_column_refs(node) -> list:
+    """(qualifier, name) pairs a boolean tree references on the CURRENT
+    table — like _node_columns but keeping qualifiers for scope resolution;
+    does not descend into nested subqueries."""
+    refs: list = []
+
+    def expr_refs(e):
+        if isinstance(e, ast.Column):
+            refs.append((e.qual, e.name))
+        elif isinstance(e, ast.Arith):
+            expr_refs(e.left)
+            expr_refs(e.right)
+        elif isinstance(e, ast.Agg):
+            if e.arg is not None:
+                expr_refs(e.arg)
+        elif isinstance(e, ast.Func):
+            for a in e.args:
+                if a is not None:
+                    expr_refs(a)
+        elif isinstance(e, ast.Case):
+            for cond, val in e.whens:
+                walk(cond)
+                expr_refs(val)
+            if e.default is not None:
+                expr_refs(e.default)
+
+    def walk(n):
+        if isinstance(n, ast.Compare):
+            if n.simple:
+                refs.append((None, n.col))
+            else:
+                expr_refs(n.left)
+                expr_refs(n.right)
+        elif isinstance(n, (ast.InList, ast.IsNull, ast.Like, ast.Between,
+                            ast.InSubquery)):
+            refs.append((None, n.col))
+        elif isinstance(n, ast.BoolOp):
+            for a in n.args:
+                walk(a)
+        elif isinstance(n, ast.NotOp):
+            walk(n.arg)
+
+    walk(node)
+    return refs
+
+
+def _rewrite_outer_refs(node, resolve, prefix: str = "__o_", inner_renames=None):
+    """Rename column references in a boolean tree for evaluation on the
+    semi-joined frame: outer-resolved refs get the ``__o_`` prefix (the join
+    renamed outer columns to avoid inner-name collisions), and inner refs in
+    ``inner_renames`` map to their coalesced key column (pyarrow joins drop
+    right-key columns; on matched rows the values are equal by the join)."""
+    import copy as _copy
+
+    inner_renames = inner_renames or {}
+
+    def ren_name(qual, name):
+        if resolve(qual, name) == "outer":
+            return prefix + name
+        return inner_renames.get(name, name)
+
+    def ren_expr(e):
+        if isinstance(e, ast.Column):
+            return ast.Column(ren_name(e.qual, e.name))
+        if isinstance(e, ast.Arith):
+            return ast.Arith(e.op, ren_expr(e.left), ren_expr(e.right))
+        return e
+
+    if isinstance(node, ast.Compare):
+        if node.simple:
+            return ast.Compare(node.op, ren_name(None, node.col), node.value)
+        return ast.Compare(
+            node.op, "", None, left=ren_expr(node.left), right=ren_expr(node.right)
+        )
+    if isinstance(node, (ast.InList, ast.IsNull, ast.Like, ast.Between,
+                         ast.InSubquery)):
+        out = _copy.copy(node)
+        out.col = ren_name(None, out.col)
+        return out
+    if isinstance(node, ast.BoolOp):
+        return ast.BoolOp(
+            node.op,
+            [_rewrite_outer_refs(a, resolve, prefix, inner_renames)
+             for a in node.args],
+        )
+    if isinstance(node, ast.NotOp):
+        return ast.NotOp(
+            _rewrite_outer_refs(node.arg, resolve, prefix, inner_renames)
+        )
+    return node
+
+
 def _contains_agg(expr) -> bool:
     return any(True for _ in _walk_aggs(expr))
 
@@ -653,6 +813,7 @@ class SqlSession:
             )
             if key_renames:
                 node = _rename_node_cols(node, key_renames)
+                node = self._rename_correlated_outer_refs(node, key_renames)
             mask = self._eval_bool(node, table)
             table = table.filter(pc.fill_null(_broadcast(mask, len(table)), False))
 
@@ -691,12 +852,15 @@ class SqlSession:
         cols: set[str] = set(stmt.group_by)
         for it in stmt.items:
             cols |= _expr_columns(it.expr)
+            cols |= _subquery_outer_candidates(it.expr)
         for c, _ in stmt.order_by:
             cols.add(c)
         if stmt.having is not None:
             cols |= _node_columns(stmt.having)
+            cols |= _subquery_outer_candidates(stmt.having)
         for n in residual_nodes:
             cols |= _node_columns(n)
+            cols |= _subquery_outer_candidates(n)  # correlation columns
         return cols
 
     def _project(self, stmt: ast.Select, table: pa.Table) -> tuple[pa.Table, list[str]]:
@@ -812,6 +976,418 @@ class SqlSession:
         return out, hidden
 
     # ------------------------------------------------------- expression eval
+    # ---------------------------------------------- correlated subqueries
+    #
+    # Correlated EXISTS / IN / scalar-aggregate subqueries are decorrelated
+    # mechanically (VERDICT r3 item 9) — the classic transforms DataFusion
+    # applies in the reference:
+    #   EXISTS (… WHERE inner.k = outer.k AND p)   → hash semi-join on k
+    #   col IN (SELECT c FROM … WHERE corr)        → EXISTS with c = col
+    #   (SELECT agg(x) FROM … WHERE inner.k = outer.k AND p)
+    #                                              → GROUP BY k + left join
+    # Column references resolve by scope membership (the dialect drops
+    # qualifiers): a name in the subquery's FROM scope is inner; otherwise it
+    # must be an outer column.  A name visible in BOTH scopes resolves inner
+    # (standard innermost-scope-wins), which also means self-correlation
+    # (Q21's l2.l_suppkey <> l1.l_suppkey) needs qualified names the dialect
+    # does not keep — that one shape stays manually rewritten in tpch.py.
+
+    def _projection_names(self, sel) -> set[str]:
+        if isinstance(sel, ast.SetOp):
+            return self._projection_names(sel.left)
+        if sel.star:
+            return self._scope_columns(sel)
+        names: set[str] = set()
+        for it in sel.items:
+            if it.alias:
+                names.add(it.alias)
+            elif isinstance(it.expr, ast.Column):
+                names.add(it.expr.name)
+        return names
+
+    def _scope_columns(self, sel) -> set[str]:
+        """Names visible inside a Select's FROM scope, without executing it."""
+        cols: set[str] = set()
+        if sel.from_subquery is not None:
+            cols |= self._projection_names(sel.from_subquery)
+        elif sel.table:
+            cols |= set(
+                self.catalog.table(sel.table, self.namespace).schema.names
+            )
+        for j in sel.joins:
+            if j.subquery is not None:
+                cols |= self._projection_names(j.subquery)
+            elif j.table:
+                cols |= set(
+                    self.catalog.table(j.table, self.namespace).schema.names
+                )
+        return cols
+
+    @staticmethod
+    def _inner_quals(sel) -> set[str]:
+        quals = {sel.table, sel.from_alias}
+        for j in sel.joins:
+            quals.add(j.table)
+            quals.add(j.alias)
+        quals.discard(None)
+        quals.discard("")
+        return quals
+
+    def _make_scope_resolver(self, sel, outer_cols: set[str]):
+        """→ resolve(qual, name) ∈ {"inner", "outer"}.  Qualifiers win
+        (``orders.orderkey`` is outer even when lineitem also has
+        ``orderkey``); bare names resolve innermost-scope-first."""
+        inner_cols = self._scope_columns(sel)
+        inner_quals = self._inner_quals(sel)
+
+        def resolve(qual, name):
+            if qual == "__outer__":
+                # marker left by _rename_correlated_outer_refs: this ref was
+                # a join-key column the outer join coalesced away, already
+                # rewritten to the surviving left-key name
+                if name not in outer_cols:
+                    raise SqlError(f"unknown outer column {name!r} in subquery")
+                return "outer"
+            if qual:
+                if qual in inner_quals:
+                    if name not in inner_cols:
+                        raise SqlError(f"unknown column {qual}.{name} in subquery")
+                    return "inner"
+                if name not in outer_cols:
+                    raise SqlError(
+                        f"unknown column {qual}.{name} (outer scope has no {name!r})"
+                    )
+                return "outer"
+            if name in inner_cols:
+                return "inner"
+            if name in outer_cols:
+                return "outer"
+            raise SqlError(f"unknown column {name!r} in subquery")
+
+        return resolve
+
+    def _split_correlated(self, sel, outer_cols: set[str]):
+        """Classify a subquery's WHERE conjuncts against (inner, outer)
+        scopes → (inner_only_node, eq_pairs [(outer_col, inner_col)],
+        mixed_conjuncts, outer_only_conjuncts, resolve)."""
+        if sel.where is None:
+            return None, [], [], [], None
+        resolve = self._make_scope_resolver(sel, outer_cols)
+        inner, eq_pairs, mixed, outer_only = [], [], [], []
+        for c in _flatten_and(sel.where):
+            refs = _node_column_refs(c)
+            if not refs:
+                inner.append(c)
+                continue
+            scopes = {resolve(q, n) for q, n in refs}
+            if scopes == {"inner"}:
+                inner.append(c)
+            elif scopes == {"outer"}:
+                outer_only.append(c)
+            else:
+                pair = self._as_eq_pair(c, resolve)
+                if pair is not None:
+                    eq_pairs.append(pair)
+                else:
+                    mixed.append(c)
+        node = (
+            inner[0] if len(inner) == 1
+            else (ast.BoolOp("and", inner) if inner else None)
+        )
+        return node, eq_pairs, mixed, outer_only, resolve
+
+    @staticmethod
+    def _as_eq_pair(c, resolve):
+        if (
+            isinstance(c, ast.Compare) and c.op == "eq" and not c.simple
+            and isinstance(c.left, ast.Column) and isinstance(c.right, ast.Column)
+        ):
+            ls = resolve(c.left.qual, c.left.name)
+            rs = resolve(c.right.qual, c.right.name)
+            if ls == "inner" and rs == "outer":
+                return (c.right.name, c.left.name)
+            if rs == "inner" and ls == "outer":
+                return (c.left.name, c.right.name)
+        return None
+
+    def _rename_correlated_outer_refs(self, node, mapping: dict):
+        """Join-key renames must reach OUTER references inside subqueries:
+        ``JOIN part ON l_partkey = partkey`` drops ``partkey`` from the
+        outer frame, so a correlated ``l2.l_partkey = part.partkey`` must
+        rewrite to the surviving ``l_partkey`` — marked with the reserved
+        ``__outer__`` qualifier so scope resolution still reads it as outer
+        even when the inner scope has a column of the same name."""
+        import copy as _copy
+        from dataclasses import replace as _dc_replace
+
+        def fix_sel(sel):
+            if not isinstance(sel, ast.Select) or sel.where is None:
+                return sel
+            inner_cols = self._scope_columns(sel)
+            inner_quals = self._inner_quals(sel)
+
+            def ren_col(c):
+                if c.qual and c.qual in inner_quals:
+                    return c
+                if not c.qual and c.name in inner_cols:
+                    return c
+                if c.name in mapping:
+                    return ast.Column(mapping[c.name], qual="__outer__")
+                return c
+
+            def ren_expr(e):
+                if isinstance(e, ast.Column):
+                    return ren_col(e)
+                if isinstance(e, ast.Arith):
+                    return ast.Arith(e.op, ren_expr(e.left), ren_expr(e.right))
+                if isinstance(e, ast.ScalarSubquery):
+                    return ast.ScalarSubquery(fix_sel(e.select))
+                return e
+
+            def ren_node(n):
+                if isinstance(n, ast.Compare):
+                    if n.simple:
+                        if n.col not in inner_cols and n.col in mapping:
+                            return ast.Compare(n.op, mapping[n.col], n.value)
+                        return n
+                    return ast.Compare(
+                        n.op, "", None,
+                        left=ren_expr(n.left), right=ren_expr(n.right),
+                    )
+                if isinstance(n, ast.BoolOp):
+                    return ast.BoolOp(n.op, [ren_node(a) for a in n.args])
+                if isinstance(n, ast.NotOp):
+                    return ast.NotOp(ren_node(n.arg))
+                if isinstance(n, (ast.Exists, ast.InSubquery)):
+                    out = _copy.copy(n)
+                    out.select = fix_sel(n.select)
+                    return out
+                return n
+
+            return _dc_replace(sel, where=ren_node(sel.where))
+
+        def walk_expr(e):
+            if isinstance(e, ast.ScalarSubquery):
+                return ast.ScalarSubquery(fix_sel(e.select))
+            if isinstance(e, ast.Arith):
+                return ast.Arith(e.op, walk_expr(e.left), walk_expr(e.right))
+            return e
+
+        def walk(n):
+            if isinstance(n, (ast.Exists, ast.InSubquery)):
+                out = _copy.copy(n)
+                out.select = fix_sel(n.select)
+                return out
+            if isinstance(n, ast.Compare) and not n.simple:
+                return ast.Compare(
+                    n.op, "", None,
+                    left=walk_expr(n.left), right=walk_expr(n.right),
+                )
+            if isinstance(n, ast.BoolOp):
+                return ast.BoolOp(n.op, [walk(a) for a in n.args])
+            if isinstance(n, ast.NotOp):
+                return ast.NotOp(walk(n.arg))
+            return n
+
+        return walk(node)
+
+    def _decorrelated_inner(self, sel, inner_node, needed: set | None = None) -> pa.Table:
+        from dataclasses import replace as _dc_replace
+
+        if sel.group_by or sel.having is not None:
+            raise SqlError(
+                "correlated EXISTS/IN with GROUP BY is not supported"
+            )
+        if needed:
+            # project to the correlation keys + mixed-predicate columns:
+            # EXISTS over a wide fact table must not materialize every column
+            items = [ast.SelectItem(ast.Column(c)) for c in sorted(needed)]
+            inner_sel = _dc_replace(
+                sel, items=items, star=False, where=inner_node,
+                order_by=[], limit=None, distinct=True,
+            )
+        else:
+            inner_sel = _dc_replace(
+                sel, items=[], star=True, where=inner_node, order_by=[],
+                limit=None,
+            )
+        return self._query(inner_sel)
+
+    def _semi_join_mask(self, outer, inner, eq_pairs, mixed, resolve):
+        """Per-outer-row EXISTS mask: hash semi-join on the equality
+        correlation keys, remaining mixed-reference conjuncts evaluated on
+        the joined pairs.  Null keys never match (SQL semantics).  Outer
+        columns are renamed ``__o_<name>`` on the joined frame so inner
+        columns with the SAME name (self-correlation) stay unambiguous."""
+        import numpy as np
+
+        n = len(outer)
+        idx = pa.array(np.arange(n, dtype=np.int64))
+        keys_o = list(dict.fromkeys(p[0] for p in eq_pairs))
+        keys_i = [p[1] for p in eq_pairs]
+        if mixed:
+            need = set(keys_o)
+            for c in mixed:
+                need |= {nm for q, nm in _node_column_refs(c)
+                         if resolve(q, nm) == "outer"}
+            osel = outer.select(sorted(need)).rename_columns(
+                ["__o_" + c for c in sorted(need)]
+            ).append_column("__cidx__", idx)
+            if eq_pairs:
+                joined = osel.join(
+                    inner,
+                    keys=["__o_" + p[0] for p in eq_pairs],
+                    right_keys=keys_i,
+                    join_type="inner",
+                )
+            else:
+                one = pa.array(np.ones(len(osel), np.int8))
+                joined = osel.append_column("__one__", one).join(
+                    inner.append_column(
+                        "__one__", pa.array(np.ones(len(inner), np.int8))
+                    ),
+                    keys="__one__",
+                    join_type="inner",
+                )
+            # inner join-key columns are dropped (coalesced) by the join;
+            # mixed refs to them read the surviving outer-side key instead
+            inner_renames = {i: "__o_" + o for o, i in eq_pairs}
+            rewritten = [
+                _rewrite_outer_refs(c, resolve, inner_renames=inner_renames)
+                for c in mixed
+            ]
+            node = (
+                rewritten[0] if len(rewritten) == 1
+                else ast.BoolOp("and", rewritten)
+            )
+            m = self._eval_bool(node, joined)
+            joined = joined.filter(pc.fill_null(_broadcast(m, len(joined)), False))
+            matched = joined.column("__cidx__")
+        else:
+            distinct = inner.select(keys_i).group_by(keys_i).aggregate([])
+            joined = (
+                outer.select(keys_o)
+                .rename_columns(["__o_" + c for c in keys_o])
+                .append_column("__cidx__", idx)
+                .join(
+                    distinct,
+                    keys=["__o_" + p[0] for p in eq_pairs],
+                    right_keys=keys_i,
+                    join_type="inner",
+                )
+            )
+            matched = joined.column("__cidx__")
+        mask = np.zeros(n, dtype=bool)
+        mi = matched.combine_chunks().to_numpy(zero_copy_only=False)
+        mask[mi] = True
+        return pa.array(mask)
+
+    def _eval_exists(self, node, table):
+        sel = node.select
+        if isinstance(sel, ast.SetOp):
+            exists = len(self._query(sel)) > 0
+            return pa.scalar(exists != node.negated)
+        inner_node, eq_pairs, mixed, outer_only, resolve = self._split_correlated(
+            sel, set(table.column_names)
+        )
+        if not eq_pairs and not mixed and not outer_only:
+            exists = len(self._query(sel)) > 0
+            return pa.scalar(exists != node.negated)
+        needed = {i for _, i in eq_pairs}
+        for c in mixed:
+            needed |= {nm for q, nm in _node_column_refs(c)
+                       if resolve(q, nm) == "inner"}
+        inner = self._decorrelated_inner(sel, inner_node, needed or None)
+        if eq_pairs or mixed:
+            mask = self._semi_join_mask(table, inner, eq_pairs, mixed, resolve)
+        else:
+            mask = pa.array([len(inner) > 0] * len(table))
+        for c in outer_only:
+            mask = pc.and_kleene(
+                pc.fill_null(mask, False),
+                pc.fill_null(_broadcast(self._eval_bool(c, table), len(table)), False),
+            )
+        return pc.invert(mask) if node.negated else mask
+
+    def _eval_in_subquery(self, node, table):
+        sel = node.select
+        if isinstance(sel, ast.Select) and sel.where is not None:
+            inner_node, eq_pairs, mixed, outer_only, resolve = self._split_correlated(
+                sel, set(table.column_names)
+            )
+        else:
+            inner_node, eq_pairs, mixed, outer_only, resolve = (
+                None, [], [], [], None,
+            )
+        if not eq_pairs and not mixed and not outer_only:
+            sub = self._query(sel)
+            if sub.num_columns != 1:
+                raise SqlError("IN (SELECT ...) must produce one column")
+            mask = pc.is_in(
+                table.column(node.col), value_set=sub.column(0).combine_chunks()
+            )
+            return pc.invert(mask) if node.negated else mask
+        # correlated IN: col IN (SELECT c …) ≡ EXISTS(… AND c = col)
+        if isinstance(sel, ast.SetOp) or sel.star or len(sel.items) != 1 \
+                or not isinstance(sel.items[0].expr, ast.Column):
+            raise SqlError(
+                "correlated IN subquery must select a single plain column"
+            )
+        inner_item = sel.items[0].expr.name
+        needed = {i for _, i in eq_pairs} | {inner_item}
+        for c in mixed:
+            needed |= {nm for q, nm in _node_column_refs(c)
+                       if resolve(q, nm) == "inner"}
+        inner = self._decorrelated_inner(sel, inner_node, needed)
+        mask = self._semi_join_mask(
+            table, inner, eq_pairs + [(node.col, inner_item)], mixed, resolve
+        )
+        for c in outer_only:
+            mask = pc.and_kleene(
+                pc.fill_null(mask, False),
+                pc.fill_null(_broadcast(self._eval_bool(c, table), len(table)), False),
+            )
+        return pc.invert(mask) if node.negated else mask
+
+    def _eval_scalar_correlated(self, sel, inner_node, eq_pairs, table):
+        """(SELECT agg(x) FROM … WHERE k = outer.k AND p) → GROUP BY k,
+        left-joined back per outer row; groupless rows yield NULL (0 for a
+        bare count, matching SQL)."""
+        import numpy as np
+        from dataclasses import replace as _dc_replace
+
+        if len(sel.items) != 1 or not _contains_agg(sel.items[0].expr) \
+                or sel.group_by:
+            raise SqlError(
+                "correlated scalar subquery must be a single aggregate"
+            )
+        keys_o = [p[0] for p in eq_pairs]
+        keys_i = [p[1] for p in eq_pairs]
+        dec = _dc_replace(
+            sel,
+            items=[ast.SelectItem(ast.Column(k)) for k in keys_i]
+            + [ast.SelectItem(sel.items[0].expr, "__scalar__")],
+            star=False,
+            where=inner_node,
+            group_by=list(keys_i),
+            order_by=[],
+            limit=None,
+        )
+        grouped = self._select(dec)
+        n = len(table)
+        idx = pa.array(np.arange(n, dtype=np.int64))
+        joined = (
+            table.select(keys_o)
+            .append_column("__cidx__", idx)
+            .join(grouped, keys=keys_o, right_keys=keys_i, join_type="left outer")
+            .sort_by("__cidx__")
+        )
+        vals = joined.column("__scalar__")
+        e = sel.items[0].expr
+        if isinstance(e, ast.Agg) and e.fn == "count":
+            vals = pc.fill_null(vals, 0)
+        return vals
+
     def _eval_expr(self, expr, table: pa.Table):
         """Evaluate a value expression against a table → Arrow array/scalar."""
         if isinstance(expr, ast.Column):
@@ -841,7 +1417,21 @@ class SqlSession:
                 )
             raise SqlError(f"unknown function {expr.name!r}")
         if isinstance(expr, ast.ScalarSubquery):
-            sub = self._query(expr.select)
+            sel = expr.select
+            if isinstance(sel, ast.Select) and sel.where is not None:
+                inner_node, eq_pairs, mixed, outer_only, _rs = self._split_correlated(
+                    sel, set(table.column_names)
+                )
+                if eq_pairs or mixed or outer_only:
+                    if mixed or outer_only:
+                        raise SqlError(
+                            "correlated scalar subquery supports equality"
+                            " correlation predicates only"
+                        )
+                    return self._eval_scalar_correlated(
+                        sel, inner_node, eq_pairs, table
+                    )
+            sub = self._query(sel)
             if sub.num_columns != 1 or len(sub) > 1:
                 raise SqlError("scalar subquery must produce one value")
             return sub.column(0)[0] if len(sub) else pa.scalar(None)
@@ -1032,16 +1622,9 @@ class SqlSession:
         if isinstance(node, ast.InList):
             return pc.is_in(table.column(node.col), value_set=pa.array(node.values))
         if isinstance(node, ast.InSubquery):
-            sub = self._query(node.select)
-            if sub.num_columns != 1:
-                raise SqlError("IN (SELECT ...) must produce one column")
-            mask = pc.is_in(
-                table.column(node.col), value_set=sub.column(0).combine_chunks()
-            )
-            return pc.invert(mask) if node.negated else mask
+            return self._eval_in_subquery(node, table)
         if isinstance(node, ast.Exists):
-            exists = len(self._query(node.select)) > 0
-            return pa.scalar(exists != node.negated)
+            return self._eval_exists(node, table)
         if isinstance(node, ast.Like):
             mask = pc.match_like(table.column(node.col), node.pattern)
             return pc.invert(mask) if node.negated else mask

@@ -76,6 +76,9 @@ def tokenize(sql: str) -> list[Token]:
 @dataclass
 class Column:
     name: str
+    # table/alias qualifier as written (``o.col``); evaluation resolves by
+    # bare name, correlated-subquery classification resolves scope by it
+    qual: str | None = None
 
 
 @dataclass
@@ -780,8 +783,11 @@ class Parser:
                 return Literal(_dt.datetime.fromisoformat(raw))
             except ValueError as e:
                 raise SqlError(f"invalid {kind.upper()} literal {raw!r}: {e}")
-        _, name = self._qualified_ident()
-        return Column(name)
+        qual, name = self._qualified_ident()
+        # the qualifier is kept for scope resolution (correlated subqueries
+        # decide inner-vs-outer by it); plain evaluation ignores it — names
+        # are unique within a working table
+        return Column(name, qual=qual)
 
     def _window_call(self) -> WindowFn:
         name = self.next().value.lower()

@@ -1,0 +1,185 @@
+"""Native correlated subqueries (VERDICT r3 item 9).
+
+The executor decorrelates EXISTS / IN / scalar-aggregate subqueries
+mechanically — hash semi-joins on equality correlation keys, grouped left
+joins for scalar aggregates — with scope resolution by qualifier first and
+bare-name membership second (innermost wins).  TPC-H Q2/Q4/Q17/Q20/Q22 run
+in their real correlated shapes (tests/test_tpch.py verifies them against
+pandas); these tests pin the machinery itself.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.sql import SqlSession
+from lakesoul_tpu.sql.parser import SqlError
+
+
+@pytest.fixture()
+def s(tmp_warehouse):
+    cat = LakeSoulCatalog(str(tmp_warehouse))
+    s = SqlSession(cat)
+    s.execute(
+        "CREATE TABLE orders (okey bigint PRIMARY KEY, cust string, total double)"
+    )
+    s.execute(
+        "CREATE TABLE items (ikey bigint PRIMARY KEY, okey bigint, qty double,"
+        " price double)"
+    )
+    s.execute(
+        "INSERT INTO orders VALUES (1,'a',10.0),(2,'b',20.0),(3,'c',30.0),(4,'d',40.0)"
+    )
+    s.execute(
+        "INSERT INTO items VALUES (10,1,5.0,1.0),(11,1,7.0,2.0),(12,3,2.0,3.0),"
+        "(13,4,9.0,4.0)"
+    )
+    return s
+
+
+def _custs(out):
+    return sorted(out.column("cust").to_pylist())
+
+
+class TestCorrelatedExists:
+    def test_exists_equality(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey AND qty > 4)"
+        )
+        assert _custs(out) == ["a", "d"]
+
+    def test_not_exists(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE NOT EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey)"
+        )
+        assert _custs(out) == ["b"]
+
+    def test_same_name_correlation_via_qualifiers(self, s):
+        # okey exists in BOTH scopes: the qualifier decides
+        out = s.execute(
+            "SELECT cust FROM orders WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = orders.okey AND price >= 3)"
+        )
+        assert _custs(out) == ["c", "d"]
+
+    def test_mixed_nonequality_conjunct(self, s):
+        # qty > total/4 references both scopes and is not an equality —
+        # evaluated on the joined pairs
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey AND qty > o.total / 4.0)"
+        )
+        # a: total 10, qtys 5,7 > 2.5 ✓; c: 2 > 7.5 ✗; d: 9 > 10 ✗
+        assert _custs(out) == ["a"]
+
+    def test_outer_only_conjunct_inside_exists(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey AND o.total < 35)"
+        )
+        assert _custs(out) == ["a", "c"]
+
+
+class TestCorrelatedIn:
+    def test_in_with_correlated_predicate(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE okey IN"
+            " (SELECT items.okey FROM items WHERE qty < o.total / 4.0)"
+        )
+        # a: qty<2.5 → none of (5,7) for okey 1 ✗; c: 2 < 7.5 ✓; d: 9 < 10 ✓
+        assert _custs(out) == ["c", "d"]
+
+    def test_not_in_correlated(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE okey NOT IN"
+            " (SELECT items.okey FROM items WHERE qty < o.total / 4.0)"
+        )
+        assert _custs(out) == ["a", "b"]
+
+
+class TestCorrelatedScalar:
+    def test_sum(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE total >"
+            " (SELECT sum(qty) FROM items WHERE items.okey = o.okey)"
+        )
+        # a: 10 > 12 ✗; b: NULL ✗; c: 30 > 2 ✓; d: 40 > 9 ✓
+        assert _custs(out) == ["c", "d"]
+
+    def test_count_star_fills_zero(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE"
+            " (SELECT count(*) FROM items WHERE items.okey = o.okey) = 0"
+        )
+        assert _custs(out) == ["b"]
+
+    def test_scalar_with_arith_over_agg(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE total <"
+            " (SELECT 2.0 * sum(qty) FROM items WHERE items.okey = o.okey)"
+        )
+        # a: 10 < 24 ✓; b NULL ✗; c: 30 < 4 ✗; d: 40 < 18 ✗
+        assert _custs(out) == ["a"]
+
+    def test_correlation_through_join_key_rename(self, s):
+        """Q17 shape: the correlation column is a join key the outer join
+        coalesced away; the rename must reach inside the subquery."""
+        out = s.execute(
+            "SELECT cust, qty FROM items"
+            " JOIN orders ON items.okey = orders.okey"
+            " WHERE qty > (SELECT 0.5 * sum(i2.qty) FROM items i2"
+            "              WHERE i2.okey = orders.okey)"
+        )
+        # group sums: okey1=12, okey3=2, okey4=9 → keep qty>6: a/7, c/2>1 ✓, d/9>4.5 ✓
+        assert sorted(zip(out.column("cust").to_pylist(),
+                          out.column("qty").to_pylist())) == [
+            ("a", 7.0), ("c", 2.0), ("d", 9.0),
+        ]
+
+
+class TestReviewRegressions:
+    def test_mixed_conjunct_reusing_join_key(self, s):
+        """A non-equality correlated predicate that references the equality
+        key column itself — the join coalesces the inner key away, so the
+        ref must read the surviving outer-side key."""
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey"
+            "  AND items.okey > o.total / 11.0)"
+        )
+        # a: okey 1 > 0.909 ✓; c: 3 > 2.72 ✓; d: 4 > 3.63 ✓; b no rows
+        assert _custs(out) == ["a", "c", "d"]
+
+    def test_correlated_scalar_in_select_list(self, s):
+        out = s.execute(
+            "SELECT cust, (SELECT sum(qty) FROM items WHERE items.okey = o.okey)"
+            " AS total_qty FROM orders o ORDER BY cust"
+        )
+        assert out.column("cust").to_pylist() == ["a", "b", "c", "d"]
+        assert out.column("total_qty").to_pylist() == [12.0, None, 2.0, 9.0]
+
+
+class TestErrors:
+    def test_unknown_column_raises(self, s):
+        with pytest.raises(SqlError, match="unknown column"):
+            s.execute(
+                "SELECT cust FROM orders o WHERE EXISTS"
+                " (SELECT * FROM items WHERE items.okey = o.nope)"
+            )
+
+    def test_correlated_in_requires_plain_column(self, s):
+        with pytest.raises(SqlError, match="single plain column"):
+            s.execute(
+                "SELECT cust FROM orders o WHERE okey IN"
+                " (SELECT items.okey + 1 FROM items WHERE qty < o.total)"
+            )
+
+    def test_correlated_scalar_requires_aggregate(self, s):
+        with pytest.raises(SqlError, match="single aggregate"):
+            s.execute(
+                "SELECT cust FROM orders o WHERE total >"
+                " (SELECT qty FROM items WHERE items.okey = o.okey)"
+            )
