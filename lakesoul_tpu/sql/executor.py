@@ -183,13 +183,13 @@ def _node_column_refs(node) -> list:
     def walk(n):
         if isinstance(n, ast.Compare):
             if n.simple:
-                refs.append((None, n.col))
+                refs.append((n.col_qual, n.col))
             else:
                 expr_refs(n.left)
                 expr_refs(n.right)
         elif isinstance(n, (ast.InList, ast.IsNull, ast.Like, ast.Between,
                             ast.InSubquery)):
-            refs.append((None, n.col))
+            refs.append((n.col_qual, n.col))
         elif isinstance(n, ast.BoolOp):
             for a in n.args:
                 walk(a)
@@ -206,44 +206,14 @@ def _rewrite_outer_refs(node, resolve, prefix: str = "__o_", inner_renames=None)
     renamed outer columns to avoid inner-name collisions), and inner refs in
     ``inner_renames`` map to their coalesced key column (pyarrow joins drop
     right-key columns; on matched rows the values are equal by the join)."""
-    import copy as _copy
-
     inner_renames = inner_renames or {}
 
-    def ren_name(qual, name):
+    def map_col(qual, name):
         if resolve(qual, name) == "outer":
-            return prefix + name
-        return inner_renames.get(name, name)
+            return None, prefix + name
+        return None, inner_renames.get(name, name)
 
-    def ren_expr(e):
-        if isinstance(e, ast.Column):
-            return ast.Column(ren_name(e.qual, e.name))
-        if isinstance(e, ast.Arith):
-            return ast.Arith(e.op, ren_expr(e.left), ren_expr(e.right))
-        return e
-
-    if isinstance(node, ast.Compare):
-        if node.simple:
-            return ast.Compare(node.op, ren_name(None, node.col), node.value)
-        return ast.Compare(
-            node.op, "", None, left=ren_expr(node.left), right=ren_expr(node.right)
-        )
-    if isinstance(node, (ast.InList, ast.IsNull, ast.Like, ast.Between,
-                         ast.InSubquery)):
-        out = _copy.copy(node)
-        out.col = ren_name(None, out.col)
-        return out
-    if isinstance(node, ast.BoolOp):
-        return ast.BoolOp(
-            node.op,
-            [_rewrite_outer_refs(a, resolve, prefix, inner_renames)
-             for a in node.args],
-        )
-    if isinstance(node, ast.NotOp):
-        return ast.NotOp(
-            _rewrite_outer_refs(node.arg, resolve, prefix, inner_renames)
-        )
-    return node
+    return _map_node_cols(node, map_col)
 
 
 def _contains_agg(expr) -> bool:
@@ -351,33 +321,73 @@ def _resolve_aliases_bool(node, alias_map: dict):
     return node
 
 
+def _map_node_cols(node, map_col, map_sel=None):
+    """Generic boolean-tree rewriter — the ONE walker behind join-key
+    renames, semi-join outer-prefix rewrites, and subquery-descending
+    correlation renames.  ``map_col(qual, name) -> (qual, name)`` rewrites
+    every column reference (including inside Func/Case/Agg expressions);
+    ``map_sel(select)`` transforms nested subquery Selects (identity when
+    None — nested scopes resolve their own names)."""
+    import copy as _copy
+
+    sel = map_sel if map_sel is not None else (lambda s: s)
+
+    def ren_expr(e):
+        if isinstance(e, ast.Column):
+            q, n = map_col(e.qual, e.name)
+            return ast.Column(n, qual=q)
+        if isinstance(e, ast.Arith):
+            return ast.Arith(e.op, ren_expr(e.left), ren_expr(e.right))
+        if isinstance(e, ast.Agg):
+            if e.arg is None:
+                return e
+            return ast.Agg(e.fn, ren_expr(e.arg), e.alias, e.distinct)
+        if isinstance(e, ast.Func):
+            return ast.Func(
+                e.name, [None if a is None else ren_expr(a) for a in e.args]
+            )
+        if isinstance(e, ast.Case):
+            return ast.Case(
+                [(walk(c), ren_expr(v)) for c, v in e.whens],
+                None if e.default is None else ren_expr(e.default),
+            )
+        if isinstance(e, ast.ScalarSubquery):
+            return ast.ScalarSubquery(sel(e.select))
+        return e
+
+    def walk(n):
+        if isinstance(n, ast.Compare):
+            if n.simple:
+                q, name = map_col(n.col_qual, n.col)
+                return ast.Compare(n.op, name, n.value, col_qual=q)
+            return ast.Compare(
+                n.op, "", None, left=ren_expr(n.left), right=ren_expr(n.right)
+            )
+        if isinstance(n, (ast.InList, ast.IsNull, ast.Like, ast.Between,
+                          ast.InSubquery)):
+            out = _copy.copy(n)
+            out.col_qual, out.col = map_col(n.col_qual, n.col)
+            if isinstance(out, ast.InSubquery):
+                out.select = sel(out.select)
+            return out
+        if isinstance(n, ast.Exists):
+            out = _copy.copy(n)
+            out.select = sel(out.select)
+            return out
+        if isinstance(n, ast.BoolOp):
+            return ast.BoolOp(n.op, [walk(a) for a in n.args])
+        if isinstance(n, ast.NotOp):
+            return ast.NotOp(walk(n.arg))
+        return n
+
+    return walk(node)
+
+
 def _rename_node_cols(node, mapping: dict):
     """Rewrite column names in a boolean tree (join key renames)."""
-
-    def ren_expr(expr):
-        if isinstance(expr, ast.Column):
-            return ast.Column(mapping.get(expr.name, expr.name))
-        if isinstance(expr, ast.Arith):
-            return ast.Arith(expr.op, ren_expr(expr.left), ren_expr(expr.right))
-        return expr
-
-    if isinstance(node, ast.Compare):
-        if node.simple:
-            return ast.Compare(node.op, mapping.get(node.col, node.col), node.value)
-        return ast.Compare(
-            node.op, "", None, left=ren_expr(node.left), right=ren_expr(node.right)
-        )
-    if isinstance(node, (ast.InList, ast.IsNull, ast.Like, ast.Between, ast.InSubquery)):
-        import copy as _copy
-
-        out = _copy.copy(node)
-        out.col = mapping.get(node.col, node.col)
-        return out
-    if isinstance(node, ast.BoolOp):
-        return ast.BoolOp(node.op, [_rename_node_cols(a, mapping) for a in node.args])
-    if isinstance(node, ast.NotOp):
-        return ast.NotOp(_rename_node_cols(node.arg, mapping))
-    return node
+    return _map_node_cols(
+        node, lambda q, n: (q, mapping.get(n, n))
+    )
 
 
 def _broadcast(val, n: int):
@@ -1117,7 +1127,6 @@ class SqlSession:
         rewrite to the surviving ``l_partkey`` — marked with the reserved
         ``__outer__`` qualifier so scope resolution still reads it as outer
         even when the inner scope has a column of the same name."""
-        import copy as _copy
         from dataclasses import replace as _dc_replace
 
         def fix_sel(sel):
@@ -1126,70 +1135,22 @@ class SqlSession:
             inner_cols = self._scope_columns(sel)
             inner_quals = self._inner_quals(sel)
 
-            def ren_col(c):
-                if c.qual and c.qual in inner_quals:
-                    return c
-                if not c.qual and c.name in inner_cols:
-                    return c
-                if c.name in mapping:
-                    return ast.Column(mapping[c.name], qual="__outer__")
-                return c
+            def map_col(qual, name):
+                if qual and qual in inner_quals:
+                    return qual, name
+                if not qual and name in inner_cols:
+                    return qual, name
+                if name in mapping:
+                    return "__outer__", mapping[name]
+                return qual, name
 
-            def ren_expr(e):
-                if isinstance(e, ast.Column):
-                    return ren_col(e)
-                if isinstance(e, ast.Arith):
-                    return ast.Arith(e.op, ren_expr(e.left), ren_expr(e.right))
-                if isinstance(e, ast.ScalarSubquery):
-                    return ast.ScalarSubquery(fix_sel(e.select))
-                return e
+            return _dc_replace(
+                sel, where=_map_node_cols(sel.where, map_col, map_sel=fix_sel)
+            )
 
-            def ren_node(n):
-                if isinstance(n, ast.Compare):
-                    if n.simple:
-                        if n.col not in inner_cols and n.col in mapping:
-                            return ast.Compare(n.op, mapping[n.col], n.value)
-                        return n
-                    return ast.Compare(
-                        n.op, "", None,
-                        left=ren_expr(n.left), right=ren_expr(n.right),
-                    )
-                if isinstance(n, ast.BoolOp):
-                    return ast.BoolOp(n.op, [ren_node(a) for a in n.args])
-                if isinstance(n, ast.NotOp):
-                    return ast.NotOp(ren_node(n.arg))
-                if isinstance(n, (ast.Exists, ast.InSubquery)):
-                    out = _copy.copy(n)
-                    out.select = fix_sel(n.select)
-                    return out
-                return n
-
-            return _dc_replace(sel, where=ren_node(sel.where))
-
-        def walk_expr(e):
-            if isinstance(e, ast.ScalarSubquery):
-                return ast.ScalarSubquery(fix_sel(e.select))
-            if isinstance(e, ast.Arith):
-                return ast.Arith(e.op, walk_expr(e.left), walk_expr(e.right))
-            return e
-
-        def walk(n):
-            if isinstance(n, (ast.Exists, ast.InSubquery)):
-                out = _copy.copy(n)
-                out.select = fix_sel(n.select)
-                return out
-            if isinstance(n, ast.Compare) and not n.simple:
-                return ast.Compare(
-                    n.op, "", None,
-                    left=walk_expr(n.left), right=walk_expr(n.right),
-                )
-            if isinstance(n, ast.BoolOp):
-                return ast.BoolOp(n.op, [walk(a) for a in n.args])
-            if isinstance(n, ast.NotOp):
-                return ast.NotOp(walk(n.arg))
-            return n
-
-        return walk(node)
+        # top level: only descend into subqueries — top-level refs were
+        # already renamed by _rename_node_cols
+        return _map_node_cols(node, lambda q, n: (q, n), map_sel=fix_sel)
 
     def _decorrelated_inner(self, sel, inner_node, needed: set | None = None) -> pa.Table:
         from dataclasses import replace as _dc_replace
@@ -1383,10 +1344,38 @@ class SqlSession:
             .sort_by("__cidx__")
         )
         vals = joined.column("__scalar__")
-        e = sel.items[0].expr
-        if isinstance(e, ast.Agg) and e.fn == "count":
-            vals = pc.fill_null(vals, 0)
+        fill = self._agg_expr_empty_value(sel.items[0].expr)
+        if fill is not None:
+            # SQL evaluates the aggregate expression over the EMPTY set for
+            # outer rows with no matching group: count(*) → 0, so
+            # count(*)+1 → 1; sum/avg/min/max → NULL keeps the join NULL
+            vals = pc.fill_null(vals, fill)
         return vals
+
+    def _agg_expr_empty_value(self, expr):
+        """Value of an aggregate expression over zero rows, or None when it
+        is NULL (any NULL-yielding aggregate poisons the expression)."""
+
+        def sub(e):
+            if isinstance(e, ast.Agg):
+                return ast.Literal(0) if e.fn == "count" else ast.Literal(None)
+            if isinstance(e, ast.Arith):
+                return ast.Arith(e.op, sub(e.left), sub(e.right))
+            if isinstance(e, ast.Func):
+                return ast.Func(e.name, [None if a is None else sub(a) for a in e.args])
+            return e
+
+        one_row = pa.table({"__d__": pa.array([0])})
+        try:
+            v = self._eval_expr(sub(expr), one_row)
+        except (SqlError, pa.ArrowInvalid, TypeError):
+            return None
+        if isinstance(v, pa.ChunkedArray):
+            v = v.combine_chunks()
+        if isinstance(v, (pa.Array, pa.ChunkedArray)):
+            v = v[0]
+        py = v.as_py() if isinstance(v, pa.Scalar) else v
+        return py if py is not None else None
 
     def _eval_expr(self, expr, table: pa.Table):
         """Evaluate a value expression against a table → Arrow array/scalar."""

@@ -150,6 +150,7 @@ class Compare:
     value: Any  # literal when the RHS is a literal, else None
     left: Any = None  # general expressions (col-col / arith comparisons)
     right: Any = None
+    col_qual: str | None = None  # qualifier of `col` as written (o.total)
 
     @property
     def simple(self) -> bool:
@@ -161,6 +162,7 @@ class Compare:
 class InList:
     col: str
     values: list
+    col_qual: str | None = None
 
 
 @dataclass
@@ -168,6 +170,7 @@ class InSubquery:
     col: str
     select: "Select"
     negated: bool = False
+    col_qual: str | None = None
 
 
 @dataclass
@@ -181,6 +184,7 @@ class Like:
     col: str
     pattern: str
     negated: bool = False
+    col_qual: str | None = None
 
 
 @dataclass
@@ -188,12 +192,14 @@ class Between:
     col: str
     low: Any
     high: Any
+    col_qual: str | None = None
 
 
 @dataclass
 class IsNull:
     col: str
     negated: bool
+    col_qual: str | None = None
 
 
 @dataclass
@@ -910,43 +916,48 @@ class Parser:
     def _predicate(self):
         left = self._arith_expr()
         simple_col = left.name if isinstance(left, Column) else None
+        # the written qualifier rides along: correlated-subquery scope
+        # resolution needs `o.total` to resolve OUTER even when the inner
+        # scope has a same-named column (evaluation still uses bare names)
+        simple_qual = left.qual if isinstance(left, Column) else None
         if simple_col is not None and self.accept("kw", "is"):
             negated = bool(self.accept("kw", "not"))
             self.expect("kw", "null")
-            return IsNull(simple_col, negated)
+            return IsNull(simple_col, negated, col_qual=simple_qual)
         if simple_col is not None and self.accept("kw", "between"):
             low = self._arith_expr()
             self.expect("kw", "and")
             high = self._arith_expr()
             if not (isinstance(low, Literal) and isinstance(high, Literal)):
                 raise SqlError("BETWEEN bounds must be literals")
-            return Between(simple_col, low.value, high.value)
+            return Between(simple_col, low.value, high.value, col_qual=simple_qual)
         if self.peek() and self.peek().kind == "kw" and self.peek().value == "not":
             self.next()
             if self.accept("kw", "like"):
                 if simple_col is None:
                     raise SqlError("LIKE requires a plain column")
-                return Like(simple_col, self._string_value(), negated=True)
+                return Like(simple_col, self._string_value(), negated=True,
+                            col_qual=simple_qual)
             self.expect("kw", "in")
-            node = self._in_tail(simple_col)
+            node = self._in_tail(simple_col, simple_qual)
             if isinstance(node, InSubquery):
                 node.negated = True
                 return node
             return NotOp(node)
         if simple_col is not None and self.accept("kw", "like"):
-            return Like(simple_col, self._string_value())
+            return Like(simple_col, self._string_value(), col_qual=simple_qual)
         if self.accept("kw", "in"):
-            return self._in_tail(simple_col)
+            return self._in_tail(simple_col, simple_qual)
         op_tok = self.next()
         if op_tok.kind != "op" or op_tok.value not in self._OP_MAP:
             raise SqlError(f"expected comparison operator, got {op_tok.value!r}")
         op = self._OP_MAP[op_tok.value]
         right = self._arith_expr()
         if simple_col is not None and isinstance(right, Literal):
-            return Compare(op, simple_col, right.value)  # pushdown-eligible
+            return Compare(op, simple_col, right.value, col_qual=simple_qual)
         return Compare(op, "", None, left=left, right=right)
 
-    def _in_tail(self, simple_col: str | None):
+    def _in_tail(self, simple_col: str | None, simple_qual: str | None = None):
         """After IN: either a literal list or a subquery."""
         self.expect("op", "(")
         nxt = self.peek()
@@ -955,14 +966,14 @@ class Parser:
             self.expect("op", ")")
             if simple_col is None:
                 raise SqlError("IN (SELECT ...) requires a plain column")
-            return InSubquery(simple_col, sub)
+            return InSubquery(simple_col, sub, col_qual=simple_qual)
         vals = [self._value()]
         while self.accept("op", ","):
             vals.append(self._value())
         self.expect("op", ")")
         if simple_col is None:
             raise SqlError("IN list requires a plain column")
-        return InList(simple_col, vals)
+        return InList(simple_col, vals, col_qual=simple_qual)
 
     def _string_value(self) -> str:
         v = self._value()

@@ -61,7 +61,6 @@ QUERIES = {
         " FROM lineitem WHERE shipdate <= '1998-09-02'"
         " GROUP BY returnflag, linestatus ORDER BY returnflag, linestatus"
     ),
-    # Q2 minimum-cost supplier (decorrelated: min cost per part via derived)
     "q02": (
         # native correlated scalar subquery (min cost per part), the real
         # Q2 shape — decorrelated automatically by the executor
@@ -247,7 +246,6 @@ QUERIES = {
         " GROUP BY p_brand, p_type, p_size"
         " ORDER BY supplier_cnt DESC, p_brand, p_type, p_size"
     ),
-    # Q17 small-quantity-order revenue (decorrelated: avg qty per part)
     # Q17 small-quantity-order revenue — native correlated scalar avg (the
     # real Q17 shape; decorrelated to GROUP BY + left join automatically)
     "q17": (
@@ -295,7 +293,9 @@ QUERIES = {
         "                     AND l_suppkey = ps_suppkey))"
         " ORDER BY s_name"
     ),
-    # Q21 suppliers who kept orders waiting (decorrelated to IN / NOT IN)
+    # Q21 suppliers who kept orders waiting — the one REMAINING manual
+    # rewrite: its self-correlated l2.l_suppkey <> l1.l_suppkey needs
+    # qualified self-join scopes the dialect does not track
     "q21": (
         "SELECT s_name, count(*) AS numwait FROM lineitem"
         " JOIN supplier ON l_suppkey = suppkey"

@@ -162,6 +162,74 @@ class TestReviewRegressions:
         assert out.column("total_qty").to_pylist() == [12.0, None, 2.0, 9.0]
 
 
+class TestQualifiedSimplePredicates:
+    @pytest.fixture()
+    def s2(self, tmp_warehouse):
+        """Schema where BOTH tables have a 'total' column — qualifiers must
+        decide the scope of simple predicates too."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE orders (okey bigint PRIMARY KEY, cust string, total double)")
+        s.execute("CREATE TABLE items (ikey bigint PRIMARY KEY, okey bigint, total double)")
+        s.execute("INSERT INTO orders VALUES (1,'a',10.0),(2,'b',20.0)")
+        s.execute("INSERT INTO items VALUES (10,1,999.0),(11,2,999.0)")
+        return s
+
+    def test_qualified_outer_col_vs_literal(self, s2):
+        out = s2.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey AND o.total < 15)"
+        )
+        assert _custs(out) == ["a"]
+
+    def test_qualified_outer_between_and_in_list(self, s2):
+        out = s2.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey"
+            "  AND o.total BETWEEN 15 AND 25)"
+        )
+        assert _custs(out) == ["b"]
+        out = s2.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey AND o.total IN (10.0))"
+        )
+        assert _custs(out) == ["a"]
+
+
+class TestReviewRegressions2:
+    def test_outer_ref_inside_func_in_mixed_conjunct(self, s):
+        # the outer ref is buried inside a Func call (substring): the
+        # semi-join rewrite must descend into Func/Case, not just Arith
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey"
+            "  AND substring(o.cust, 1, 1) = 'a')"
+        )
+        assert _custs(out) == ["a"]
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey"
+            "  AND qty + 0 > o.total / 4.0 + 0)"
+        )
+        assert _custs(out) == ["a"]
+        # inner ref + outer ref inside CASE: a genuinely mixed conjunct
+        # whose outer ref sits under a non-Arith expression node
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE EXISTS"
+            " (SELECT * FROM items WHERE items.okey = o.okey"
+            "  AND qty > CASE WHEN o.total > 15 THEN 8 ELSE 4 END)"
+        )
+        # a (total 10): qtys 5,7 > 4 ✓; c (30): 2 > 8 ✗; d (40): 9 > 8 ✓
+        assert _custs(out) == ["a", "d"]
+
+    def test_count_inside_arith_fills_zero(self, s):
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE"
+            " (SELECT count(*) + 0 FROM items WHERE items.okey = o.okey) = 0"
+        )
+        assert _custs(out) == ["b"]
+
+
 class TestErrors:
     def test_unknown_column_raises(self, s):
         with pytest.raises(SqlError, match="unknown column"):
