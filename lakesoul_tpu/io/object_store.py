@@ -3,8 +3,17 @@
 The reference registers per-table object stores (S3/HDFS/local) behind the
 ``object_store`` crate (rust/lakesoul-io/src/object_store.rs:185).  Here the
 same role is played by fsspec: local paths, ``gs://`` (gcsfs), ``s3://``,
-``memory://`` — whatever fsspec resolves — handed directly to
+``memory://``, ``hdfs://`` — whatever fsspec resolves — handed directly to
 pyarrow, which understands fsspec filesystems natively.
+
+``hdfs://namenode:port/path`` resolves through fsspec's arrow wrapper over
+``pyarrow.fs.HadoopFileSystem`` (the role of the reference's hdrs-backed
+store, rust/lakesoul-io/src/hdfs/mod.rs:37-640); host/port come from the
+URL, while extras ride protocol-scoped storage options — ``hdfs.user``,
+``hdfs.kerb_ticket``, ``hdfs.replication`` — which are stripped of their
+prefix and passed only when the path IS hdfs.  The same scoping works for
+every protocol (``s3.endpoint_url``, ``gs.token``, …), so one option dict
+can serve a multi-store catalog without leaking kwargs across backends.
 
 Remote READS go through the framework's own bounded disk page cache
 (io/page_cache.py, the role of rust/lakesoul-io/src/cache/disk_cache.rs)
@@ -25,11 +34,39 @@ OPTION_CACHE_DISABLED_PROTOCOLS = ("file", "local")
 
 _OWN_OPTIONS = (OPTION_CACHE_DIR, OPTION_CACHE_MAX_BYTES, OPTION_CACHE_PAGE_BYTES)
 
+# protocol scopes recognized in dotted option keys (`hdfs.user`); an option
+# scoped to another protocol is dropped, not forwarded.  Aliased schemes
+# (s3/s3a, gs/gcs, abfs/az) normalize to one canonical scope so either
+# spelling works on either path form.
+_PROTOCOL_ALIASES = {
+    "file": "file", "local": "file", "memory": "memory",
+    "s3": "s3", "s3a": "s3", "gs": "gs", "gcs": "gs",
+    "hdfs": "hdfs", "webhdfs": "webhdfs",
+    "abfs": "abfs", "az": "abfs", "http": "http", "https": "http",
+}
+_PROTOCOL_SCOPES = tuple(_PROTOCOL_ALIASES)
+
 
 def _split_options(storage_options: dict | None) -> tuple[dict, dict]:
     opts = dict(storage_options or {})
     own = {k: opts.pop(k) for k in _OWN_OPTIONS if k in opts}
     return own, opts
+
+
+def _scope_options(opts: dict, protocol: str) -> dict:
+    """Apply protocol-scoped keys: ``<protocol>.<kwarg>`` is unwrapped for
+    the matching protocol, scopes for other protocols are dropped, and
+    unscoped keys pass through untouched."""
+    out = {}
+    canon = _PROTOCOL_ALIASES.get(protocol, protocol)
+    for k, v in opts.items():
+        pfx, dot, rest = k.partition(".")
+        if dot and pfx in _PROTOCOL_SCOPES:
+            if _PROTOCOL_ALIASES[pfx] == canon:
+                out[rest] = v
+            continue
+        out[k] = v
+    return out
 
 
 def filesystem_for(path: str, storage_options: dict | None = None, *, write: bool = False):
@@ -43,7 +80,7 @@ def filesystem_for(path: str, storage_options: dict | None = None, *, write: boo
     own, opts = _split_options(storage_options)
     cache_dir = own.get(OPTION_CACHE_DIR)
     protocol = fsspec.core.split_protocol(path)[0] or "file"
-    fs, p = fsspec.core.url_to_fs(path, **opts)
+    fs, p = fsspec.core.url_to_fs(path, **_scope_options(opts, protocol))
     if cache_dir and not write and protocol not in OPTION_CACHE_DISABLED_PROTOCOLS:
         from lakesoul_tpu.io.page_cache import CachedReadFileSystem, get_cache
 

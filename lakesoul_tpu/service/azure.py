@@ -1,0 +1,206 @@
+"""Azure Blob Storage upstream for the RBAC storage proxy.
+
+Role parity with rust/lakesoul-s3-proxy/src/azure.rs: the proxy terminates
+client auth (JWT + RBAC) and forwards object operations to Azure Blob
+Storage signed with the account's **Shared Key** (hmac-sha256 over Azure's
+canonicalized string-to-sign; azure.rs `sign` / `add_required_headers`).
+
+Scope note (recorded in PARITY.md): the reference's azure.rs is an
+S3-API→Azure *translator* — it additionally rewrites S3 ListObjectsV2,
+multipart-upload, and batch-delete requests into Blob/Block equivalents
+because its clients speak the S3 protocol.  This proxy's client surface is
+GET/HEAD/PUT objects (storage_proxy.py), so those S3-dialect rewrites have
+nothing to translate; what remains — required x-ms headers, shared-key
+canonicalization/signing, Range pass-through, DNS-discovered health-checked
+backends — is implemented here with the same request interface as
+``S3Upstream`` (duck-typed; ``StorageProxy`` is upstream-agnostic).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+import logging
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from urllib.parse import quote
+
+from lakesoul_tpu.service.s3_upstream import DnsDiscovery, connect_backend
+
+logger = logging.getLogger(__name__)
+
+API_VERSION = "2021-08-06"
+
+# headers that take part in the fixed section of the string-to-sign, in
+# Azure's mandated order
+_SIGNED_STD_HEADERS = (
+    "content-encoding",
+    "content-language",
+    "content-length",
+    "content-md5",
+    "content-type",
+    "date",
+    "if-modified-since",
+    "if-match",
+    "if-none-match",
+    "if-unmodified-since",
+    "range",
+)
+
+
+def rfc1123_now() -> str:
+    return datetime.now(timezone.utc).strftime("%a, %d %b %Y %H:%M:%S GMT")
+
+
+def string_to_sign(
+    method: str,
+    account: str,
+    path: str,
+    query: dict[str, str],
+    headers: dict[str, str],
+) -> str:
+    """Azure Shared Key canonicalization (the 2015-02-21+ rules: a zero
+    Content-Length signs as the empty string; Date is empty when x-ms-date
+    is supplied; x-ms-* headers sorted lowercase; the canonicalized
+    resource is /account/path plus sorted ``name:value`` query lines)."""
+    low = {k.lower(): v.strip() for k, v in headers.items()}
+    if "x-ms-date" in low:
+        low["date"] = ""
+    if low.get("content-length") in ("0", ""):
+        low["content-length"] = ""
+    fixed = [method.upper()]
+    fixed += [low.get(h, "") for h in _SIGNED_STD_HEADERS]
+    canon_headers = "".join(
+        f"{k}:{low[k]}\n" for k in sorted(k for k in low if k.startswith("x-ms-"))
+    )
+    canon_resource = f"/{account}{path}"
+    for k in sorted(query):
+        canon_resource += f"\n{k.lower()}:{query[k]}"
+    return "\n".join(fixed) + "\n" + canon_headers + canon_resource
+
+
+def sign_shared_key(
+    method: str,
+    account: str,
+    key_b64: str,
+    path: str,
+    query: dict[str, str],
+    headers: dict[str, str],
+) -> str:
+    """→ value for the Authorization header."""
+    sts = string_to_sign(method, account, path, query, headers)
+    mac = hmac.new(
+        base64.b64decode(key_b64), sts.encode("utf-8"), hashlib.sha256
+    ).digest()
+    return f"SharedKey {account}:{base64.b64encode(mac).decode()}"
+
+
+def encode_blob_path(path: str) -> str:
+    return quote(path, safe="/-_.~!$&'()*+,;=:@")
+
+
+@dataclass
+class AzureUpstreamConfig:
+    account: str
+    key_b64: str  # the base64 account key, as the portal hands it out
+    container: str
+    endpoint: str | None = None  # default https://{account}.blob.core.windows.net
+    port: int | None = None
+    connect_timeout_s: float = 3.0
+    refresh_interval_s: float = 30.0
+    retry_down_s: float = 10.0
+
+
+class AzureUpstream:
+    """Forward object operations to Azure Blob, Shared-Key-signed
+    (``/<container>/<blob>``); same duck-typed interface as S3Upstream."""
+
+    def __init__(self, config: AzureUpstreamConfig, *, resolver=None, health_check=None):
+        self.config = config
+        endpoint = config.endpoint or f"https://{config.account}.blob.core.windows.net"
+        scheme, _, rest = endpoint.partition("://")
+        if rest == "":
+            scheme, rest = "https", scheme
+        host, _, port_s = rest.partition(":")
+        self.scheme = scheme
+        self.host_header = rest
+        self.host = host
+        self.port = config.port or (
+            int(port_s) if port_s else (443 if scheme == "https" else 80)
+        )
+        self.discovery = DnsDiscovery(
+            host,
+            self.port,
+            resolver=resolver,
+            health_check=health_check,
+            refresh_interval_s=config.refresh_interval_s,
+            retry_down_s=config.retry_down_s,
+            connect_timeout_s=config.connect_timeout_s,
+        )
+
+    def _connect(self, ip: str) -> http.client.HTTPConnection:
+        return connect_backend(
+            self.scheme, ip, self.port, self.host, self.config.connect_timeout_s
+        )
+
+    def request(
+        self,
+        method: str,
+        key: str,
+        *,
+        body: bytes | None = None,
+        body_iter=None,
+        content_length: int | None = None,
+        range_header: str | None = None,
+        retries: int = 1,
+    ):
+        """One signed request → (status, headers dict, response object);
+        contract identical to S3Upstream.request (streaming responses,
+        non-replayable streamed uploads don't retry)."""
+        cfg = self.config
+        path = encode_blob_path(f"/{cfg.container}/{key.lstrip('/')}")
+        if body_iter is not None and content_length is None:
+            raise ValueError("body_iter requires content_length")
+        length = (
+            content_length if body_iter is not None
+            else (len(body) if body is not None else 0)
+        )
+        headers: dict[str, str] = {
+            "Host": self.host_header,
+            "x-ms-date": rfc1123_now(),
+            "x-ms-version": API_VERSION,
+            "Content-Length": str(length),
+        }
+        if method == "PUT":
+            # whole-object upload; the reference's multipart→block-list
+            # translation has no client on this proxy's surface
+            headers["x-ms-blob-type"] = "BlockBlob"
+        if range_header:
+            headers["Range"] = range_header
+        headers["Authorization"] = sign_shared_key(
+            method, cfg.account, cfg.key_b64, path, {}, headers
+        )
+        if body_iter is not None:
+            retries = 0  # a consumed stream cannot be replayed
+        last_err: Exception | None = None
+        for _ in range(retries + 1):
+            ip = self.discovery.pick()
+            conn = self._connect(ip)
+            try:
+                conn.request(
+                    method,
+                    path,
+                    body=body_iter if body_iter is not None else body,
+                    headers=headers,
+                )
+                resp = conn.getresponse()
+                resp._proxy_conn = conn  # keep alive while streaming
+                return resp.status, dict(resp.getheaders()), resp
+            except OSError as e:
+                conn.close()
+                self.discovery.report_failure(ip)
+                last_err = e
+                logger.warning("azure upstream %s %s via %s failed: %s", method, key, ip, e)
+        raise OSError(f"all azure backends failed for {method} {key}: {last_err}")

@@ -27,6 +27,37 @@ from lakesoul_tpu.service import sigv4
 logger = logging.getLogger("lakesoul_tpu.service.s3_upstream")
 
 
+class VerifiedHTTPSConnection(http.client.HTTPSConnection):
+    """HTTPS to a DNS-discovered IP with certificate verification against
+    the REAL hostname: dialing the resolved IP directly would otherwise
+    handshake with server_hostname=<ip literal> (no SNI), and real
+    endpoints' certs carry DNS SANs only — every request would die with
+    CERTIFICATE_VERIFY_FAILED."""
+
+    def __init__(self, ip: str, port: int, *, server_hostname: str, timeout: float):
+        import ssl
+
+        super().__init__(ip, port, timeout=timeout)
+        self._server_hostname = server_hostname
+        self._verify_ctx = ssl.create_default_context()
+
+    def connect(self):
+        http.client.HTTPConnection.connect(self)
+        self.sock = self._verify_ctx.wrap_socket(
+            self.sock, server_hostname=self._server_hostname
+        )
+
+
+def connect_backend(scheme: str, ip: str, port: int, host: str, timeout: float):
+    """Connection to one discovered backend IP; https verifies against the
+    logical host name."""
+    if scheme == "https":
+        return VerifiedHTTPSConnection(
+            ip, port, server_hostname=host, timeout=timeout
+        )
+    return http.client.HTTPConnection(ip, port, timeout=timeout)
+
+
 @dataclass
 class S3UpstreamConfig:
     """Where and how to forward object operations."""
@@ -195,11 +226,9 @@ class S3Upstream:
         )
 
     def _connect(self, ip: str) -> http.client.HTTPConnection:
-        cls = (
-            http.client.HTTPSConnection if self.scheme == "https"
-            else http.client.HTTPConnection
+        return connect_backend(
+            self.scheme, ip, self.port, self.host, self.config.connect_timeout_s
         )
-        return cls(ip, self.port, timeout=self.config.connect_timeout_s)
 
     def request(
         self,

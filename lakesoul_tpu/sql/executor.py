@@ -1368,7 +1368,9 @@ class SqlSession:
         one_row = pa.table({"__d__": pa.array([0])})
         try:
             v = self._eval_expr(sub(expr), one_row)
-        except (SqlError, pa.ArrowInvalid, TypeError):
+        except (SqlError, pa.ArrowInvalid, TypeError, KeyError):
+            # KeyError: the expression also references a (correlation) column
+            # — no constant empty-set value exists, keep the NULL
             return None
         if isinstance(v, pa.ChunkedArray):
             v = v.combine_chunks()

@@ -229,6 +229,16 @@ class TestReviewRegressions2:
         )
         assert _custs(out) == ["b"]
 
+    def test_agg_expr_referencing_group_key_keeps_null(self, s):
+        """The empty-set fill probe must not crash when the aggregate
+        expression also references a column (no constant empty value
+        exists); missing groups stay NULL."""
+        out = s.execute(
+            "SELECT cust FROM orders o WHERE"
+            " (SELECT okey + count(*) FROM items WHERE items.okey = o.okey) > 0"
+        )
+        assert _custs(out) == ["a", "c", "d"]  # b has no group → NULL → false
+
 
 class TestErrors:
     def test_unknown_column_raises(self, s):
