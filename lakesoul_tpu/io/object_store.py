@@ -12,8 +12,9 @@ store, rust/lakesoul-io/src/hdfs/mod.rs:37-640); host/port come from the
 URL, while extras ride protocol-scoped storage options — ``hdfs.user``,
 ``hdfs.kerb_ticket``, ``hdfs.replication`` — which are stripped of their
 prefix and passed only when the path IS hdfs.  The same scoping works for
-every protocol (``s3.endpoint_url``, ``gs.token``, …), so one option dict
-can serve a multi-store catalog without leaking kwargs across backends.
+every protocol fsspec knows (``s3.endpoint_url``, ``gs.token``,
+``sftp.username``, …), so one option dict can serve a multi-store catalog
+without leaking kwargs across backends.
 
 Remote READS go through the framework's own bounded disk page cache
 (io/page_cache.py, the role of rust/lakesoul-io/src/cache/disk_cache.rs)
@@ -34,17 +35,20 @@ OPTION_CACHE_DISABLED_PROTOCOLS = ("file", "local")
 
 _OWN_OPTIONS = (OPTION_CACHE_DIR, OPTION_CACHE_MAX_BYTES, OPTION_CACHE_PAGE_BYTES)
 
-# protocol scopes recognized in dotted option keys (`hdfs.user`); an option
-# scoped to another protocol is dropped, not forwarded.  Aliased schemes
-# (s3/s3a, gs/gcs, abfs/az) normalize to one canonical scope so either
-# spelling works on either path form.
+# aliased schemes normalize to one canonical scope so either spelling works
+# on either path form (`gs.token` on a gcs:// path and vice versa)
 _PROTOCOL_ALIASES = {
-    "file": "file", "local": "file", "memory": "memory",
-    "s3": "s3", "s3a": "s3", "gs": "gs", "gcs": "gs",
-    "hdfs": "hdfs", "webhdfs": "webhdfs",
-    "abfs": "abfs", "az": "abfs", "http": "http", "https": "http",
+    "local": "file", "s3a": "s3", "gcs": "gs", "az": "abfs", "https": "http",
 }
-_PROTOCOL_SCOPES = tuple(_PROTOCOL_ALIASES)
+
+
+def _known_protocols() -> set[str]:
+    """Every scheme fsspec knows about (plus our aliases): a dotted option
+    key starting with any of these is a protocol scope, anything else is an
+    ordinary kwarg that happens to contain a dot."""
+    from fsspec.registry import known_implementations
+
+    return set(known_implementations) | set(_PROTOCOL_ALIASES) | {"file"}
 
 
 def _split_options(storage_options: dict | None) -> tuple[dict, dict]:
@@ -54,15 +58,17 @@ def _split_options(storage_options: dict | None) -> tuple[dict, dict]:
 
 
 def _scope_options(opts: dict, protocol: str) -> dict:
-    """Apply protocol-scoped keys: ``<protocol>.<kwarg>`` is unwrapped for
-    the matching protocol, scopes for other protocols are dropped, and
-    unscoped keys pass through untouched."""
+    """Apply protocol-scoped keys for ANY fsspec-known protocol:
+    ``<protocol>.<kwarg>`` is unwrapped when the prefix names the current
+    protocol (directly or via an alias), dropped when it names a different
+    one, and unscoped keys pass through untouched."""
     out = {}
+    known = _known_protocols()
     canon = _PROTOCOL_ALIASES.get(protocol, protocol)
     for k, v in opts.items():
         pfx, dot, rest = k.partition(".")
-        if dot and pfx in _PROTOCOL_SCOPES:
-            if _PROTOCOL_ALIASES[pfx] == canon:
+        if dot and pfx in known:
+            if pfx == protocol or _PROTOCOL_ALIASES.get(pfx, pfx) == canon:
                 out[rest] = v
             continue
         out[k] = v

@@ -51,7 +51,11 @@ _SIGNED_STD_HEADERS = (
 
 
 def rfc1123_now() -> str:
-    return datetime.now(timezone.utc).strftime("%a, %d %b %Y %H:%M:%S GMT")
+    # locale-independent HTTP-date: strftime('%a/%b') would localize day and
+    # month names under a non-English LC_TIME, and Azure rejects those
+    from email.utils import formatdate
+
+    return formatdate(usegmt=True)
 
 
 def string_to_sign(

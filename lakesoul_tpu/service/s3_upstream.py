@@ -27,6 +27,23 @@ from lakesoul_tpu.service import sigv4
 logger = logging.getLogger("lakesoul_tpu.service.s3_upstream")
 
 
+_SSL_CTX = None
+_SSL_CTX_LOCK = threading.Lock()
+
+
+def _default_ssl_context():
+    """One shared verifying context: building a fresh one per connection
+    would re-read the system CA bundle on the proxy's per-request hot path;
+    wrap_socket on a shared context is thread-safe."""
+    global _SSL_CTX
+    with _SSL_CTX_LOCK:
+        if _SSL_CTX is None:
+            import ssl
+
+            _SSL_CTX = ssl.create_default_context()
+        return _SSL_CTX
+
+
 class VerifiedHTTPSConnection(http.client.HTTPSConnection):
     """HTTPS to a DNS-discovered IP with certificate verification against
     the REAL hostname: dialing the resolved IP directly would otherwise
@@ -35,11 +52,9 @@ class VerifiedHTTPSConnection(http.client.HTTPSConnection):
     CERTIFICATE_VERIFY_FAILED."""
 
     def __init__(self, ip: str, port: int, *, server_hostname: str, timeout: float):
-        import ssl
-
         super().__init__(ip, port, timeout=timeout)
         self._server_hostname = server_hostname
-        self._verify_ctx = ssl.create_default_context()
+        self._verify_ctx = _default_ssl_context()
 
     def connect(self):
         http.client.HTTPConnection.connect(self)

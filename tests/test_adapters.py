@@ -62,16 +62,30 @@ def _install_ray_stub(monkeypatch):
 
 
 def _install_daft_stub(monkeypatch):
+    """Wire-faithful daft surface used by the adapter: ``from_arrow``
+    accepts a Table or an ITERABLE of tables (the reference passes a
+    generator, daft/__init__.py:34) and materializes lazily;
+    ``to_arrow_iter`` yields the underlying tables."""
     daft = types.ModuleType("daft")
 
     class _DF:
-        def __init__(self, table):
-            self._table = table
+        def __init__(self, obj):
+            self._obj = obj  # table or lazy iterable — consumed on demand
+
+        def _tables(self):
+            if isinstance(self._obj, pa.Table):
+                self._obj = [self._obj]
+            elif not isinstance(self._obj, list):
+                self._obj = list(self._obj)
+            return self._obj
 
         def to_arrow(self):
-            return self._table
+            return pa.concat_tables(self._tables())
 
-    daft.from_arrow = lambda table: _DF(table)
+        def to_arrow_iter(self):
+            yield from self._tables()
+
+    daft.from_arrow = lambda obj: _DF(obj)
     monkeypatch.setitem(sys.modules, "daft", daft)
 
 
@@ -145,3 +159,48 @@ class TestDaftAdapter:
         )
         write_lakesoul(df, dst)
         assert dst.to_arrow().sort_by("id").equals(table.to_arrow().sort_by("id"))
+
+    def test_read_is_lazy_and_per_unit(self, table, monkeypatch):
+        """read_lakesoul must hand daft a LAZY per-scan-unit iterator — no
+        decode until daft consumes, one table per (partition, bucket)."""
+        _install_daft_stub(monkeypatch)
+        import lakesoul_tpu.io.reader as reader_mod
+        from lakesoul_tpu.data.daft_adapter import read_lakesoul
+
+        calls = []
+        real = reader_mod.read_scan_unit
+        monkeypatch.setattr(
+            reader_mod, "read_scan_unit",
+            lambda *a, **k: (calls.append(1) or real(*a, **k)),
+        )
+        df = read_lakesoul(table.scan())
+        assert calls == [], "read_lakesoul decoded eagerly"
+        n_units = len(table.scan().scan_plan())
+        assert n_units >= 2  # 2 hash buckets
+        tables = list(df.to_arrow_iter())
+        assert len(calls) == n_units and len(tables) == n_units
+        got = pa.concat_tables(tables).sort_by("id")
+        assert got.column("v").to_pylist() == [1.0, 20.0, 3.0, 4.0]
+
+    def test_write_streams_iter_single_commit(self, tmp_warehouse, monkeypatch):
+        """write_lakesoul streams to_arrow_iter() partitions through one
+        writer and commits once (version-0 heads)."""
+        _install_daft_stub(monkeypatch)
+        import daft
+
+        from lakesoul_tpu.data.daft_adapter import write_lakesoul
+
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table("dw", SCHEMA, primary_keys=["id"], hash_bucket_num=2)
+        parts = [
+            pa.table({"id": [1, 2], "v": [1.0, 2.0]}),
+            pa.table({"id": [3], "v": [3.0]}),
+            pa.table({"id": [4, 5], "v": [4.0, 5.0]}),
+        ]
+        df = daft.from_arrow(iter(parts))
+        ops = write_lakesoul(df, t)
+        assert ops  # committed file ops returned
+        got = t.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2, 3, 4, 5]
+        heads = catalog.client.store.get_all_latest_partition_info(t.info.table_id)
+        assert all(h.version == 0 for h in heads)  # exactly one commit

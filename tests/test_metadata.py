@@ -22,14 +22,49 @@ from lakesoul_tpu.meta.store import SqliteMetadataStore
 SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float32()), ("date", pa.string())])
 
 
-@pytest.fixture(params=["sqlite", "pglike"])
+@pytest.fixture(params=["sqlite", "pglike", "pg-real"])
 def client(tmp_path, request, monkeypatch):
-    """The full metadata suite runs against BOTH backends: sqlite and
+    """The full metadata suite runs against THREE backends: sqlite,
     PostgresMetadataStore driven by a wire-faithful psycopg2 fake (format
     paramstyle, autocommit switching, psycopg2 error classes, real
-    cross-connection transactions — VERDICT r1 weak #5)."""
+    cross-connection transactions — VERDICT r1 weak #5), and — when a real
+    server is reachable — LIVE PostgreSQL (the reference CI's postgres:14.5
+    shape, .github/workflows/rust-ci.yml:27-56).  The live leg needs
+    ``LAKESOUL_TEST_PG_DSN`` (e.g. postgresql://user:pw@host/db) and the
+    psycopg2 driver; this image ships neither, so it shows as SKIPPED here
+    and runs wherever they exist.  tests/test_pg_dialect.py statically
+    checks every emitted statement for PG-dialect safety in the meantime."""
     if request.param == "sqlite":
         yield MetaDataClient(db_path=str(tmp_path / "meta.db"))
+        return
+    if request.param == "pg-real":
+        import os
+
+        dsn = os.environ.get("LAKESOUL_TEST_PG_DSN")
+        if not dsn:
+            pytest.skip("no live PostgreSQL (set LAKESOUL_TEST_PG_DSN)")
+        pytest.importorskip("psycopg2")
+        from lakesoul_tpu.meta.store import PostgresMetadataStore
+
+        store = PostgresMetadataStore(dsn)
+
+        def wipe():
+            # the DSN must point at a DEDICATED throwaway database: the
+            # suite uses fixed table names, so the metadata tables are
+            # truncated — before (residue from a crashed prior run) AND
+            # after each test
+            conn = store._conn()
+            with conn:
+                cur = conn.cursor()
+                for tbl in ("namespace", "table_info", "table_name_id",
+                            "table_path_id", "data_commit_info",
+                            "partition_info", "global_config",
+                            "discard_compressed_file_info"):
+                    cur.execute(f"DELETE FROM {tbl}")
+
+        wipe()
+        yield MetaDataClient(store=store)
+        wipe()
         return
     import sys
 

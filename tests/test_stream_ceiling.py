@@ -1,0 +1,69 @@
+"""Pin the bounded-memory streaming ceiling (VERDICT r3 item 4).
+
+A fresh subprocess writes a table several times larger than both the memory
+budget and the asserted RSS ceiling's headroom, scans it through the
+streaming path, and reports its own peak RSS: if the read path ever regressed
+to materializing units, the subprocess high-water mark would blow straight
+past the ceiling.  (bench.py's `stream` leg runs the same check at ≥100M-row
+scale; this is the CI-sized pin.)
+"""
+
+import json
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import json, os, resource, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np, pyarrow as pa
+from lakesoul_tpu import LakeSoulCatalog
+
+N, F = 8_000_000, 16
+schema = pa.schema([("id", pa.int64())] + [(f"f{{i}}", pa.float32()) for i in range(F)])
+cat = LakeSoulCatalog({wh!r})
+t = cat.create_table(
+    "big", schema, primary_keys=["id"], hash_bucket_num=4,
+    properties={{
+        "lakesoul.file_format": "lsf",
+        "lakesoul.memory_budget_bytes": str(8 << 20),  # 8 MB: force streaming
+    }},
+)
+rng = np.random.default_rng(0)
+for start in range(0, N, 1_000_000):
+    cols = {{"id": np.arange(start, start + 1_000_000, dtype=np.int64)}}
+    for i in range(F):
+        cols[f"f{{i}}"] = rng.normal(size=1_000_000).astype(np.float32)
+    t.write_arrow(pa.table(cols, schema=schema))
+# overlapping upsert so the STREAMING MERGE path runs, not plain decode
+up = rng.choice(N, N // 20, replace=False).astype(np.int64)
+cols = {{"id": up}}
+for i in range(F):
+    cols[f"f{{i}}"] = rng.normal(size=len(up)).astype(np.float32)
+t.upsert(pa.table(cols, schema=schema))
+
+after_build = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+rows = 0
+for batch in t.scan().batch_size(262_144).to_batches():
+    rows += len(batch)
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+print(json.dumps({{"rows": rows, "peak_rss_mb": peak, "build_rss_mb": after_build}}))
+"""
+
+
+def test_streaming_scan_stays_under_ceiling(tmp_path):
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", _SCRIPT.format(repo=repo, wh=str(tmp_path / "wh"))],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    r = json.loads(out.stdout.splitlines()[-1])
+    assert r["rows"] == 8_000_000
+    # table data ≈ 8M rows x 68 B ≈ 550 MB; a materializing read would hold
+    # entire buckets (~140 MB each) plus merge copies on top of the ~350 MB
+    # python/pyarrow/numpy floor.  The bounded path must stay well below
+    # floor+table.
+    assert r["peak_rss_mb"] < 900, f"streaming path peak RSS {r['peak_rss_mb']} MB"
