@@ -108,13 +108,30 @@ def _chunks(n_rows, start_at=0, chunk=500_000, seed=0):
 def _upsert_wave(t, seed: int, n_rows: int | None = None,
                  chunk: int = 2_000_000) -> None:
     """One MOR-provoking upsert wave: re-write UPSERT_FRAC of the keys,
-    chunked so the wave never materializes whole in the driver."""
+    chunked so the wave never materializes whole in the driver.  Keys are
+    sampled without replacement from DISJOINT id sub-ranges per chunk —
+    `rng.choice(N, replace=False)` would permute the full N-row population
+    (O(N) transient memory: ~8 GB at 1B rows) for a tiny sample."""
     n_rows = n_rows or N_ROWS
     rng = np.random.default_rng(seed)
     n_up = int(n_rows * UPSERT_FRAC)
-    upd = rng.choice(n_rows, n_up, replace=False).astype(np.int64)
-    for start in range(0, n_up, chunk):
-        piece = upd[start : start + chunk]
+    n_chunks = max(1, -(-n_up // chunk))
+    span = n_rows // n_chunks
+
+    def sample(n, k):
+        # O(k) rejection sampling (k/n ≈ UPSERT_FRAC, so retries are rare)
+        out = np.unique(rng.integers(0, n, int(k * 1.1) + 16, dtype=np.int64))
+        while out.size < k:
+            out = np.unique(
+                np.concatenate([out, rng.integers(0, n, k, dtype=np.int64)])
+            )
+        rng.shuffle(out)
+        return out[:k]
+
+    for c in range(n_chunks):
+        take = min(chunk, n_up - c * chunk)
+        lo = c * span
+        piece = lo + sample(min(span, n_rows - lo), take)
         cols = {"id": piece}
         for i in range(N_FEATURES):
             cols[f"f{i}"] = rng.normal(size=len(piece)).astype(np.float32)

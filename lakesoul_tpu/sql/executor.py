@@ -475,9 +475,66 @@ class SqlSession:
     def __init__(self, catalog, namespace: str = "default"):
         self.catalog = catalog
         self.namespace = namespace
+        self._externals: dict[str, object] = {}
+
+    # ----------------------------------------------------------- federation
+    def register_external(self, name: str, source) -> None:
+        """Register a READ-ONLY external table for federation — the role of
+        the reference's ADBC federation in lakesoul-datafusion (SURVEY §2.5:
+        querying a mysql catalog from the same SQL session).  ``source`` is
+        an Arrow table, a data-file path (any format the registry reads —
+        parquet/LSF/IPC — on any fsspec store), or a zero-arg callable
+        returning an Arrow table (e.g. an ADBC/DB-API fetch).  External
+        names shadow catalog tables inside THIS session and join/subquery
+        freely against lakehouse tables; DML against them is rejected."""
+        self._externals[name] = source
+
+    def _external_table(self, name: str) -> "pa.Table | None":
+        source = self._externals.get(name)
+        if source is None:
+            return None
+        memo = getattr(self, "_ext_memo", None)
+        if memo is None:
+            memo = {}  # outside a statement: discarded temporary
+        if name in memo:
+            return memo[name]
+        if isinstance(source, pa.Table):
+            out = source
+        elif callable(source):
+            out = source()
+            if not isinstance(out, pa.Table):
+                raise SqlError(
+                    f"external source {name!r} returned {type(out).__name__},"
+                    " expected pyarrow.Table"
+                )
+        else:
+            from lakesoul_tpu.io.formats import format_for
+
+            out = format_for(str(source)).read_table(str(source))
+        # one fetch per STATEMENT: a query referencing the external several
+        # times (join + subquery) sees one consistent snapshot; outside a
+        # statement the memo is a discarded temporary (nothing stays pinned)
+        memo[name] = out
+        return out
 
     def execute(self, sql: str) -> pa.Table:
         stmt = parse(sql)
+        target = getattr(stmt, "table", None)
+        if target in self._externals and isinstance(
+            stmt,
+            (ast.Insert, ast.Update, ast.Delete, ast.DropTable,
+             ast.AlterAddColumn, ast.AlterSetProperties),
+        ):
+            raise SqlError(f"external table {target!r} is read-only")
+        self._ext_memo: dict[str, pa.Table] = {}
+        try:
+            return self._execute_stmt(stmt)
+        finally:
+            # a fetched external snapshot must not stay pinned past the
+            # statement on a long-lived session
+            self._ext_memo = None
+
+    def _execute_stmt(self, stmt) -> pa.Table:
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt.stmt)
         if isinstance(stmt, ast.Select):
@@ -684,6 +741,17 @@ class SqlSession:
                 has_aggs = bool(s.group_by) or s.having is not None or any(
                     _contains_agg(it.expr) for it in s.items
                 )
+            elif s.table in self._externals:
+                lines.append(
+                    f"{indent}ExternalScan: {s.table} (federated source; no"
+                    " pushdown — whole WHERE filters post-materialization)"
+                )
+                has_aggs = bool(s.group_by) or s.having is not None or any(
+                    _contains_agg(it.expr) for it in s.items
+                )
+            elif not s.table:
+                lines.append(f"{indent}OneRow: FROM-less SELECT")
+                return
             elif self._count_shortcut_applies(s):
                 lines.append(
                     f"{indent}MetadataCount: table={s.table} — row count from"
@@ -741,13 +809,13 @@ class SqlSession:
         describe(stmt)
         return pa.table({"plan": lines})
 
-    @staticmethod
-    def _count_shortcut_applies(stmt: ast.Select) -> bool:
+    def _count_shortcut_applies(self, stmt: ast.Select) -> bool:
         """Bare ``SELECT count(*) FROM t``: metadata-only count, no decode
         (reference: EmptyScanCountExec shortcut).  Shared with EXPLAIN so the
         plan shown is the plan run."""
         return (
-            len(stmt.items) == 1
+            stmt.table not in self._externals
+            and len(stmt.items) == 1
             and isinstance(stmt.items[0].expr, ast.Agg)
             and stmt.items[0].expr.fn == "count"
             and stmt.items[0].expr.arg is None
@@ -762,6 +830,18 @@ class SqlSession:
         )
 
     def _select(self, stmt: ast.Select) -> pa.Table:
+        if not stmt.table and stmt.from_subquery is None and not stmt.joins:
+            # FROM-less SELECT: evaluate items over one anonymous row
+            one = pa.table({"__r__": pa.array([0])})
+            if stmt.where is not None:
+                mask = self._eval_bool(stmt.where, one)
+                one = one.filter(pc.fill_null(_broadcast(mask, 1), False))
+            out, hidden = self._project(stmt, one)
+            if hidden:
+                out = out.drop_columns(hidden)
+            if stmt.limit is not None:
+                out = out.slice(0, stmt.limit)
+            return out
         if self._count_shortcut_applies(stmt):
             n = self._base_scan(stmt).count_rows()
             label = stmt.items[0].alias or "count(*)"
@@ -780,6 +860,12 @@ class SqlSession:
             table = self._query(stmt.from_subquery)
             if stmt.where is not None:
                 residual_nodes = [stmt.where]
+        elif (ext := self._external_table(stmt.table)) is not None:
+            if stmt.as_of_ms is not None:
+                raise SqlError("AS OF time travel requires a lakehouse table")
+            table = ext
+            if stmt.where is not None:
+                residual_nodes = [stmt.where]
         else:
             scan, residual_nodes = self._plan_base(stmt, has_aggs)
             table = scan.to_arrow()
@@ -788,6 +874,8 @@ class SqlSession:
         for j in stmt.joins:
             if j.subquery is not None:
                 right = self._query(j.subquery)
+            elif (jext := self._external_table(j.table)) is not None:
+                right = jext
             else:
                 right = self.catalog.table(j.table, self.namespace).to_arrow()
             rname = j.alias or j.table
@@ -1015,22 +1103,24 @@ class SqlSession:
                 names.add(it.expr.name)
         return names
 
+    def _table_schema_names(self, name: str) -> set[str]:
+        ext = self._external_table(name)
+        if ext is not None:
+            return set(ext.schema.names)
+        return set(self.catalog.table(name, self.namespace).schema.names)
+
     def _scope_columns(self, sel) -> set[str]:
         """Names visible inside a Select's FROM scope, without executing it."""
         cols: set[str] = set()
         if sel.from_subquery is not None:
             cols |= self._projection_names(sel.from_subquery)
         elif sel.table:
-            cols |= set(
-                self.catalog.table(sel.table, self.namespace).schema.names
-            )
+            cols |= self._table_schema_names(sel.table)
         for j in sel.joins:
             if j.subquery is not None:
                 cols |= self._projection_names(j.subquery)
             elif j.table:
-                cols |= set(
-                    self.catalog.table(j.table, self.namespace).schema.names
-                )
+                cols |= self._table_schema_names(j.table)
         return cols
 
     @staticmethod

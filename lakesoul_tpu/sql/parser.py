@@ -477,15 +477,21 @@ class Parser:
                 items.append(self._select_item())
                 if not self.accept("op", ","):
                     break
-        self.expect("kw", "from")
         sel = Select(items=items, star=star, table="", distinct=distinct)
-        if self.accept("op", "("):
+        has_from = bool(self.accept("kw", "from"))
+        if not has_from and star:
+            # FROM-less SELECT (`SELECT 1`, `SELECT 1 LIMIT 1`) — the probe
+            # statement ADBC/JDBC drivers open connections with; evaluates
+            # the items over one anonymous row.  Trailing clauses (WHERE,
+            # ORDER BY, LIMIT) parse the same as with a FROM.
+            raise SqlError("SELECT * requires a FROM clause")
+        if has_from and self.accept("op", "("):
             sel.from_subquery = self.parse_query()
             self.expect("op", ")")
             self.accept("kw", "as")
             if self.peek() is not None and self.peek().kind == "ident":
                 sel.from_alias = self.ident()
-        else:
+        elif has_from:
             sel.table = self.ident()
             self._maybe_time_travel(sel)
             # optional table alias (FROM lineitem l) — ignored for resolution,
@@ -493,7 +499,7 @@ class Parser:
             nxt = self.peek()
             if nxt is not None and nxt.kind == "ident":
                 sel.from_alias = self.ident()
-        while True:
+        while has_from:
             kind = None
             if self.accept("kw", "inner"):
                 kind = "inner"

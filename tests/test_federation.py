@@ -1,0 +1,124 @@
+"""FROM-less SELECT and federated external tables (SURVEY §2.5's ADBC
+federation role: lakesoul-datafusion queries a mysql catalog from the same
+SQL session; here any Arrow table / data file / fetch-callable registers as
+a read-only external table that joins and subqueries against lakehouse
+tables)."""
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.sql import SqlSession
+from lakesoul_tpu.sql.parser import SqlError
+
+
+@pytest.fixture()
+def s(tmp_warehouse):
+    cat = LakeSoulCatalog(str(tmp_warehouse))
+    s = SqlSession(cat)
+    s.execute("CREATE TABLE fact (id bigint PRIMARY KEY, dim_id bigint, v double)")
+    s.execute("INSERT INTO fact VALUES (1,10,1.5),(2,20,2.5),(3,10,3.5)")
+    return s
+
+
+class TestFromlessSelect:
+    def test_literals(self, s):
+        assert s.execute("SELECT 1").to_pydict() == {"1": [1]}
+        out = s.execute("SELECT 1 + 1 AS two, 'hi' AS msg")
+        assert out.to_pydict() == {"two": [2], "msg": ["hi"]}
+
+    def test_star_requires_from(self, s):
+        with pytest.raises(SqlError, match="FROM"):
+            s.execute("SELECT *")
+
+    def test_trailing_clauses(self, s):
+        # connection pools probe with `SELECT 1 LIMIT 1`; WHERE gates the row
+        assert s.execute("SELECT 1 LIMIT 1").to_pydict() == {"1": [1]}
+        assert s.execute("SELECT 1 WHERE 1 = 2").num_rows == 0
+        assert s.execute("SELECT 1 AS x ORDER BY x").to_pydict() == {"x": [1]}
+
+    def test_over_flight_sql(self, tmp_warehouse):
+        """The ADBC connection-probe statement works over the protocol."""
+        from lakesoul_tpu.service.flight_sql import (
+            FlightSqlClient,
+            LakeSoulFlightSqlServer,
+        )
+
+        srv = LakeSoulFlightSqlServer(
+            LakeSoulCatalog(str(tmp_warehouse)), "grpc://127.0.0.1:0"
+        )
+        try:
+            c = FlightSqlClient(f"grpc://127.0.0.1:{srv.port}")
+            assert c.execute("SELECT 1").to_pydict() == {"1": [1]}
+            c.close()
+        finally:
+            srv.shutdown()
+
+
+class TestExternalTables:
+    def test_arrow_table_join(self, s):
+        s.register_external(
+            "dims", pa.table({"dim_id": [10, 20], "name": ["a", "b"]})
+        )
+        out = s.execute(
+            "SELECT name, sum(v) AS sv FROM fact JOIN dims ON"
+            " fact.dim_id = dims.dim_id GROUP BY name ORDER BY name"
+        )
+        assert out.column("name").to_pylist() == ["a", "b"]
+        assert out.column("sv").to_pylist() == [5.0, 2.5]
+
+    def test_file_source(self, s, tmp_path):
+        path = tmp_path / "ext.parquet"
+        pq.write_table(pa.table({"id": [1, 2], "tag": ["x", "y"]}), path)
+        s.register_external("tags", str(path))
+        out = s.execute("SELECT tag FROM tags ORDER BY tag")
+        assert out.column("tag").to_pylist() == ["x", "y"]
+
+    def test_callable_fetched_once_per_statement(self, s):
+        calls = []
+
+        def fetch():
+            calls.append(1)
+            return pa.table({"dim_id": [10], "w": [2.0]})
+
+        s.register_external("live", fetch)
+        out = s.execute(
+            "SELECT sum(v * w) AS x FROM fact JOIN live ON"
+            " fact.dim_id = live.dim_id"
+            " WHERE dim_id IN (SELECT dim_id FROM live)"
+        )
+        assert out.column("x").to_pylist() == [10.0]
+        assert len(calls) == 1  # one consistent snapshot per statement
+        s.execute("SELECT count(*) AS c FROM live")
+        assert len(calls) == 2  # next statement re-fetches
+
+    def test_external_in_correlated_subquery(self, s):
+        s.register_external(
+            "quota", pa.table({"dim_id": [10, 20], "cap": [4.0, 1.0]})
+        )
+        out = s.execute(
+            "SELECT id FROM fact f WHERE v < "
+            "(SELECT max(cap) FROM quota WHERE quota.dim_id = f.dim_id)"
+            " ORDER BY id"
+        )
+        assert out.column("id").to_pylist() == [1, 3]
+
+    def test_external_shadows_and_is_read_only(self, s):
+        s.register_external("fact2", pa.table({"id": [99]}))
+        with pytest.raises(SqlError, match="read-only"):
+            s.execute("INSERT INTO fact2 VALUES (1)")
+        with pytest.raises(SqlError, match="read-only"):
+            s.execute("DROP TABLE fact2")
+        # lakehouse DML still works
+        s.execute("DELETE FROM fact WHERE id = 3")
+        out = s.execute("SELECT count(*) AS c FROM fact")
+        assert out.column("c").to_pylist() == [2]
+
+    def test_explain_shows_external_scan(self, s):
+        s.register_external("dims", pa.table({"dim_id": [10], "name": ["a"]}))
+        plan = "\n".join(
+            s.execute("EXPLAIN SELECT name FROM dims WHERE dim_id = 10")
+            .column("plan").to_pylist()
+        )
+        assert "ExternalScan: dims" in plan
