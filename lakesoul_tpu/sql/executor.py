@@ -1083,12 +1083,12 @@ class SqlSession:
     #   col IN (SELECT c FROM … WHERE corr)        → EXISTS with c = col
     #   (SELECT agg(x) FROM … WHERE inner.k = outer.k AND p)
     #                                              → GROUP BY k + left join
-    # Column references resolve by scope membership (the dialect drops
-    # qualifiers): a name in the subquery's FROM scope is inner; otherwise it
-    # must be an outer column.  A name visible in BOTH scopes resolves inner
-    # (standard innermost-scope-wins), which also means self-correlation
-    # (Q21's l2.l_suppkey <> l1.l_suppkey) needs qualified names the dialect
-    # does not keep — that one shape stays manually rewritten in tpch.py.
+    # Column references resolve QUALIFIER-FIRST (Column.qual survives
+    # parsing): a qualifier naming the subquery's own table/alias is inner,
+    # any other qualifier is outer; bare names resolve by scope membership,
+    # innermost-first.  That covers aliased self-correlation too — Q21's
+    # ``l2.l_suppkey <> l1.l_suppkey`` runs natively, the inner/outer sides
+    # disambiguated by the l1/l2 aliases even though the names collide.
 
     def _projection_names(self, sel) -> set[str]:
         if isinstance(sel, ast.SetOp):

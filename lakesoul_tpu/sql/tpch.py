@@ -9,12 +9,10 @@ HAVING, sub-queries) while mapping constructs the dialect does not have:
 
 - dates are ISO strings (lexicographic order == date order; EXTRACT(year)
   becomes ``substring(col, 1, 4)``)
-- correlated sub-queries run NATIVELY (Q2/Q4/Q17/Q20/Q22 keep their real
-  correlated shapes; the executor decorrelates them mechanically to hash
-  semi-joins / grouped left joins).  The one exception is Q21's
-  self-correlated ``l2.l_suppkey <> l1.l_suppkey`` pair, which needs
-  qualified self-join scopes the dialect does not track — it stays
-  rewritten to its HAVING-count equivalent
+- correlated sub-queries run NATIVELY (Q2/Q4/Q17/Q20/Q21/Q22 keep their
+  real correlated shapes; the executor decorrelates them mechanically to
+  hash semi-joins / grouped left joins, with alias qualifiers resolving
+  self-correlation like Q21's ``l2.l_suppkey <> l1.l_suppkey``)
 - partsupp's composite key joins through a synthetic ``ps_key``
   (partkey * 1e6 + suppkey) mirrored on lineitem
 - multi-role dimension joins (Q7/Q8's two nations) use column-renaming
@@ -293,19 +291,23 @@ QUERIES = {
         "                     AND l_suppkey = ps_suppkey))"
         " ORDER BY s_name"
     ),
-    # Q21 suppliers who kept orders waiting — the one REMAINING manual
-    # rewrite: its self-correlated l2.l_suppkey <> l1.l_suppkey needs
-    # qualified self-join scopes the dialect does not track
+    # Q21 suppliers who kept orders waiting — the REAL self-correlated
+    # shape: alias qualifiers (l1/l2/l3) resolve the same-named columns
+    # across scopes; the executor decorrelates both EXISTS legs to
+    # semi-joins with the <> predicate evaluated on the joined pairs
     "q21": (
-        "SELECT s_name, count(*) AS numwait FROM lineitem"
-        " JOIN supplier ON l_suppkey = suppkey"
-        " JOIN orders ON lineitem.orderkey = orders.orderkey"
+        "SELECT s_name, count(*) AS numwait FROM lineitem l1"
+        " JOIN supplier ON l1.l_suppkey = suppkey"
+        " JOIN orders ON l1.orderkey = orders.orderkey"
         " JOIN nation ON s_nationkey = nationkey"
         " WHERE o_status = 'F' AND receiptdate > commitdate"
         " AND n_name = 'KENYA'"
-        " AND lineitem.orderkey IN"
-        " (SELECT orderkey FROM lineitem GROUP BY orderkey"
-        "  HAVING count(DISTINCT l_suppkey) > 1)"
+        " AND EXISTS (SELECT * FROM lineitem l2 WHERE l2.orderkey = l1.orderkey"
+        "             AND l2.l_suppkey <> l1.l_suppkey)"
+        " AND NOT EXISTS (SELECT * FROM lineitem l3"
+        "                 WHERE l3.orderkey = l1.orderkey"
+        "                 AND l3.l_suppkey <> l1.l_suppkey"
+        "                 AND l3.receiptdate > l3.commitdate)"
         " GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100"
     ),
     # Q22 global sales opportunity: substring country codes, scalar-subquery
@@ -789,8 +791,11 @@ def pandas_reference(name: str, f: dict):
         return d.sort_values("s_name")[["s_name"]]
 
     if name == "q21":
-        multi = li.groupby("orderkey")["l_suppkey"].nunique()
-        multi = set(multi[multi > 1].index)
+        # real Q21 semantics: l1 late; ANOTHER supplier has a lineitem in
+        # the same order; and NO other supplier's lineitem in it is late
+        supp_by_order = li.groupby("orderkey")["l_suppkey"].agg(lambda s: set(s))
+        late = li[li.receiptdate > li.commitdate]
+        late_by_order = late.groupby("orderkey")["l_suppkey"].agg(lambda s: set(s))
         d = (
             li.merge(su, left_on="l_suppkey", right_on="suppkey")
             .merge(od, on="orderkey")
@@ -798,8 +803,14 @@ def pandas_reference(name: str, f: dict):
         )
         d = d[
             (d.o_status == "F") & (d.receiptdate > d.commitdate)
-            & (d.n_name == "KENYA") & d.orderkey.isin(multi)
+            & (d.n_name == "KENYA")
         ]
+        keep = d.apply(
+            lambda r: bool(supp_by_order.get(r.orderkey, set()) - {r.l_suppkey})
+            and not (late_by_order.get(r.orderkey, set()) - {r.l_suppkey}),
+            axis=1,
+        )
+        d = d[keep] if len(d) else d
         g = d.groupby("s_name", as_index=False).agg(numwait=("orderkey", "size"))
         return g.sort_values(["numwait", "s_name"], ascending=[False, True]).head(100)
 
