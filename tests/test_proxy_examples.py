@@ -105,6 +105,17 @@ class TestExamples:
         assert out.returncode == 0, out.stderr[-2000:]
         assert "steps, loss" in out.stdout
 
+    def test_flight_sql_gateway_example(self):
+        import os
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable, "examples/flight_sql_gateway.py"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert out.stdout.strip().endswith("OK")
+
 
 class TestProxyRangeRequests:
     """VERDICT r1 weak #7: streamed bodies + HTTP Range support so parquet
