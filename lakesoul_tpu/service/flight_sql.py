@@ -762,3 +762,84 @@ class FlightSqlClient:
 
     def close(self) -> None:
         self._client.close()
+
+
+def _serve_prometheus(metrics, port: int, host: str = "0.0.0.0"):
+    """Prometheus exposition endpoint (parity with the reference server's
+    PrometheusBuilder, bin/flight_sql_server.rs:21-22): GET /metrics."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            body = metrics.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    import threading
+
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def main(argv=None) -> int:
+    """`lakesoul-flight-sql-server` — the reference's flight_sql_server
+    binary (bin/flight_sql_server.rs:22): serve a warehouse over the
+    standard Flight SQL protocol, optionally with JWT auth and a
+    Prometheus /metrics endpoint."""
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        "lakesoul-flight-sql-server",
+        description="Arrow Flight SQL gateway over a lakesoul_tpu warehouse",
+    )
+    p.add_argument("--warehouse", required=True, help="warehouse root (any fsspec path)")
+    p.add_argument("--db-path", default=None, help="metadata SQLite path (default: in-warehouse)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=50051)
+    p.add_argument(
+        "--jwt-secret",
+        default=os.environ.get("LAKESOUL_JWT_SECRET"),
+        help="enable auth (env LAKESOUL_JWT_SECRET); omit for open access",
+    )
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus metrics on this HTTP port")
+    args = p.parse_args(argv)
+
+    from lakesoul_tpu import LakeSoulCatalog
+
+    catalog = LakeSoulCatalog(args.warehouse, db_path=args.db_path)
+    server = LakeSoulFlightSqlServer(
+        catalog, f"grpc://{args.host}:{args.port}", jwt_secret=args.jwt_secret
+    )
+    metrics_srv = None
+    if args.metrics_port:
+        # metrics bind the SAME interface as the gateway: --host 127.0.0.1
+        # must not leave /metrics world-reachable
+        metrics_srv = _serve_prometheus(server.metrics, args.metrics_port, args.host)
+        print(f"metrics on http://{args.host}:{args.metrics_port}/metrics", flush=True)
+    print(
+        f"Flight SQL server on grpc://{args.host}:{server.port}"
+        f" (auth={'jwt' if args.jwt_secret else 'open'})",
+        flush=True,
+    )
+    try:
+        server.serve()
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -248,6 +248,9 @@ class StorageProxy:
     def port(self) -> int:
         return self._server.server_address[1]
 
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
@@ -257,3 +260,61 @@ class StorageProxy:
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    """`lakesoul-storage-proxy` — the reference's s3-proxy binary role:
+    JWT+RBAC-enforcing object proxy over a warehouse, optionally re-signing
+    to an S3 or Azure upstream configured from environment variables
+    (LAKESOUL_PROXY_S3_* / LAKESOUL_PROXY_AZURE_*)."""
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        "lakesoul-storage-proxy",
+        description="RBAC storage proxy over a lakesoul_tpu warehouse",
+    )
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--db-path", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--jwt-secret", default=os.environ.get("LAKESOUL_JWT_SECRET"))
+    args = p.parse_args(argv)
+
+    from lakesoul_tpu import LakeSoulCatalog
+
+    upstream, mode = None, "direct"
+    if os.environ.get("LAKESOUL_PROXY_S3_ENDPOINT"):
+        from lakesoul_tpu.service.s3_upstream import S3Upstream, S3UpstreamConfig
+
+        upstream = S3Upstream(S3UpstreamConfig(
+            endpoint=os.environ["LAKESOUL_PROXY_S3_ENDPOINT"],
+            bucket=os.environ["LAKESOUL_PROXY_S3_BUCKET"],
+            access_key=os.environ.get("LAKESOUL_PROXY_S3_ACCESS_KEY", ""),
+            secret_key=os.environ.get("LAKESOUL_PROXY_S3_SECRET_KEY", ""),
+            region=os.environ.get("LAKESOUL_PROXY_S3_REGION", "us-east-1"),
+        ))
+        mode = "s3-upstream"
+    elif os.environ.get("LAKESOUL_PROXY_AZURE_ACCOUNT"):
+        from lakesoul_tpu.service.azure import AzureUpstream, AzureUpstreamConfig
+
+        upstream = AzureUpstream(AzureUpstreamConfig(
+            account=os.environ["LAKESOUL_PROXY_AZURE_ACCOUNT"],
+            key_b64=os.environ["LAKESOUL_PROXY_AZURE_KEY"],
+            container=os.environ["LAKESOUL_PROXY_AZURE_CONTAINER"],
+            endpoint=os.environ.get("LAKESOUL_PROXY_AZURE_ENDPOINT"),
+        ))
+        mode = "azure-upstream"
+    catalog = LakeSoulCatalog(args.warehouse, db_path=args.db_path)
+    proxy = StorageProxy(
+        catalog, jwt_secret=args.jwt_secret, host=args.host, port=args.port,
+        upstream=upstream,
+    )
+    print(f"storage proxy on http://{args.host}:{proxy.port} ({mode},"
+          f" auth={'jwt' if args.jwt_secret else 'open'})", flush=True)
+    proxy.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
