@@ -190,16 +190,17 @@ def bench_stream_bounded(t) -> dict:
     the process's peak RSS, which must stay under STREAM_RSS_CEILING_MB —
     the whole point is that throughput does NOT come from materializing the
     table (ref stance: benches/spill_bench.rs, cache_bench.rs).  Runs in a
-    fresh subprocess so ru_maxrss is this leg's own high-water mark; no JAX
-    in this leg (pure host path)."""
-    import resource
+    fresh subprocess so the high-water mark is this leg's own; measured via
+    VmHWM — ru_maxrss survives exec and would report the bench driver's
+    peak (utils/memory.py).  No JAX in this leg (pure host path)."""
+    from lakesoul_tpu.utils.memory import peak_rss_mb as _peak
 
     start = time.perf_counter()
     rows = 0
     for batch in t.scan().batch_size(262_144).to_batches():
         rows += len(batch)
     wall = time.perf_counter() - start
-    peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    peak_rss_mb = _peak()
     if peak_rss_mb > STREAM_RSS_CEILING_MB:
         raise RuntimeError(
             f"stream leg peak RSS {peak_rss_mb:.0f} MB exceeded the"

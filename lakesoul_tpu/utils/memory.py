@@ -1,0 +1,29 @@
+"""Process memory accounting helpers."""
+
+from __future__ import annotations
+
+
+def peak_rss_mb() -> float:
+    """This process's OWN peak resident set, in MiB.
+
+    ``getrusage(RUSAGE_SELF).ru_maxrss`` is the obvious API but carries a
+    Linux quirk that poisons subprocess measurements: ``maxrss`` lives on
+    the signal struct, which SURVIVES ``execve`` — a worker forked from a
+    large parent (pytest after a long session, a bench driver that just
+    built a 100M-row table) reports the PARENT's high-water mark, not its
+    own.  ``VmHWM`` in ``/proc/self/status`` is per-``mm`` and resets at
+    exec, so it measures the process itself; ru_maxrss remains the
+    fallback where /proc is absent."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    import resource
+    import sys
+
+    maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # linux reports KiB; macOS reports BYTES (the only common /proc-less host)
+    return maxrss / (1 << 20) if sys.platform == "darwin" else maxrss / 1024.0

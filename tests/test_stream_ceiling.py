@@ -46,17 +46,17 @@ print("BUILT")
 """
 
 _SCAN = r"""
-import json, os, resource, sys
+import json, os, sys
 sys.path.insert(0, {repo!r})
 os.environ["JAX_PLATFORMS"] = "cpu"
 from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.utils.memory import peak_rss_mb
 
 t = LakeSoulCatalog({wh!r}).table("big")
 rows = 0
 for batch in t.scan().batch_size(262_144).to_batches():
     rows += len(batch)
-peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-print(json.dumps({{"rows": rows, "peak_rss_mb": peak}}))
+print(json.dumps({{"rows": rows, "peak_rss_mb": peak_rss_mb()}}))
 """
 
 
