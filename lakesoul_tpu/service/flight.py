@@ -382,12 +382,12 @@ class LakeSoulFlightServer(flight.FlightServerBase):
                 stmt = parse_sql(stmt_text)
             except SqlError as e:
                 raise flight.FlightServerError(str(e))
-            # same per-table RBAC as do_get/do_put: any statement touching an
-            # existing table is checked (CREATE TABLE targets a new one)
-            target = getattr(stmt, "table", None)
-            from lakesoul_tpu.sql.parser import CreateTable
+            # same per-table RBAC as do_get/do_put: EVERY table the statement
+            # touches is checked — joins, derived tables, subqueries — not
+            # just the primary FROM (CREATE TABLE targets a new one, skipped)
+            from lakesoul_tpu.sql.parser import referenced_tables
 
-            if target and not isinstance(stmt, CreateTable):
+            for target in sorted(referenced_tables(stmt)):
                 self._check(context, ns, target)
             result = SqlSession(self.catalog, ns).execute(stmt_text)
             sink = pa.BufferOutputStream()

@@ -77,18 +77,76 @@ def _render_sql_literal(v) -> str:
         return "NULL"
     if isinstance(v, bool):
         return "TRUE" if v else "FALSE"
-    if isinstance(v, (int, float)):
+    if isinstance(v, int):
         return repr(v)
+    if isinstance(v, float):
+        # the dialect's tokenizer has no scientific-notation number token:
+        # repr(1e-07) would fail to parse — render as plain decimal, exact
+        # to the float's shortest repr
+        import decimal
+        import math
+
+        if not math.isfinite(v):
+            raise flight.FlightServerError(
+                f"cannot bind non-finite float parameter {v!r}: the dialect"
+                " has no literal for it"
+            )
+        text = format(decimal.Decimal(repr(v)), "f")
+        # keep the decimal point: an integral float (1e16) would otherwise
+        # re-type as an int literal and fail int-range checks downstream
+        return text if "." in text else text + ".0"
     if isinstance(v, bytes):
-        return "'" + v.hex() + "'"
+        # a quoted hex STRING would silently never equal a binary column —
+        # reject instead of producing a wrong-answer literal
+        raise flight.FlightServerError(
+            "binary parameters are not supported: the dialect has no bytes"
+            " literal (bind a string or use ingest)"
+        )
     return "'" + str(v).replace("'", "''") + "'"
+
+
+def count_placeholders(query: str) -> int:
+    """Number of ``?`` parameter slots outside string literals — the same
+    scan :func:`bind_parameters` performs, used to validate arity at
+    CreatePreparedStatement time instead of failing at bind time."""
+    n = 0
+    in_str = False
+    i = 0
+    while i < len(query):
+        ch = query[i]
+        if in_str:
+            if ch == "'":
+                if i + 1 < len(query) and query[i + 1] == "'":
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+        elif ch == "?":
+            n += 1
+        i += 1
+    return n
 
 
 def bind_parameters(query: str, row: dict | None, values: list) -> str:
     """Substitute ``?`` placeholders (outside string literals) with rendered
     SQL literals — the binding model simple Flight SQL servers use; the
-    dialect has no server-side parameterized plans."""
+    dialect has no server-side parameterized plans.
+
+    Contract: binding is LITERAL SUBSTITUTION over the dialect's quoting
+    rules — single-quoted strings with ``''`` escapes are the only string
+    syntax the tokenizer knows, and the scan here mirrors exactly that.  If
+    the dialect ever grows another quoting form (dollar quotes, ``E''``),
+    this scanner must learn it in the same commit or placeholders inside
+    such strings would be substituted.  Arity is validated here and at
+    prepare time (:func:`count_placeholders`); a mismatch is an error, not
+    a silent partial bind."""
     del row  # positional binding only
+    want = count_placeholders(query)
+    if len(values) != want:
+        raise flight.FlightServerError(
+            f"statement has {want} parameter(s) but {len(values)} were bound"
+        )
     out = []
     it = iter(values)
     in_str = False
@@ -108,10 +166,8 @@ def bind_parameters(query: str, row: dict | None, values: list) -> str:
             in_str = True
             out.append(ch)
         elif ch == "?":
-            try:
-                out.append(_render_sql_literal(next(it)))
-            except StopIteration:
-                raise flight.FlightServerError("not enough bound parameters")
+            # arity was validated above: the iterator cannot exhaust
+            out.append(_render_sql_literal(next(it)))
         else:
             out.append(ch)
         i += 1
@@ -123,13 +179,14 @@ _PREPARED_CAP = 256
 
 
 class _PreparedStatement:
-    __slots__ = ("query", "dataset_schema", "params", "expires")
+    __slots__ = ("query", "dataset_schema", "params", "expires", "param_count")
 
     def __init__(self, query: str, dataset_schema: pa.Schema | None):
         self.query = query
         self.dataset_schema = dataset_schema
         self.params: list[list] = []  # bound rows (positional values)
         self.expires = time.monotonic() + _PREPARED_TTL_S
+        self.param_count = count_placeholders(query)
 
     def touch(self) -> "_PreparedStatement":
         self.expires = time.monotonic() + _PREPARED_TTL_S
@@ -148,14 +205,19 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
     # ------------------------------------------------------------- sql exec
     def _execute_sql(self, context, query: str, namespace: str = "default") -> pa.Table:
         from lakesoul_tpu.sql import SqlSession
-        from lakesoul_tpu.sql.parser import CreateTable, SqlError, parse as parse_sql
+        from lakesoul_tpu.sql.parser import (
+            SqlError,
+            parse as parse_sql,
+            referenced_tables,
+        )
 
         try:
             stmt = parse_sql(query)
         except SqlError as e:
             raise flight.FlightServerError(str(e))
-        target = getattr(stmt, "table", None)
-        if target and not isinstance(stmt, CreateTable):
+        # RBAC covers EVERY table the statement touches — joins, derived
+        # tables, EXISTS/IN/scalar subqueries — not just the primary FROM
+        for target in sorted(referenced_tables(stmt)):
             self._check(context, namespace, target)
         try:
             return SqlSession(self.catalog, namespace).execute(query)
@@ -300,13 +362,17 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             schema=self._PK_SCHEMA,
         )
 
-    # SqlInfo ids from the public spec (FLIGHT_SQL_SERVER_* block)
+    # SqlInfo ids from the public spec (FLIGHT_SQL_SERVER_* block).  Python
+    # ints ride the bigint branch of the union: id 8 is the int32
+    # SqlSupportedTransaction ENUM per spec, not a bool — strict ADBC/JDBC
+    # drivers read the union child by declared type
     _SQL_INFO = {
         0: "lakesoul_tpu",      # FLIGHT_SQL_SERVER_NAME
-        1: "4.0",               # FLIGHT_SQL_SERVER_VERSION
+        1: "5.0",               # FLIGHT_SQL_SERVER_VERSION
         2: pa.__version__,      # FLIGHT_SQL_SERVER_ARROW_VERSION
         3: False,               # FLIGHT_SQL_SERVER_READ_ONLY
-        8: False,               # FLIGHT_SQL_SERVER_TRANSACTION (none)
+        8: 1,                   # FLIGHT_SQL_SERVER_TRANSACTION
+                                #   = SQL_SUPPORTED_TRANSACTION_TRANSACTION
     }
 
     def _get_sql_info(self, msg) -> pa.Table:
@@ -315,13 +381,17 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         # spec value type: dense_union<string_value: utf8=0, bool_value: bool=1,
         # bigint_value: int64=2, int32_bitmask: int32=3, string_list:
         # list<utf8>=4, int32_to_int32_list_map: map<int32, list<int32>>=5>
-        strings, bools = [], []
+        strings, bools, bigints = [], [], []
         type_ids, offsets = [], []
         for _, v in items:
             if isinstance(v, bool):
                 type_ids.append(1)
                 offsets.append(len(bools))
                 bools.append(v)
+            elif isinstance(v, int):
+                type_ids.append(2)
+                offsets.append(len(bigints))
+                bigints.append(v)
             else:
                 type_ids.append(0)
                 offsets.append(len(strings))
@@ -329,7 +399,7 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         children = [
             pa.array(strings, pa.utf8()),
             pa.array(bools, pa.bool_()),
-            pa.array([], pa.int64()),
+            pa.array(bigints, pa.int64()),
             pa.array([], pa.int32()),
             pa.array([], pa.list_(pa.utf8())),
             pa.array([], pa.map_(pa.int32(), pa.list_(pa.int32()))),
@@ -445,11 +515,11 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             return
         if name == "CommandPreparedStatementQuery":
             ps = self._get_prepared(msg.prepared_statement_handle)
-            ps.params = self._read_param_rows(reader)
+            ps.params = self._check_param_arity(ps, self._read_param_rows(reader))
             return
         if name == "CommandPreparedStatementUpdate":
             ps = self._get_prepared(msg.prepared_statement_handle)
-            rows = self._read_param_rows(reader)
+            rows = self._check_param_arity(ps, self._read_param_rows(reader))
             total = 0
             if rows:
                 for values in rows:
@@ -473,6 +543,19 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
                 pb.DoPutUpdateResult(record_count=record_count).SerializeToString()
             )
         )
+
+    @staticmethod
+    def _check_param_arity(ps: _PreparedStatement, rows: list[list]) -> list[list]:
+        """Reject a parameter bind whose width differs from the statement's
+        placeholder count AT BIND TIME (the spec error point), instead of
+        surfacing a confusing failure at execution."""
+        for values in rows:
+            if len(values) != ps.param_count:
+                raise flight.FlightServerError(
+                    f"statement has {ps.param_count} parameter(s) but"
+                    f" {len(values)} were bound"
+                )
+        return rows
 
     @staticmethod
     def _read_param_rows(reader) -> list[list]:
@@ -501,6 +584,7 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         ns = msg.schema or "default"
         name = msg.table
         exists = name in self.catalog.list_tables(ns)
+        replace = False
         if not exists:
             if opts.if_not_exist == pb.CommandStatementIngest.TableDefinitionOptions.TABLE_NOT_EXIST_OPTION_FAIL:
                 raise flight.FlightServerError(f"table {ns}.{name} does not exist")
@@ -512,22 +596,15 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             self._check(context, ns, name)
             if opts.if_exists == pb.CommandStatementIngest.TableDefinitionOptions.TABLE_EXISTS_OPTION_FAIL:
                 raise flight.FlightServerError(f"table {ns}.{name} already exists")
-            if opts.if_exists == pb.CommandStatementIngest.TableDefinitionOptions.TABLE_EXISTS_OPTION_REPLACE:
-                # REPLACE keeps the table's STRUCTURE (primary keys, range
-                # partitions, bucket count, CDC column live in properties) —
-                # only the data is replaced; dropping them would silently
-                # turn a merge-on-read table into a plain append table
-                old = self.catalog.table(name, ns)
-                schema, info = old.schema, old.info
-                self.catalog.drop_table(name, ns)
-                self.catalog.create_table(
-                    name,
-                    schema,
-                    namespace=ns,
-                    primary_keys=info.primary_keys or None,
-                    range_partitions=info.range_partition_columns or None,
-                    properties=dict(info.properties),
-                )
+            # REPLACE keeps the table itself (same table_id, so primary
+            # keys, range partitions, bucket count, CDC column and the
+            # exactly-once replay dedup all survive): the stream is staged
+            # as files first, then ONE UPDATE commit swaps the content in —
+            # a disconnect mid-stream leaves the old data fully visible
+            replace = (
+                opts.if_exists
+                == pb.CommandStatementIngest.TableDefinitionOptions.TABLE_EXISTS_OPTION_REPLACE
+            )
         table = self.catalog.table(name, ns)
         from lakesoul_tpu.streaming import CheckpointedWriter
 
@@ -536,17 +613,23 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         nbytes = 0
         self.metrics.add(active_put_streams=1, total_put_streams=1)
         try:
-            for chunk in reader:
-                batch = chunk.data
-                if batch is not None and len(batch):
-                    rows += len(batch)
-                    nbytes += batch.nbytes
-                    w.write(pa.table(batch))
-            if msg.transaction_id:
-                # exactly-once: replaying the same transaction id is a no-op
-                w.checkpoint(msg.transaction_id.hex())
+            try:
+                for chunk in reader:
+                    batch = chunk.data
+                    if batch is not None and len(batch):
+                        rows += len(batch)
+                        nbytes += batch.nbytes
+                        w.write(pa.table(batch))
+            except Exception:
+                # incomplete stream: drop staged files, commit nothing
+                w.abort()
+                raise
+            # exactly-once: replaying the same transaction id is a no-op
+            txn = msg.transaction_id.hex() if msg.transaction_id else uuid.uuid4().hex
+            if replace:
+                w.checkpoint_replace(txn)
             else:
-                w.checkpoint(uuid.uuid4().hex)
+                w.checkpoint(txn)
             self.metrics.add(rows_in=rows, bytes_in=nbytes)
         except LakeSoulError as e:
             raise flight.FlightServerError(str(e))

@@ -1374,9 +1374,23 @@ class SqlSession:
             sub = self._query(sel)
             if sub.num_columns != 1:
                 raise SqlError("IN (SELECT ...) must produce one column")
-            mask = pc.is_in(
-                table.column(node.col), value_set=sub.column(0).combine_chunks()
+            values = sub.column(0).combine_chunks()
+            col = table.column(node.col)
+            mask = pc.fill_null(
+                pc.is_in(col, value_set=values, skip_nulls=True), False
             )
+            # SQL three-valued logic: an UNMATCHED probe is UNKNOWN (null),
+            # not FALSE, when the probe is NULL or the set contains NULLs —
+            # so `x NOT IN (... NULL ...)` filters the row instead of
+            # keeping it (Kleene invert maps null → null)
+            if len(values) and (col.null_count or values.null_count):
+                unknown = pc.and_(
+                    pc.invert(mask),
+                    pc.or_(
+                        pc.is_null(col), pa.scalar(bool(values.null_count))
+                    ),
+                )
+                mask = pc.if_else(unknown, pa.scalar(None, pa.bool_()), mask)
             return pc.invert(mask) if node.negated else mask
         # correlated IN: col IN (SELECT c …) ≡ EXISTS(… AND c = col)
         if isinstance(sel, ast.SetOp) or sel.star or len(sel.items) != 1 \
@@ -1393,9 +1407,42 @@ class SqlSession:
         mask = self._semi_join_mask(
             table, inner, eq_pairs + [(node.col, inner_item)], mixed, resolve
         )
+        # three-valued logic: unmatched is UNKNOWN (not FALSE) when the outer
+        # value is NULL and the correlated group is non-empty, or the group
+        # itself contains a NULL — `NOT IN` must filter such rows.  Joins
+        # never match NULL keys, so `mask` alone would claim definite FALSE.
+        outer_col = table.column(node.col)
+        inner_vals = inner.column(inner_item)
+        if outer_col.null_count or inner_vals.null_count:
+            def _group_mask(group: pa.Table):
+                if eq_pairs or mixed:
+                    return self._semi_join_mask(
+                        table, group, eq_pairs, mixed, resolve
+                    )
+                return pa.array([len(group) > 0] * len(table))
+
+            unknown = None
+            if inner_vals.null_count:
+                unknown = _group_mask(inner.filter(pc.is_null(inner_vals)))
+            if outer_col.null_count:
+                probe_null = pc.and_(
+                    pc.is_null(outer_col), _group_mask(inner)
+                )
+                unknown = probe_null if unknown is None \
+                    else pc.or_(unknown, probe_null)
+            unknown = pc.and_(
+                pc.fill_null(_broadcast(unknown, len(table)), False),
+                pc.invert(pc.fill_null(_broadcast(mask, len(table)), False)),
+            )
+            mask = pc.if_else(
+                unknown, pa.scalar(None, pa.bool_()),
+                _broadcast(mask, len(table)),
+            )
         for c in outer_only:
+            # the outer-only predicate gates the whole subquery: where it is
+            # FALSE or UNKNOWN the group is empty → IN is definite FALSE
             mask = pc.and_kleene(
-                pc.fill_null(mask, False),
+                _broadcast(mask, len(table)),
                 pc.fill_null(_broadcast(self._eval_bool(c, table), len(table)), False),
             )
         return pc.invert(mask) if node.negated else mask

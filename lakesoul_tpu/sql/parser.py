@@ -1184,6 +1184,58 @@ def inline_ctes(node, ctes: dict, _seen: set | None = None) -> None:
                 inline_ctes(item, ctes, seen)
 
 
+def referenced_tables(stmt) -> set[str]:
+    """Every catalog table name a parsed statement reads or writes —
+    primary FROM tables, JOINed tables, and tables inside derived tables,
+    EXISTS / IN / scalar subqueries, set operations, INSERT ... SELECT,
+    EXPLAIN bodies, and maintenance CALLs, recursively.
+
+    This is the per-statement RBAC surface: a gateway must check ALL of
+    these, not just the primary FROM table, or ``SELECT ... FROM allowed
+    JOIN secret`` reads ``secret`` unchecked.  CREATE TABLE targets are
+    excluded (the table does not exist yet); CTE names never appear (they
+    are inlined into derived tables at parse time); derived tables carry
+    ``table == ""``."""
+    import dataclasses
+
+    out: set[str] = set()
+    seen: set[int] = set()
+
+    def walk(node) -> None:
+        if node is None or isinstance(node, (str, bytes, int, float, bool)):
+            return
+        if isinstance(node, (list, tuple, set, frozenset)):
+            for item in node:
+                walk(item)
+            return
+        if isinstance(node, dict):
+            for item in node.values():
+                walk(item)
+            return
+        if not dataclasses.is_dataclass(node) or isinstance(node, Token):
+            return
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, CreateTable):
+            return
+        if isinstance(node, Call):
+            # compact/rollback/build_vector_index address a table by name in
+            # their first argument; clean is warehouse-wide
+            if node.procedure in ("compact", "rollback", "build_vector_index") \
+                    and node.args:
+                out.add(str(node.args[0]))
+            return
+        target = getattr(node, "table", None)
+        if isinstance(target, str) and target:
+            out.add(target)
+        for f in dataclasses.fields(node):
+            walk(getattr(node, f.name))
+
+    walk(stmt)
+    return out
+
+
 def parse(sql: str):
     return Parser(sql).parse()
 

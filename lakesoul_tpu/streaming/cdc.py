@@ -58,23 +58,27 @@ class CheckpointedWriter:
     def write(self, batch: pa.RecordBatch | pa.Table) -> None:
         self._ensure_writer().write_batch(batch)
 
-    def checkpoint(self, checkpoint_id: int | str) -> int:
-        """Flush staged data and commit with checkpoint-derived commit ids.
-        Returns the number of partitions committed (0 on replay/no data)."""
+    def _staged_files_by_partition(self) -> dict[str, list[DataFileOp]]:
+        """Flush and group this epoch's staged files per partition.
+        take_staged, not flush()'s return: write_batch may have auto-flushed
+        earlier files of this epoch on the row budget."""
         if self._writer is None:
-            return 0
+            return {}
         self._writer.flush()
-        # take_staged, not flush()'s return: write_batch may have auto-flushed
-        # earlier files of this epoch on the row budget
-        outputs = self._writer.take_staged()
-        if not outputs:
-            return 0
         files_by_partition: dict[str, list[DataFileOp]] = {}
-        for out in outputs:
+        for out in self._writer.take_staged():
             files_by_partition.setdefault(out.partition_desc, []).append(
                 DataFileOp(path=out.path, file_op="add", size=out.size,
                            file_exist_cols=out.file_exist_cols)
             )
+        return files_by_partition
+
+    def checkpoint(self, checkpoint_id: int | str) -> int:
+        """Flush staged data and commit with checkpoint-derived commit ids.
+        Returns the number of partitions committed (0 on replay/no data)."""
+        files_by_partition = self._staged_files_by_partition()
+        if not files_by_partition:
+            return 0
         commit_ids = {
             desc: checkpoint_commit_id(self.table.info.table_id, desc, checkpoint_id)
             for desc in files_by_partition
@@ -87,6 +91,71 @@ class CheckpointedWriter:
             storage_options=self.table.io_config().object_store_options,
         )
         return len(committed)
+
+    def checkpoint_replace(self, checkpoint_id: int | str) -> int:
+        """REPLACE-mode checkpoint: swap the table's ENTIRE content for this
+        epoch's staged files without ever dropping the table.
+
+        Partitions that received data get an UPDATE commit (whole-snapshot
+        replace with read-version conflict detection); pre-existing
+        partitions that did not are emptied with a DELETE commit.  The
+        table_id never changes and every commit id derives from the
+        checkpoint id, so replaying the same id after a success is an
+        idempotent no-op (re-staged duplicate files are dropped as replay
+        orphans) — unlike a drop+recreate, a client disconnect mid-stream
+        leaves the old data fully intact, and a crash between the two commit
+        waves is healed by the replay.  Returns partitions committed."""
+        files_by_partition = self._staged_files_by_partition()
+        from lakesoul_tpu.errors import CommitConflictError
+
+        client = self.table.catalog.client
+        info = self.table.info
+        opts = self.table.io_config().object_store_options
+        last_conflict: Exception | None = None
+        for _ in range(5):
+            heads = {
+                h.partition_desc: h
+                for h in client._select_partitions(info, None)
+            }
+            try:
+                committed = 0
+                if files_by_partition:
+                    committed += len(client.commit_data_files(
+                        info,
+                        files_by_partition,
+                        CommitOp.UPDATE,
+                        commit_id_by_partition={
+                            desc: checkpoint_commit_id(info.table_id, desc, checkpoint_id)
+                            for desc in files_by_partition
+                        },
+                        read_partition_info=[
+                            heads[d] for d in files_by_partition if d in heads
+                        ],
+                        storage_options=opts,
+                    ))
+                stale = [
+                    d for d, h in heads.items()
+                    if d not in files_by_partition and h.snapshot
+                ]
+                if stale:
+                    committed += len(client.commit_data_files(
+                        info,
+                        {d: [] for d in stale},
+                        CommitOp.DELETE,
+                        commit_id_by_partition={
+                            d: checkpoint_commit_id(
+                                info.table_id, d, f"{checkpoint_id}:truncate"
+                            )
+                            for d in stale
+                        },
+                        storage_options=opts,
+                    ))
+                return committed
+            except CommitConflictError as e:
+                # a concurrent writer advanced a partition between our head
+                # read and the commit — re-read and re-apply the replace
+                last_conflict = e
+        raise last_conflict  # type: ignore[misc]
 
     def adopt_staged(self, other: "CheckpointedWriter | None") -> None:
         """Take over another checkpointed writer's staged-but-uncommitted

@@ -1,0 +1,353 @@
+"""Regression pins for the round-4 advisor findings (ADVICE.md r4).
+
+1. high — gateway RBAC must cover EVERY table a statement references
+   (joins, derived tables, EXISTS/IN/scalar subqueries), not just the
+   primary FROM table.
+2. medium — CommandStatementIngest REPLACE must be atomic: a failed stream
+   leaves the old data intact, the table_id never changes, and replaying a
+   transaction id after success is a no-op.
+3. medium — correlated (and uncorrelated) NOT IN follows SQL three-valued
+   logic: NULL probes and NULL-bearing subquery results yield UNKNOWN
+   (row filtered), not TRUE.
+4. low — prepared-statement parameters: floats render as plain decimals the
+   tokenizer can parse, bytes are rejected, arity mismatches fail at bind.
+5. low — CommandGetSqlInfo id 8 (FLIGHT_SQL_SERVER_TRANSACTION) rides the
+   bigint branch of the union as the int SqlSupportedTransaction enum.
+"""
+
+import types
+import uuid
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as flight
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.service import _flight_sql_pb2 as pb
+from lakesoul_tpu.service.flight_sql import (
+    FlightSqlClient,
+    LakeSoulFlightSqlServer,
+    bind_parameters,
+)
+from lakesoul_tpu.service.jwt import Claims
+from lakesoul_tpu.sql import SqlSession
+from lakesoul_tpu.sql.parser import parse, referenced_tables
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+# --------------------------------------------------------------------- 1
+class TestReferencedTables:
+    def test_join_and_subqueries_collected(self):
+        stmt = parse(
+            "SELECT a.id FROM a JOIN b ON a.id = b.id WHERE EXISTS"
+            " (SELECT * FROM c WHERE c.id = a.id)"
+            " AND a.id IN (SELECT id FROM d)"
+        )
+        assert referenced_tables(stmt) == {"a", "b", "c", "d"}
+
+    def test_derived_table(self):
+        stmt = parse("SELECT * FROM (SELECT id FROM secret) x")
+        assert referenced_tables(stmt) == {"secret"}
+
+    def test_insert_select_and_setop(self):
+        stmt = parse("INSERT INTO t SELECT id FROM u")
+        assert referenced_tables(stmt) == {"t", "u"}
+        stmt = parse("SELECT id FROM a UNION SELECT id FROM b")
+        assert referenced_tables(stmt) == {"a", "b"}
+
+    def test_create_table_target_excluded(self):
+        stmt = parse("CREATE TABLE fresh (id bigint PRIMARY KEY)")
+        assert referenced_tables(stmt) == set()
+
+    def test_call_addresses_table(self):
+        stmt = parse("CALL compact('t1')")
+        assert referenced_tables(stmt) == {"t1"}
+
+    def test_explain_recurses(self):
+        stmt = parse("EXPLAIN SELECT a.id FROM a JOIN b ON a.id = b.id")
+        assert referenced_tables(stmt) == {"a", "b"}
+
+
+@pytest.fixture()
+def rbac_server(tmp_warehouse):
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    t = catalog.create_table("pub", SCHEMA, primary_keys=["id"])
+    t.write_arrow(pa.table({"id": np.arange(5), "v": np.zeros(5)}))
+    info = catalog.client.create_table(
+        "secret", f"{tmp_warehouse}/secret", SCHEMA, domain="team1"
+    )
+    del info
+    srv = LakeSoulFlightSqlServer(catalog, "grpc://127.0.0.1:0", jwt_secret="k")
+    token = srv.jwt_server.create_token(Claims(sub="eve", group="public"))
+    client = FlightSqlClient(f"grpc://127.0.0.1:{srv.port}", token=token)
+    yield srv, client
+    client.close()
+    srv.shutdown()
+
+
+class TestRbacCoversAllTables:
+    def test_primary_from_still_checked(self, rbac_server):
+        _, client = rbac_server
+        with pytest.raises(flight.FlightError, match="no access"):
+            client.execute("SELECT * FROM secret")
+
+    def test_join_checked(self, rbac_server):
+        _, client = rbac_server
+        with pytest.raises(flight.FlightError, match="no access"):
+            client.execute(
+                "SELECT pub.id FROM pub JOIN secret ON pub.id = secret.id"
+            )
+
+    def test_derived_table_checked(self, rbac_server):
+        _, client = rbac_server
+        with pytest.raises(flight.FlightError, match="no access"):
+            client.execute("SELECT * FROM (SELECT id FROM secret) x")
+
+    def test_subquery_checked(self, rbac_server):
+        _, client = rbac_server
+        with pytest.raises(flight.FlightError, match="no access"):
+            client.execute(
+                "SELECT id FROM pub WHERE id IN (SELECT id FROM secret)"
+            )
+        with pytest.raises(flight.FlightError, match="no access"):
+            client.execute(
+                "SELECT id FROM pub p WHERE EXISTS"
+                " (SELECT * FROM secret WHERE secret.id = p.id)"
+            )
+
+    def test_allowed_tables_still_work(self, rbac_server):
+        _, client = rbac_server
+        out = client.execute(
+            "SELECT count(*) AS c FROM pub WHERE id IN (SELECT id FROM pub)"
+        )
+        assert out.column("c").to_pylist() == [5]
+
+    def test_json_sql_action_checked(self, rbac_server):
+        srv, _ = rbac_server
+        import json
+
+        raw = flight.FlightClient(f"grpc://127.0.0.1:{srv.port}")
+        token = srv.jwt_server.create_token(Claims(sub="eve", group="public"))
+        opts = flight.FlightCallOptions(
+            headers=[(b"authorization", f"Bearer {token}".encode())]
+        )
+        body = json.dumps({
+            "statement": "SELECT pub.id FROM pub JOIN secret ON pub.id = secret.id"
+        }).encode()
+        with pytest.raises(flight.FlightError, match="no access"):
+            list(raw.do_action(flight.Action("sql", body), options=opts))
+        raw.close()
+
+
+# --------------------------------------------------------------------- 2
+@pytest.fixture()
+def server(tmp_warehouse):
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    t = catalog.create_table("orders", SCHEMA, primary_keys=["id"])
+    t.write_arrow(pa.table({"id": np.arange(10), "v": np.arange(10) * 1.0}))
+    srv = LakeSoulFlightSqlServer(catalog, "grpc://127.0.0.1:0")
+    yield srv, catalog
+    srv.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    srv, _ = server
+    c = FlightSqlClient(f"grpc://127.0.0.1:{srv.port}")
+    yield c
+    c.close()
+
+
+class _BoomReader:
+    """Flight reader stub whose stream dies mid-way (client disconnect)."""
+
+    def __init__(self, schema: pa.Schema, batches: list[pa.RecordBatch]):
+        self.schema = schema
+        self._batches = batches
+
+    def __iter__(self):
+        for b in self._batches:
+            yield types.SimpleNamespace(data=b)
+        raise flight.FlightError("stream interrupted")
+
+
+class _AnonContext:
+    @staticmethod
+    def get_middleware(name):
+        return None
+
+
+def _replace_msg(table: str) -> pb.CommandStatementIngest:
+    tdo = pb.CommandStatementIngest.TableDefinitionOptions(
+        if_not_exist=pb.CommandStatementIngest.TableDefinitionOptions.TABLE_NOT_EXIST_OPTION_CREATE,
+        if_exists=pb.CommandStatementIngest.TableDefinitionOptions.TABLE_EXISTS_OPTION_REPLACE,
+    )
+    return pb.CommandStatementIngest(
+        table_definition_options=tdo, table=table, schema="default"
+    )
+
+
+class TestReplaceAtomicity:
+    def test_failed_stream_leaves_old_data(self, server):
+        srv, catalog = server
+        batch = pa.record_batch({"id": np.arange(3), "v": np.zeros(3)})
+        reader = _BoomReader(SCHEMA, [batch])
+        with pytest.raises(flight.FlightError, match="interrupted"):
+            srv._ingest(_AnonContext(), _replace_msg("orders"), reader)
+        out = catalog.table("orders").scan().to_arrow()
+        assert out.num_rows == 10  # the pre-replace content, fully intact
+        assert sorted(out.column("id").to_pylist()) == list(range(10))
+
+    def test_replace_keeps_table_id(self, server, client):
+        _, catalog = server
+        before = catalog.table("orders").info.table_id
+        client.ingest(
+            "orders", pa.table({"id": np.arange(3), "v": np.ones(3)}),
+            mode="replace",
+        )
+        after = catalog.table("orders").info.table_id
+        assert before == after
+        out = client.execute("SELECT count(*) AS c FROM orders")
+        assert out.column("c").to_pylist() == [3]
+
+    def test_replace_replay_is_noop(self, server, client):
+        _, catalog = server
+        data = pa.table({"id": np.arange(4), "v": np.full(4, 7.0)})
+        txn = b"replace-job:epoch-1"
+        assert client.ingest("orders", data, mode="replace",
+                             transaction_id=txn) == 4
+        # replay after success: must neither destroy nor duplicate
+        client.ingest("orders", data, mode="replace", transaction_id=txn)
+        out = client.execute("SELECT count(*) AS c, sum(v) AS s FROM orders")
+        assert out.column("c").to_pylist() == [4]
+        assert out.column("s").to_pylist() == [28.0]
+
+    def test_replace_empties_untouched_partitions(self, tmp_warehouse):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        schema = pa.schema([("p", pa.utf8()), ("id", pa.int64())])
+        t = catalog.create_table("parts", schema, range_partitions=["p"])
+        t.write_arrow(pa.table({"p": ["a", "a", "b"], "id": [1, 2, 3]}))
+        from lakesoul_tpu.streaming import CheckpointedWriter
+
+        w = CheckpointedWriter(t)
+        w.write(pa.table({"p": ["a"], "id": [9]}))
+        w.checkpoint_replace("epoch-1")
+        out = catalog.table("parts").scan().to_arrow()
+        assert out.column("p").to_pylist() == ["a"]
+        assert out.column("id").to_pylist() == [9]  # b was emptied, a swapped
+
+
+# --------------------------------------------------------------------- 3
+@pytest.fixture()
+def null_session(tmp_warehouse):
+    cat = LakeSoulCatalog(str(tmp_warehouse))
+    s = SqlSession(cat)
+    s.execute("CREATE TABLE o (k bigint, x bigint)")
+    s.execute("CREATE TABLE t (k bigint, c bigint)")
+    s.execute("INSERT INTO o VALUES (1, 10), (1, NULL), (2, 20), (3, 30)")
+    # group k=1 contains a NULL; k=2 matches 20; k=3 has no group rows
+    s.execute("INSERT INTO t VALUES (1, 11), (1, NULL), (2, 20), (2, 21)")
+    return s
+
+
+class TestNotInThreeValuedLogic:
+    def test_uncorrelated_not_in_with_null_in_set(self, null_session):
+        # set contains NULL → every non-matching row is UNKNOWN → filtered;
+        # matching rows are FALSE → filtered.  Result: no rows.
+        out = null_session.execute(
+            "SELECT x FROM o WHERE x NOT IN (SELECT c FROM t)"
+        )
+        assert out.num_rows == 0
+
+    def test_uncorrelated_not_in_null_probe(self, null_session):
+        # NULL probe vs a non-empty NULL-free set → UNKNOWN → filtered
+        out = null_session.execute(
+            "SELECT x FROM o WHERE x NOT IN (SELECT c FROM t WHERE c IS NOT NULL)"
+        )
+        assert sorted(out.column("x").to_pylist()) == [10, 30]
+
+    def test_uncorrelated_in_unaffected(self, null_session):
+        out = null_session.execute(
+            "SELECT x FROM o WHERE x IN (SELECT c FROM t)"
+        )
+        assert out.column("x").to_pylist() == [20]
+
+    def test_correlated_not_in_group_with_null(self, null_session):
+        # k=1 rows: group {11, NULL} → both o-rows UNKNOWN (10 unmatched vs
+        # NULL-bearing group; NULL probe) → filtered.
+        # k=2 row: x=20 matches → FALSE → filtered.
+        # k=3 row: empty group → TRUE → kept.
+        out = null_session.execute(
+            "SELECT x FROM o WHERE x NOT IN (SELECT c FROM t WHERE t.k = o.k)"
+        )
+        assert out.column("x").to_pylist() == [30]
+
+    def test_correlated_not_in_null_probe_empty_group_kept(self, null_session):
+        # NULL probe with an EMPTY group is still TRUE (NOT IN over the
+        # empty set), so only group-bearing NULL probes are filtered:
+        # (1,10) vs {11} → TRUE; (1,NULL) vs {11} → UNKNOWN; (2,20) vs
+        # {20,21} → FALSE; (3,30) and (9,NULL) have empty groups → TRUE
+        null_session.execute("INSERT INTO o VALUES (9, NULL)")
+        out = null_session.execute(
+            "SELECT k FROM o WHERE x NOT IN"
+            " (SELECT c FROM t WHERE t.k = o.k AND c IS NOT NULL)"
+        )
+        assert sorted(out.column("k").to_pylist()) == [1, 3, 9]
+
+    def test_correlated_not_in_without_nulls_unchanged(self, null_session):
+        null_session.execute("DELETE FROM t WHERE c IS NULL")
+        null_session.execute("DELETE FROM o WHERE x IS NULL")
+        out = null_session.execute(
+            "SELECT x FROM o WHERE x NOT IN (SELECT c FROM t WHERE t.k = o.k)"
+        )
+        assert sorted(out.column("x").to_pylist()) == [10, 30]
+
+
+# --------------------------------------------------------------------- 4
+class TestParameterRendering:
+    def test_float_exponent_renders_decimal(self, client):
+        client.execute_update("INSERT INTO orders VALUES (100, 0.0000001)")
+        handle = client.prepare("SELECT id FROM orders WHERE v = ?")
+        out = client.execute_prepared(handle, params=[1e-07])
+        assert out.column("id").to_pylist() == [100]
+        client.close_prepared(handle)
+
+    def test_float_round_trip_exact(self):
+        lit = bind_parameters("SELECT ?", None, [1e-07]).split()[-1]
+        assert "e" not in lit.lower()
+        assert float(lit) == 1e-07
+
+    def test_bytes_rejected(self):
+        with pytest.raises(flight.FlightError, match="binary parameters"):
+            bind_parameters("SELECT * FROM t WHERE b = ?", None, [b"ab"])
+
+    def test_nonfinite_float_rejected(self):
+        with pytest.raises(flight.FlightError, match="non-finite"):
+            bind_parameters("SELECT ?", None, [float("inf")])
+
+    def test_arity_mismatch_rejected(self):
+        with pytest.raises(flight.FlightError, match="2 parameter"):
+            bind_parameters("SELECT * FROM t WHERE a = ? AND b = ?", None, [1])
+        with pytest.raises(flight.FlightError, match="1 parameter"):
+            bind_parameters("SELECT * FROM t WHERE a = ?", None, [1, 2])
+
+    def test_bind_time_arity_error(self, client):
+        handle = client.prepare("SELECT v FROM orders WHERE id = ?")
+        with pytest.raises(flight.FlightError, match="1 parameter"):
+            client.execute_prepared(handle, params=[1, 2])
+        client.close_prepared(handle)
+
+
+# --------------------------------------------------------------------- 5
+class TestSqlInfoTransactionEnum:
+    def test_id8_is_bigint_enum(self, client):
+        info = client.get_sql_info(ids=[8])
+        assert info.column("info_name").to_pylist() == [8]
+        value = info.column("value")[0]
+        assert value.as_py() == 1  # SQL_SUPPORTED_TRANSACTION_TRANSACTION
+        # strict drivers read the union child by declared type: must be the
+        # bigint branch, not bool
+        chunk = info.column("value").chunk(0)
+        assert chunk.type_codes.to_pylist() == [2]

@@ -270,5 +270,5 @@ class TestBindParameters:
         assert q == "SELECT * FROM t WHERE a = 1 AND b = 'x?y' AND c = 'it''s'"
 
     def test_too_few_params(self):
-        with pytest.raises(flight.FlightError, match="not enough"):
+        with pytest.raises(flight.FlightError, match="1 parameter"):
             bind_parameters("SELECT ?", None, [])
