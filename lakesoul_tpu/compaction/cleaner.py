@@ -21,10 +21,17 @@ logger = logging.getLogger(__name__)
 
 class Cleaner:
     def __init__(self, catalog, *, retention_ms: int = 7 * 24 * 3600 * 1000,
-                 discard_grace_ms: int = 3600 * 1000):
+                 discard_grace_ms: int = 3600 * 1000, deleter=None):
+        """``deleter`` routes object deletes somewhere other than the store
+        directly — pass ``ProxyDeleter`` (service/storage_proxy.py) to push
+        the cleaner's destructive traffic through the RBAC-enforcing proxy
+        (the reference proxies every verb, s3-proxy/src/main.rs:350); the
+        default talks to the object store like the reference's Spark
+        cleaner does."""
         self.catalog = catalog
         self.retention_ms = retention_ms
         self.discard_grace_ms = discard_grace_ms
+        self._delete = deleter or delete_file
 
     def _version_retention_for(self, info) -> int:
         """``lakesoul.version.retention`` (days) beats the cleaner default;
@@ -73,7 +80,7 @@ class Cleaner:
                 CommitOp.DELETE,
             )
             for f in live:
-                delete_file(f.path, self.catalog.storage_options, missing_ok=True)
+                self._delete(f.path, self.catalog.storage_options, missing_ok=True)
             logger.info(
                 "expired partition %s of %s (%d files)",
                 head.partition_desc, table_name, len(live),
@@ -121,7 +128,7 @@ class Cleaner:
                     continue
                 for commit in commits:
                     for op in commit.file_ops:
-                        delete_file(op.path, self.catalog.storage_options, missing_ok=True)
+                        self._delete(op.path, self.catalog.storage_options, missing_ok=True)
                         files_deleted += 1
                 store.delete_data_commit_info(info.table_id, head.partition_desc, [cid])
         return {"versions_dropped": versions_dropped, "files_deleted": files_deleted}
@@ -134,7 +141,7 @@ class Cleaner:
         rows = store.list_discard_files(older_than_ms=now_ms - self.discard_grace_ms)
         deleted = []
         for file_path, _table_path, _desc in rows:
-            delete_file(file_path, self.catalog.storage_options, missing_ok=True)
+            self._delete(file_path, self.catalog.storage_options, missing_ok=True)
             deleted.append(file_path)
         store.delete_discard_files(deleted)
         return len(deleted)

@@ -158,11 +158,23 @@ class AzureUpstream:
         body_iter=None,
         content_length: int | None = None,
         range_header: str | None = None,
+        query: str = "",
         retries: int = 1,
     ):
         """One signed request → (status, headers dict, response object);
         contract identical to S3Upstream.request (streaming responses,
-        non-replayable streamed uploads don't retry)."""
+        non-replayable streamed uploads don't retry).
+
+        ``query`` carries S3-dialect parameters (list-type / uploads /
+        partNumber); the reference's azure.rs translates those into
+        Blob/Block API calls — this upstream does not (documented scope
+        trade, PARITY.md), so a non-empty query is rejected explicitly
+        rather than sent to Azure as a nonsense blob path."""
+        if query:
+            raise NotImplementedError(
+                "S3-dialect query operations (list/multipart) are not"
+                " translated for the Azure upstream; see PARITY.md"
+            )
         cfg = self.config
         path = encode_blob_path(f"/{cfg.container}/{key.lstrip('/')}")
         if body_iter is not None and content_length is None:

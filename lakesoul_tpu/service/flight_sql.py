@@ -22,9 +22,20 @@ The JSON-ticket dialect of ``LakeSoulFlightServer`` remains the internal fast
 path — any ticket/descriptor that doesn't parse as an Any-wrapped Flight SQL
 message falls back to it.  Auth is unchanged (Basic/Bearer headers through the
 shared middleware; ``authenticate_basic_token`` handshakes get the minted
-bearer back in the response headers).  Transactions are autocommit: explicit
-``transaction_id``s are accepted for idempotent ingest but Begin/End actions
-are not offered, matching the commit protocol's per-statement atomicity.
+bearer back in the response headers).
+
+Transactions (reference: do_action_begin_transaction / end_transaction,
+flight_sql_service.rs:1044-1082): ``BeginTransaction`` mints a server
+transaction id; ingest streams carrying that id are STAGED (files written,
+nothing committed); ``EndTransaction`` COMMIT publishes every staged table
+through the exactly-once checkpoint path (commit ids derive from the
+transaction id) and ROLLBACK deletes the staged files.  This is what ADBC
+drivers with ``autocommit=False`` issue at connect time.  Like the
+reference, only ingest participates: DML/queries inside an open transaction
+execute per-statement (each is individually atomic through the commit
+protocol).  An explicit ``transaction_id`` that was NOT minted by
+BeginTransaction keeps its pre-existing meaning — per-statement ingest with
+idempotent-replay dedup.
 """
 
 from __future__ import annotations
@@ -177,6 +188,28 @@ def bind_parameters(query: str, row: dict | None, values: list) -> str:
 _PREPARED_TTL_S = 3600.0
 _PREPARED_CAP = 256
 
+_TXN_TTL_S = 3600.0
+_TXN_CAP = 64
+
+
+class _Transaction:
+    """Server-side transaction: per-table staged writers, published (or
+    aborted) as one unit at EndTransaction."""
+
+    __slots__ = ("writers", "replace", "failed", "expires", "lock")
+
+    def __init__(self):
+        self.writers: dict[tuple[str, str], object] = {}  # (ns, table) → CheckpointedWriter
+        self.replace: set[tuple[str, str]] = set()
+        self.failed = False  # a stream died mid-way: COMMIT must refuse
+        self.expires = time.monotonic() + _TXN_TTL_S
+        self.lock = threading.Lock()
+
+    def abort(self) -> None:
+        for w in self.writers.values():
+            w.abort()
+        self.writers.clear()
+
 
 class _PreparedStatement:
     __slots__ = ("query", "dataset_schema", "params", "expires", "param_count")
@@ -201,6 +234,115 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         self._stmt_lock = threading.Lock()
         self._stmt_results: dict[bytes, tuple[float, pa.Table]] = {}
         self._prepared: dict[bytes, _PreparedStatement] = {}
+        self._transactions: dict[bytes, _Transaction] = {}
+        # ids of ended/expired transactions: an ingest replaying one must be
+        # REJECTED, not silently fall through to the autocommit path
+        self._closed_txns: "dict[bytes, None]" = {}
+
+    # --------------------------------------------------------- transactions
+    def _pop_expired_locked(self) -> list[_Transaction]:
+        """Remove TTL-expired transactions from the registry (caller holds
+        ``_stmt_lock``) and return them — the caller aborts them AFTER
+        releasing the lock, since abort takes each transaction's own lock
+        and may wait for an in-flight stream."""
+        now = time.monotonic()
+        dead = [t for t, txn in self._transactions.items() if txn.expires < now]
+        out = []
+        for t in dead:
+            self._mark_closed_locked(t)
+            out.append(self._transactions.pop(t))
+        return out
+
+    def _mark_closed_locked(self, txn_id: bytes) -> None:
+        while len(self._closed_txns) >= 1024:
+            self._closed_txns.pop(next(iter(self._closed_txns)))
+        self._closed_txns[txn_id] = None
+
+    @staticmethod
+    def _abort_all(expired: list[_Transaction]) -> None:
+        for txn in expired:
+            # expired staged files would orphan on the store forever; the
+            # txn lock serializes with any stream still writing
+            with txn.lock:
+                txn.abort()
+
+    def _begin_transaction(self) -> list:
+        txn_id = uuid.uuid4().bytes
+        with self._stmt_lock:
+            expired = self._pop_expired_locked()
+            if len(self._transactions) >= _TXN_CAP:
+                self._abort_all(expired)
+                raise flight.FlightServerError(
+                    f"too many open transactions ({_TXN_CAP}); commit or"
+                    " roll back existing ones"
+                )
+            self._transactions[txn_id] = _Transaction()
+        self._abort_all(expired)
+        return [
+            flight.Result(
+                _pack(pb.ActionBeginTransactionResult(transaction_id=txn_id))
+            )
+        ]
+
+    def _get_transaction(self, txn_id: bytes) -> _Transaction | None:
+        """The OPEN transaction for this id; None when the id was never
+        minted by BeginTransaction (→ per-statement idempotent-ingest path);
+        error when it WAS minted but has since ended or expired."""
+        with self._stmt_lock:
+            expired = self._pop_expired_locked()
+            txn = self._transactions.get(txn_id)
+            if txn is not None:
+                txn.expires = time.monotonic() + _TXN_TTL_S
+            closed = txn is None and txn_id in self._closed_txns
+        self._abort_all(expired)
+        if closed:
+            raise flight.FlightServerError(
+                "transaction has already ended or expired"
+            )
+        return txn
+
+    def _end_transaction(self, msg) -> list:
+        with self._stmt_lock:
+            txn = self._transactions.pop(msg.transaction_id, None)
+            if txn is not None:
+                self._mark_closed_locked(msg.transaction_id)
+        if txn is None:
+            raise flight.FlightServerError("unknown or expired transaction")
+        with txn.lock:
+            if msg.action == pb.ActionEndTransactionRequest.END_TRANSACTION_ROLLBACK:
+                txn.abort()
+                return []
+            if msg.action != pb.ActionEndTransactionRequest.END_TRANSACTION_COMMIT:
+                txn.abort()
+                raise flight.FlightServerError("invalid EndTransaction action")
+            if txn.failed:
+                txn.abort()
+                raise flight.FlightServerError(
+                    "transaction had a failed statement and cannot commit"
+                )
+            cid = msg.transaction_id.hex()
+            done: set = set()
+            try:
+                for key, w in txn.writers.items():
+                    if key in txn.replace:
+                        w.checkpoint_replace(cid)
+                    else:
+                        w.checkpoint(cid)
+                    done.add(key)
+            except LakeSoulError as e:
+                # per-table commits are individually atomic but there is no
+                # cross-table transaction log: abort the NOT-yet-committed
+                # writers (their staged files must not orphan) and report
+                # exactly what did land so the client can reconcile
+                for key, w in txn.writers.items():
+                    if key not in done:
+                        w.abort()
+                committed = ", ".join(f"{ns}.{t}" for ns, t in sorted(done)) or "none"
+                raise flight.FlightServerError(
+                    f"transaction commit failed on {e}; committed tables:"
+                    f" {committed}; remaining tables rolled back"
+                )
+        return []
 
     # ------------------------------------------------------------- sql exec
     def _execute_sql(self, context, query: str, namespace: str = "default") -> pa.Table:
@@ -608,6 +750,14 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         table = self.catalog.table(name, ns)
         from lakesoul_tpu.streaming import CheckpointedWriter
 
+        if msg.transaction_id:
+            txn = self._get_transaction(bytes(msg.transaction_id))
+            if txn is not None:
+                # open server transaction: stage only — EndTransaction
+                # COMMIT publishes, ROLLBACK deletes the staged files
+                return self._ingest_into_transaction(
+                    txn, (ns, name), table, reader, replace
+                )
         w = CheckpointedWriter(table)
         rows = 0
         nbytes = 0
@@ -637,8 +787,51 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             self.metrics.add(active_put_streams=-1)
         return rows
 
+    def _ingest_into_transaction(self, txn: _Transaction, key, table, reader,
+                                 replace: bool) -> int:
+        from lakesoul_tpu.streaming import CheckpointedWriter
+
+        rows = 0
+        nbytes = 0
+        self.metrics.add(active_put_streams=1, total_put_streams=1)
+        try:
+            # streams of one transaction serialize: they share its writers
+            with txn.lock:
+                w = txn.writers.get(key)
+                if w is None:
+                    w = txn.writers[key] = CheckpointedWriter(table)
+                if replace:
+                    txn.replace.add(key)
+                try:
+                    for chunk in reader:
+                        batch = chunk.data
+                        if batch is not None and len(batch):
+                            rows += len(batch)
+                            nbytes += batch.nbytes
+                            w.write(pa.table(batch))
+                except Exception:
+                    # half a stream is in the staged writer and cannot be
+                    # torn back out: poison the transaction so COMMIT refuses
+                    txn.failed = True
+                    raise
+            self.metrics.add(rows_in=rows, bytes_in=nbytes)
+        except LakeSoulError as e:
+            raise flight.FlightServerError(str(e))
+        finally:
+            self.metrics.add(active_put_streams=-1)
+        return rows
+
     # --------------------------------------------------------------- actions
     def do_action(self, context, action):
+        if action.type == "BeginTransaction":
+            return self._begin_transaction()
+        if action.type == "EndTransaction":
+            _, msg = _unpack(action.body.to_pybytes())
+            if msg is None:
+                raise flight.FlightServerError(
+                    "EndTransaction body must be an Any-wrapped request"
+                )
+            return self._end_transaction(msg)
         if action.type == "CreatePreparedStatement":
             _, msg = _unpack(action.body.to_pybytes())
             if msg is None:
@@ -690,6 +883,8 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         return list(super().list_actions(context)) + [
             ("CreatePreparedStatement", "Flight SQL: create a prepared statement"),
             ("ClosePreparedStatement", "Flight SQL: close a prepared statement"),
+            ("BeginTransaction", "Flight SQL: begin a server transaction"),
+            ("EndTransaction", "Flight SQL: commit or roll back a transaction"),
         ]
 
 
@@ -774,6 +969,35 @@ class FlightSqlClient:
         if buf is None:
             return 0
         return pb.DoPutUpdateResult.FromString(buf.to_pybytes()).record_count
+
+    # --------------------------------------------------------- transactions
+    def begin_transaction(self) -> bytes:
+        """What an ADBC driver sends on connect with ``autocommit=False``."""
+        action = flight.Action(
+            "BeginTransaction", _pack(pb.ActionBeginTransactionRequest())
+        )
+        results = list(self._client.do_action(action, options=self._options))
+        _, msg = _unpack(results[0].body.to_pybytes())
+        return msg.transaction_id
+
+    def _end_transaction(self, txn_id: bytes, end_action) -> None:
+        action = flight.Action(
+            "EndTransaction",
+            _pack(pb.ActionEndTransactionRequest(
+                transaction_id=txn_id, action=end_action
+            )),
+        )
+        list(self._client.do_action(action, options=self._options))
+
+    def commit(self, txn_id: bytes) -> None:
+        self._end_transaction(
+            txn_id, pb.ActionEndTransactionRequest.END_TRANSACTION_COMMIT
+        )
+
+    def rollback(self, txn_id: bytes) -> None:
+        self._end_transaction(
+            txn_id, pb.ActionEndTransactionRequest.END_TRANSACTION_ROLLBACK
+        )
 
     # ------------------------------------------------------------- prepared
     def prepare(self, query: str) -> bytes:

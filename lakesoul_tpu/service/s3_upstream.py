@@ -254,6 +254,7 @@ class S3Upstream:
         body_iter=None,
         content_length: int | None = None,
         range_header: str | None = None,
+        query: str = "",
         retries: int = 1,
     ):
         """One signed request → (status, headers dict, response object).
@@ -281,7 +282,7 @@ class S3Upstream:
             method,
             self.host_header,
             path,
-            "",
+            query,
             extra,
             payload_hash,
             access_key=cfg.access_key,
@@ -301,8 +302,10 @@ class S3Upstream:
             ip = self.discovery.pick()
             conn = self._connect(ip)
             try:
+                wire_path = f"{path}?{sigv4.canonical_query(query)}" if query else path
                 conn.request(
-                    method, path, body=body_iter if body_iter is not None else body,
+                    method, wire_path,
+                    body=body_iter if body_iter is not None else body,
                     headers=headers,
                 )
                 resp = conn.getresponse()
