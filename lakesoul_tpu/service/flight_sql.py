@@ -196,12 +196,15 @@ class _Transaction:
     """Server-side transaction: per-table staged writers, published (or
     aborted) as one unit at EndTransaction."""
 
-    __slots__ = ("writers", "replace", "failed", "expires", "lock")
+    __slots__ = ("writers", "replace", "failed", "closed", "expires", "lock")
 
     def __init__(self):
         self.writers: dict[tuple[str, str], object] = {}  # (ns, table) → CheckpointedWriter
         self.replace: set[tuple[str, str]] = set()
         self.failed = False  # a stream died mid-way: COMMIT must refuse
+        # set under `lock` by EndTransaction/eviction: an ingest that looked
+        # the txn up just before it ended must FAIL, not stage into a ghost
+        self.closed = False
         self.expires = time.monotonic() + _TXN_TTL_S
         self.lock = threading.Lock()
 
@@ -264,20 +267,24 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             # expired staged files would orphan on the store forever; the
             # txn lock serializes with any stream still writing
             with txn.lock:
+                txn.closed = True
                 txn.abort()
 
     def _begin_transaction(self) -> list:
         txn_id = uuid.uuid4().bytes
         with self._stmt_lock:
             expired = self._pop_expired_locked()
-            if len(self._transactions) >= _TXN_CAP:
-                self._abort_all(expired)
-                raise flight.FlightServerError(
-                    f"too many open transactions ({_TXN_CAP}); commit or"
-                    " roll back existing ones"
-                )
-            self._transactions[txn_id] = _Transaction()
+            full = len(self._transactions) >= _TXN_CAP
+            if not full:
+                self._transactions[txn_id] = _Transaction()
+        # aborts always happen OUTSIDE _stmt_lock: abort takes each txn.lock,
+        # which an in-flight stream may hold for its whole duration
         self._abort_all(expired)
+        if full:
+            raise flight.FlightServerError(
+                f"too many open transactions ({_TXN_CAP}); commit or"
+                " roll back existing ones"
+            )
         return [
             flight.Result(
                 _pack(pb.ActionBeginTransactionResult(transaction_id=txn_id))
@@ -309,6 +316,7 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         if txn is None:
             raise flight.FlightServerError("unknown or expired transaction")
         with txn.lock:
+            txn.closed = True
             if msg.action == pb.ActionEndTransactionRequest.END_TRANSACTION_ROLLBACK:
                 txn.abort()
                 return []
@@ -329,14 +337,19 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
                     else:
                         w.checkpoint(cid)
                     done.add(key)
-            except LakeSoulError as e:
+            except Exception as e:  # noqa: BLE001 — ANY failure must clean up
                 # per-table commits are individually atomic but there is no
                 # cross-table transaction log: abort the NOT-yet-committed
                 # writers (their staged files must not orphan) and report
-                # exactly what did land so the client can reconcile
+                # exactly what did land so the client can reconcile.  A
+                # failing abort (same store outage) must not stop the other
+                # aborts or mask the original error's report.
                 for key, w in txn.writers.items():
                     if key not in done:
-                        w.abort()
+                        try:
+                            w.abort()
+                        except Exception:  # noqa: BLE001
+                            pass
                 committed = ", ".join(f"{ns}.{t}" for ns, t in sorted(done)) or "none"
                 raise flight.FlightServerError(
                     f"transaction commit failed on {e}; committed tables:"
@@ -797,6 +810,12 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         try:
             # streams of one transaction serialize: they share its writers
             with txn.lock:
+                if txn.closed:
+                    # the txn ended between our registry lookup and here —
+                    # staging now would silently lose the rows
+                    raise flight.FlightServerError(
+                        "transaction has already ended or expired"
+                    )
                 w = txn.writers.get(key)
                 if w is None:
                     w = txn.writers[key] = CheckpointedWriter(table)

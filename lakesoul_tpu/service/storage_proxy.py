@@ -92,6 +92,13 @@ class StorageProxy:
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
         self.upstream = upstream  # S3Upstream | None
+        # live multipart uploads: the authoritative tombstone set.  An
+        # aborted id leaves this set FIRST, so an in-flight part upload
+        # that raced the abort detects it post-write and self-deletes
+        # instead of resurrecting the staging dir (classic TOCTOU).
+        # Server-process-scoped: a restart 404s pre-restart uploads.
+        self._mpu_lock = threading.Lock()
+        self._mpu_active: set[str] = set()
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -391,6 +398,8 @@ class StorageProxy:
 
             def _do_initiate_upload(self) -> None:
                 upload_id = uuid.uuid4().hex
+                with proxy._mpu_lock:
+                    proxy._mpu_active.add(upload_id)
                 ensure_dir(self._upload_dir(upload_id), proxy.catalog.storage_options)
                 self._send_xml(
                     '<?xml version="1.0" encoding="UTF-8"?>'
@@ -408,9 +417,31 @@ class StorageProxy:
                     self.send_error(400, "partNumber must be an integer")
                     return
                 upload_id = self._query["uploadId"]
-                self._stream_body_to(
-                    f"{self._upload_dir(upload_id)}/part-{part:05d}"
-                )
+                # S3 semantics: a part for a never-initiated or aborted
+                # upload is NoSuchUpload — silently recreating the staging
+                # dir would let a late retry resurrect an aborted upload
+                # and publish a truncated object
+                with proxy._mpu_lock:
+                    live = upload_id in proxy._mpu_active
+                if not live:
+                    self.send_error(404, "NoSuchUpload")
+                    return
+                staging = self._upload_dir(upload_id)
+                part_path = f"{staging}/part-{part:05d}"
+                self._stream_body_to(part_path)
+                # the abort tombstone is removed from _mpu_active BEFORE the
+                # abort deletes files, so re-checking after the write closes
+                # the race: if the upload died mid-write, drop our part
+                with proxy._mpu_lock:
+                    live = upload_id in proxy._mpu_active
+                if not live:
+                    fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
+                    try:
+                        fs.rm(sp, recursive=True)
+                    except FileNotFoundError:
+                        pass
+                    self.send_error(404, "NoSuchUpload")
+                    return
                 self.send_response(200)
                 self.send_header("ETag", f'"{upload_id}-{part}"')
                 self.send_header("Content-Length", "0")
@@ -418,6 +449,11 @@ class StorageProxy:
 
             def _do_complete_upload(self) -> None:
                 upload_id = self._query["uploadId"]
+                with proxy._mpu_lock:
+                    if upload_id not in proxy._mpu_active:
+                        self.send_error(404, "NoSuchUpload")
+                        return
+                    proxy._mpu_active.discard(upload_id)
                 # the CompleteMultipartUpload body's manifest SELECTS which
                 # parts compose the object (S3 semantics) — an empty body
                 # means "all staged parts in number order"
@@ -478,6 +514,9 @@ class StorageProxy:
                 )
 
             def _do_abort_upload(self) -> None:
+                # tombstone FIRST (see _mpu_active), delete files second
+                with proxy._mpu_lock:
+                    proxy._mpu_active.discard(self._query["uploadId"])
                 staging = self._upload_dir(self._query["uploadId"])
                 fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
                 try:
@@ -580,22 +619,37 @@ class ProxyStorageClient:
         self._check(status, data, 204, 200)
 
     def list_objects(self, table_key: str, prefix: str = "") -> list[tuple[str, int]]:
-        """``[(key, size)]`` under one table via ListObjectsV2."""
+        """``[(key, size)]`` under one table via ListObjectsV2, following
+        continuation tokens — a real S3 upstream pages at 1000 keys and a
+        single-page read would silently truncate the listing."""
         import urllib.parse
 
-        q = "list-type=2"
-        if prefix:
-            q += "&prefix=" + urllib.parse.quote(prefix)
-        status, _, data = self._request("GET", table_key, query=q)
-        self._check(status, data, 200)
-        root = ET.fromstring(data)
         ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
-        out = []
-        for c in root.findall("s3:Contents", ns) or root.findall("Contents"):
-            key = c.findtext("s3:Key", None, ns) or c.findtext("Key", "")
-            size = c.findtext("s3:Size", None, ns) or c.findtext("Size", "0")
-            out.append((key, int(size)))
-        return out
+        out: list[tuple[str, int]] = []
+        token: str | None = None
+        while True:
+            q = "list-type=2"
+            if prefix:
+                q += "&prefix=" + urllib.parse.quote(prefix)
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(token)
+            status, _, data = self._request("GET", table_key, query=q)
+            self._check(status, data, 200)
+            root = ET.fromstring(data)
+            for c in root.findall("s3:Contents", ns) or root.findall("Contents"):
+                key = c.findtext("s3:Key", None, ns) or c.findtext("Key", "")
+                size = c.findtext("s3:Size", None, ns) or c.findtext("Size", "0")
+                out.append((key, int(size)))
+            truncated = (
+                root.findtext("s3:IsTruncated", None, ns)
+                or root.findtext("IsTruncated", "false")
+            )
+            token = (
+                root.findtext("s3:NextContinuationToken", None, ns)
+                or root.findtext("NextContinuationToken", None)
+            )
+            if truncated.lower() != "true" or not token:
+                return out
 
     # ------------------------------------------------------------ multipart
     def initiate_multipart(self, key: str) -> str:

@@ -254,3 +254,17 @@ class TestMultipartManifest:
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req, timeout=5)
         assert e.value.code == 400
+
+    def test_part_upload_to_unknown_or_aborted_upload_404(self, proxy_env):
+        """NoSuchUpload: parts for never-initiated or aborted uploads are
+        rejected, never silently staged (abort-resurrection guard)."""
+        _, _, _, _, client = proxy_env
+        with pytest.raises(OSError, match="404"):
+            client.upload_part("default/t/ghost.bin", "deadbeef", 1, b"x")
+        key = "default/t/resurrect.bin"
+        upload = client.initiate_multipart(key)
+        client.abort_multipart(key, upload)
+        with pytest.raises(OSError, match="404"):
+            client.upload_part(key, upload, 1, b"x")
+        with pytest.raises(OSError, match="404"):
+            client.complete_multipart(key, upload)
