@@ -1319,14 +1319,18 @@ def main():
     catalog = LakeSoulCatalog(warehouse)
 
     # ---- host-only legs while the probe owns the (possibly dead) tunnel --
-    baseline_host = emit.leg(
-        "baseline_host",
-        lambda: _run_leg("baseline", env={"JAX_PLATFORMS": "cpu"})["baseline"],
-        lambda out: (
-            {"baseline_host_rows_per_s": round(out, 1)} if out == out else {}
-        ),
-        cost_s=240,
-    )
+    baseline_host = None
+    if not built_main:
+        emit.skip("baseline_host", "build_main did not complete")
+    else:
+        baseline_host = emit.leg(
+            "baseline_host",
+            lambda: _run_leg("baseline", env={"JAX_PLATFORMS": "cpu"})["baseline"],
+            lambda out: (
+                {"baseline_host_rows_per_s": round(out, 1)} if out == out else {}
+            ),
+            cost_s=240,
+        )
     emit.leg(
         "remote", lambda: _run_leg("remote", env={"JAX_PLATFORMS": "cpu"}),
         lambda out: {

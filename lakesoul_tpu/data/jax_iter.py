@@ -255,7 +255,10 @@ class JaxBatchIterator:
             return
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         stop = threading.Event()
-        thread = threading.Thread(target=self._producer, args=(q, stop), daemon=True)
+        thread = threading.Thread(
+            target=self._producer, args=(q, stop),
+            daemon=True, name="lakesoul-loader-producer",
+        )
         thread.start()
 
         def host_iter():
@@ -269,6 +272,13 @@ class JaxBatchIterator:
                     yield item
             finally:
                 stop.set()
+                # quiesce, don't just signal: an abandoned producer that
+                # keeps decoding in the background races whatever the caller
+                # does next (a resumed iterator over the same table, a test's
+                # monkeypatch, shutdown).  The put loop notices `stop` within
+                # 0.1 s; the bounded wait only rides out a unit decode that
+                # is already in flight.
+                thread.join(timeout=60.0)
 
         def delivered(rows: int) -> None:
             # position advances when a batch reaches the CONSUMER: a trainer

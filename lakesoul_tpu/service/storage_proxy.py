@@ -449,11 +449,13 @@ class StorageProxy:
 
             def _do_complete_upload(self) -> None:
                 upload_id = self._query["uploadId"]
+                # membership CHECK only: a failed complete (malformed body,
+                # missing part) must leave the upload open and retryable —
+                # S3 semantics; the id is discarded after success below
                 with proxy._mpu_lock:
                     if upload_id not in proxy._mpu_active:
                         self.send_error(404, "NoSuchUpload")
                         return
-                    proxy._mpu_active.discard(upload_id)
                 # the CompleteMultipartUpload body's manifest SELECTS which
                 # parts compose the object (S3 semantics) — an empty body
                 # means "all staged parts in number order"
@@ -504,6 +506,8 @@ class StorageProxy:
                                 if not piece:
                                     break
                                 out.write(piece)
+                with proxy._mpu_lock:
+                    proxy._mpu_active.discard(upload_id)
                 fs.rm(sp, recursive=True)
                 self._send_xml(
                     '<?xml version="1.0" encoding="UTF-8"?>'
@@ -632,7 +636,8 @@ class ProxyStorageClient:
             if prefix:
                 q += "&prefix=" + urllib.parse.quote(prefix)
             if token:
-                q += "&continuation-token=" + urllib.parse.quote(token)
+                # tokens are opaque server strings: escape EVERYTHING
+                q += "&continuation-token=" + urllib.parse.quote(token, safe="")
             status, _, data = self._request("GET", table_key, query=q)
             self._check(status, data, 200)
             root = ET.fromstring(data)

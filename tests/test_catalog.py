@@ -545,6 +545,24 @@ class TestLoaderCheckpoint:
         # position already includes it
         assert ckpt.rows_delivered == 128
 
+    def test_close_quiesces_producer_thread(self, catalog):
+        """Closing a loader iterator JOINS the producer thread instead of
+        merely signalling it: an abandoned producer that keeps decoding in
+        the background races whatever runs next (a resumed iterator, a
+        monkeypatch, interpreter shutdown) — the root cause of a flaky
+        full-suite failure where a stale phase-1 producer polluted phase 2's
+        decode spy under CPU contention."""
+        import threading
+
+        t = self._table(catalog, n=2000)
+        it = iter(t.scan().batch_size(100).to_jax_iter(device_put=False))
+        next(it)
+        it.close()
+        assert not any(
+            th.name == "lakesoul-loader-producer" and th.is_alive()
+            for th in threading.enumerate()
+        )
+
     def test_resume_fast_skips_whole_units_without_decode(self, catalog, monkeypatch):
         """Resume drops whole pre-position units via metadata row counts —
         they must never be decoded (footer-count fast path)."""

@@ -268,3 +268,74 @@ class TestMultipartManifest:
             client.upload_part(key, upload, 1, b"x")
         with pytest.raises(OSError, match="404"):
             client.complete_multipart(key, upload)
+
+    def test_failed_complete_leaves_upload_retryable(self, proxy_env):
+        """S3 semantics: a failed CompleteMultipartUpload (missing part)
+        leaves the upload OPEN — the client re-uploads the part and
+        retries, instead of losing every uploaded byte."""
+        import urllib.error
+        import urllib.request
+
+        _, proxy, token, _, client = proxy_env
+        key = "default/t/retry.bin"
+        upload = client.initiate_multipart(key)
+        client.upload_part(key, upload, 1, b"ONE")
+        body = (
+            b"<CompleteMultipartUpload>"
+            b"<Part><PartNumber>1</PartNumber></Part>"
+            b"<Part><PartNumber>2</PartNumber></Part>"
+            b"</CompleteMultipartUpload>"
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/{key}?uploadId={upload}",
+            method="POST", data=body,
+        )
+        req.add_header("Authorization", f"Bearer {token}")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+        # upload still live: fix the gap and retry successfully
+        client.upload_part(key, upload, 2, b"TWO")
+        client.complete_multipart(key, upload)
+        assert client.get(key) == b"ONETWO"
+        # and only NOW is the id dead
+        with pytest.raises(OSError, match="404"):
+            client.complete_multipart(key, upload)
+
+
+class TestListPaging:
+    def test_continuation_token_pages_are_followed(self):
+        """A real S3 upstream pages ListObjectsV2 at 1000 keys; the client
+        must follow NextContinuationToken or silently truncate listings
+        that ProxyDeleter/Cleaner act on destructively."""
+        pages = [
+            (
+                b'<?xml version="1.0" encoding="UTF-8"?>'
+                b'<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                b"<IsTruncated>true</IsTruncated>"
+                b"<NextContinuationToken>tok+1/=</NextContinuationToken>"
+                b"<Contents><Key>ns/t/a.bin</Key><Size>1</Size></Contents>"
+                b"</ListBucketResult>"
+            ),
+            (
+                b'<?xml version="1.0" encoding="UTF-8"?>'
+                b'<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                b"<IsTruncated>false</IsTruncated>"
+                b"<Contents><Key>ns/t/b.bin</Key><Size>2</Size></Contents>"
+                b"</ListBucketResult>"
+            ),
+        ]
+        queries = []
+        client = ProxyStorageClient("http://127.0.0.1:1")
+
+        def fake_request(method, key, *, body=None, query="", headers=None):
+            queries.append(query)
+            return 200, {}, pages[len(queries) - 1]
+
+        client._request = fake_request
+        out = client.list_objects("ns/t", prefix="p/")
+        assert out == [("ns/t/a.bin", 1), ("ns/t/b.bin", 2)]
+        assert "continuation-token" not in queries[0]
+        # the token is echoed back fully URL-encoded on the second page
+        assert "continuation-token=tok%2B1%2F%3D" in queries[1]
+        assert all(q.startswith("list-type=2&prefix=p") for q in queries)
