@@ -92,13 +92,17 @@ class StorageProxy:
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
         self.upstream = upstream  # S3Upstream | None
-        # live multipart uploads: the authoritative tombstone set.  An
-        # aborted id leaves this set FIRST, so an in-flight part upload
-        # that raced the abort detects it post-write and self-deletes
-        # instead of resurrecting the staging dir (classic TOCTOU).
+        # live multipart uploads: the authoritative tombstone map
+        # (id → "open" | "completing").  An aborted id leaves the map
+        # FIRST, so an in-flight part upload that raced the abort detects
+        # it post-write and self-deletes instead of resurrecting the
+        # staging dir (classic TOCTOU).  "completing" serializes duplicate
+        # CompleteMultipartUpload retries: the loser answers 409 instead of
+        # racing the winner's final-object write; a FAILED complete flips
+        # back to "open" so the upload stays retryable (S3 semantics).
         # Server-process-scoped: a restart 404s pre-restart uploads.
         self._mpu_lock = threading.Lock()
-        self._mpu_active: set[str] = set()
+        self._mpu_active: dict[str, str] = {}
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -399,7 +403,7 @@ class StorageProxy:
             def _do_initiate_upload(self) -> None:
                 upload_id = uuid.uuid4().hex
                 with proxy._mpu_lock:
-                    proxy._mpu_active.add(upload_id)
+                    proxy._mpu_active[upload_id] = "open"
                 ensure_dir(self._upload_dir(upload_id), proxy.catalog.storage_options)
                 self._send_xml(
                     '<?xml version="1.0" encoding="UTF-8"?>'
@@ -422,7 +426,7 @@ class StorageProxy:
                 # dir would let a late retry resurrect an aborted upload
                 # and publish a truncated object
                 with proxy._mpu_lock:
-                    live = upload_id in proxy._mpu_active
+                    live = proxy._mpu_active.get(upload_id) == "open"
                 if not live:
                     self.send_error(404, "NoSuchUpload")
                     return
@@ -433,7 +437,7 @@ class StorageProxy:
                 # abort deletes files, so re-checking after the write closes
                 # the race: if the upload died mid-write, drop our part
                 with proxy._mpu_lock:
-                    live = upload_id in proxy._mpu_active
+                    live = proxy._mpu_active.get(upload_id) == "open"
                 if not live:
                     fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
                     try:
@@ -449,65 +453,85 @@ class StorageProxy:
 
             def _do_complete_upload(self) -> None:
                 upload_id = self._query["uploadId"]
-                # membership CHECK only: a failed complete (malformed body,
-                # missing part) must leave the upload open and retryable —
-                # S3 semantics; the id is discarded after success below
+                # claim "completing" atomically: a duplicate concurrent
+                # complete answers 409 instead of racing the final write; a
+                # FAILED complete flips back to "open" (retryable, S3
+                # semantics); only a SUCCESS discards the id
                 with proxy._mpu_lock:
-                    if upload_id not in proxy._mpu_active:
+                    state = proxy._mpu_active.get(upload_id)
+                    if state == "completing":
+                        self.send_error(409, "upload completion in progress")
+                        return
+                    if state != "open":
                         self.send_error(404, "NoSuchUpload")
                         return
+                    proxy._mpu_active[upload_id] = "completing"
+
+                def reopen():
+                    with proxy._mpu_lock:
+                        if proxy._mpu_active.get(upload_id) == "completing":
+                            proxy._mpu_active[upload_id] = "open"
                 # the CompleteMultipartUpload body's manifest SELECTS which
                 # parts compose the object (S3 semantics) — an empty body
                 # means "all staged parts in number order"
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
-                wanted: list[int] | None = None
-                if body.strip():
-                    try:
-                        manifest = ET.fromstring(body)
-                    except ET.ParseError:
-                        self.send_error(400, "malformed CompleteMultipartUpload body")
-                        return
-                    wanted = [
-                        int(el.text)
-                        for el in manifest.iter()
-                        if el.tag.rsplit("}", 1)[-1] == "PartNumber"
-                    ]
-                staging = self._upload_dir(upload_id)
-                fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
                 try:
-                    parts = sorted(
-                        p for p in fs.ls(sp, detail=False)
-                        if p.rsplit("/", 1)[-1].startswith("part-")
-                    )
-                except FileNotFoundError:
-                    parts = []
-                if wanted is not None:
-                    by_number = {
-                        int(p.rsplit("part-", 1)[-1]): p for p in parts
-                    }
-                    missing = [n for n in wanted if n not in by_number]
-                    if missing:
-                        self.send_error(400, f"parts never uploaded: {missing}")
+                    wanted: list[int] | None = None
+                    if body.strip():
+                        try:
+                            manifest = ET.fromstring(body)
+                        except ET.ParseError:
+                            reopen()
+                            self.send_error(
+                                400, "malformed CompleteMultipartUpload body"
+                            )
+                            return
+                        wanted = [
+                            int(el.text)
+                            for el in manifest.iter()
+                            if el.tag.rsplit("}", 1)[-1] == "PartNumber"
+                        ]
+                    staging = self._upload_dir(upload_id)
+                    fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
+                    try:
+                        parts = sorted(
+                            p for p in fs.ls(sp, detail=False)
+                            if p.rsplit("/", 1)[-1].startswith("part-")
+                        )
+                    except FileNotFoundError:
+                        parts = []
+                    if wanted is not None:
+                        by_number = {
+                            int(p.rsplit("part-", 1)[-1]): p for p in parts
+                        }
+                        missing = [n for n in wanted if n not in by_number]
+                        if missing:
+                            reopen()
+                            self.send_error(400, f"parts never uploaded: {missing}")
+                            return
+                        parts = [by_number[n] for n in wanted]
+                    if not parts:
+                        reopen()
+                        self.send_error(404, "unknown uploadId (or no parts)")
                         return
-                    parts = [by_number[n] for n in wanted]
-                if not parts:
-                    self.send_error(404, "unknown uploadId (or no parts)")
-                    return
-                # the part-NNNNN zero-padding makes lexical order part order
-                out_fs, out_p = filesystem_for(
-                    self._object_path, proxy.catalog.storage_options, write=True
-                )
-                with out_fs.open(out_p, "wb") as out:
-                    for part in parts:
-                        with fs.open(part, "rb") as f:
-                            while True:
-                                piece = f.read(CHUNK)
-                                if not piece:
-                                    break
-                                out.write(piece)
+                    # the part-NNNNN zero-padding makes lexical order part order
+                    out_fs, out_p = filesystem_for(
+                        self._object_path, proxy.catalog.storage_options, write=True
+                    )
+                    with out_fs.open(out_p, "wb") as out:
+                        for part in parts:
+                            with fs.open(part, "rb") as f:
+                                while True:
+                                    piece = f.read(CHUNK)
+                                    if not piece:
+                                        break
+                                    out.write(piece)
+                except Exception:
+                    reopen()  # an I/O failure mid-assembly stays retryable
+                    raise
                 with proxy._mpu_lock:
-                    proxy._mpu_active.discard(upload_id)
+                    proxy._mpu_active.pop(upload_id, None)
                 fs.rm(sp, recursive=True)
                 self._send_xml(
                     '<?xml version="1.0" encoding="UTF-8"?>'
@@ -520,7 +544,7 @@ class StorageProxy:
             def _do_abort_upload(self) -> None:
                 # tombstone FIRST (see _mpu_active), delete files second
                 with proxy._mpu_lock:
-                    proxy._mpu_active.discard(self._query["uploadId"])
+                    proxy._mpu_active.pop(self._query["uploadId"], None)
                 staging = self._upload_dir(self._query["uploadId"])
                 fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
                 try:

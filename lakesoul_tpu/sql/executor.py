@@ -390,6 +390,55 @@ def _rename_node_cols(node, mapping: dict):
     )
 
 
+def _select_rebinds(sel, qual: str) -> bool:
+    """Does this (sub)query's own FROM/JOIN bind ``qual`` as a table name
+    or alias?  If so, the qualifier is re-scoped inside it."""
+    if sel.table == qual or sel.from_alias == qual:
+        return True
+    return any(j.table == qual or j.alias == qual for j in sel.joins)
+
+
+def _rename_qualified_refs(node, qual: str, name: str, new: str,
+                           _seen: set | None = None) -> None:
+    """IN-PLACE: every reference written ``<qual>.<name>`` becomes the bare
+    column ``new`` — items, WHERE/HAVING trees, later-join ON keys, and
+    subqueries alike.  Used when a RIGHT/FULL join keeps BOTH same-named
+    key columns and the right one survives under a suffix (the statement
+    AST is parsed per-execution, so mutation is safe)."""
+    import dataclasses
+
+    seen = _seen if _seen is not None else set()
+    if node is None or not dataclasses.is_dataclass(node) \
+            or isinstance(node, ast.Token) or id(node) in seen:
+        return
+    if isinstance(node, ast.Select) and seen and _select_rebinds(node, qual):
+        # a nested subquery whose OWN FROM/JOIN binds the same qualifier
+        # re-scopes it: its inner references must stay untouched
+        return
+    seen.add(id(node))
+    if isinstance(node, ast.Column):
+        if node.qual == qual and node.name == name:
+            node.name, node.qual = new, None
+        return
+    if getattr(node, "col_qual", None) == qual and getattr(node, "col", None) == name:
+        node.col, node.col_qual = new, None
+    if isinstance(node, ast.Join):
+        # EITHER operand of a later ON may reference the renamed key (the
+        # executor swap-binds by qualifier, so both sides are candidates)
+        if node.left_qual == qual and node.left_on == name:
+            node.left_on, node.left_qual = new, None
+        if node.right_qual == qual and node.right_on == name:
+            node.right_on, node.right_qual = new, None
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        for item in (v if isinstance(v, (list, tuple)) else [v]):
+            if isinstance(item, tuple):
+                for sub in item:
+                    _rename_qualified_refs(sub, qual, name, new, seen)
+            else:
+                _rename_qualified_refs(item, qual, name, new, seen)
+
+
 def _broadcast(val, n: int):
     """Expression results may be scalars (column-free expressions); broadcast
     them to the table's row count."""
@@ -681,7 +730,15 @@ class SqlSession:
         push_nodes: list = []
         if stmt.where is not None:
             push_nodes, residual_nodes = _split_where(stmt.where)
-            if stmt.joins:
+            if any(j.kind in ("right", "full") for j in stmt.joins):
+                # RIGHT/FULL OUTER preserve unmatched rows from the other
+                # side, whose base columns surface as NULL: a base-table
+                # predicate does NOT commute below the join (it would drop
+                # the NULL-extended rows' match partners) — everything
+                # evaluates post-join
+                residual_nodes = residual_nodes + push_nodes
+                push_nodes = []
+            elif stmt.joins:
                 # only base-table conjuncts may push below the join
                 spill = [
                     n for n in push_nodes if not _node_columns(n) <= base_schema
@@ -879,7 +936,12 @@ class SqlSession:
             else:
                 right = self.catalog.table(j.table, self.namespace).to_arrow()
             rname = j.alias or j.table
-            join_type = "inner" if j.kind == "inner" else "left outer"
+            join_type = {
+                "inner": "inner",
+                "left": "left outer",
+                "right": "right outer",
+                "full": "full outer",
+            }[j.kind]
             left_key, right_key = j.left_on, j.right_on
             # bind keys by their written qualifier (ON b.x = a.y works in
             # either order); bare names fall back to column membership
@@ -889,6 +951,26 @@ class SqlSession:
                 and left_key in right.column_names
             ):
                 left_key, right_key = right_key, left_key
+            if j.kind in ("right", "full"):
+                # ON semantics under outer extension: keep BOTH key columns
+                # (pyarrow's default key coalescing would make the
+                # NULL-extended side's key read the other side's value,
+                # silently breaking `a.k IS NULL` anti-joins)
+                clashes = set(table.column_names) & set(right.column_names)
+                suffix = f"_{rname}" if clashes else None
+                table = table.join(
+                    right, keys=left_key, right_keys=right_key,
+                    join_type=join_type, right_suffix=suffix,
+                    coalesce_keys=False,
+                )
+                if left_key == right_key and suffix:
+                    # the right key survives suffixed: qualified references
+                    # to it resolve there (bare ones stay on the left key)
+                    new = right_key + suffix
+                    _rename_qualified_refs(stmt, rname, right_key, new)
+                    for n2 in residual_nodes:
+                        _rename_qualified_refs(n2, rname, right_key, new)
+                continue
             # non-key name collisions: suffix the right side (documented,
             # deterministic; a bare reference resolves to the left table)
             clashes = (set(table.column_names) & set(right.column_names)) - {right_key}
@@ -1543,6 +1625,44 @@ class SqlSession:
                 return pc.utf8_slice_codeunits(
                     self._eval_expr(arr, table), start=s0, stop=stop
                 )
+            if expr.name == "coalesce":
+                vals = [
+                    _broadcast(self._eval_expr(a, table), len(table))
+                    for a in expr.args
+                ]
+                return pc.coalesce(*vals)
+            if expr.name == "nullif":
+                if len(expr.args) != 2:
+                    raise SqlError("nullif takes exactly two arguments")
+                a = _broadcast(self._eval_expr(expr.args[0], table), len(table))
+                b = _broadcast(self._eval_expr(expr.args[1], table), len(table))
+                eq = pc.fill_null(pc.equal(a, b), False)
+                return pc.if_else(eq, pa.scalar(None, a.type), a)
+            if expr.name in ("abs", "upper", "lower", "length", "round"):
+                if expr.name == "round":
+                    if not 1 <= len(expr.args) <= 2:
+                        raise SqlError("round takes one or two arguments")
+                    nd = 0
+                    if len(expr.args) == 2:
+                        ndv = self._eval_expr(expr.args[1], table)
+                        if not isinstance(ndv, pa.Scalar):
+                            raise SqlError("round digits must be a literal")
+                        nd = int(ndv.as_py())
+                    # SQL rounds half away from zero, not banker's rounding
+                    return pc.round(
+                        self._eval_expr(expr.args[0], table),
+                        ndigits=nd, round_mode="half_towards_infinity",
+                    )
+                if len(expr.args) != 1:
+                    raise SqlError(f"{expr.name} takes exactly one argument")
+                arg = self._eval_expr(expr.args[0], table)
+                fn = {
+                    "abs": pc.abs,
+                    "upper": pc.utf8_upper,
+                    "lower": pc.utf8_lower,
+                    "length": pc.utf8_length,
+                }[expr.name]
+                return fn(arg)
             raise SqlError(f"unknown function {expr.name!r}")
         if isinstance(expr, ast.ScalarSubquery):
             sel = expr.select

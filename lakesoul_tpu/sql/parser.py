@@ -38,6 +38,7 @@ KEYWORDS = {
     "primary", "key", "partitioned", "with", "if", "exists", "distinct",
     "count", "sum", "min", "max", "avg", "true", "false", "alter", "add",
     "column", "call", "update", "set", "delete", "join", "inner", "left", "on",
+    "right", "full", "outer",
     "case", "when", "then", "else", "end", "having", "between", "like",
     "substring", "for", "union", "intersect", "except", "all", "over",
     "partition",
@@ -45,6 +46,12 @@ KEYWORDS = {
 
 # window-only functions (idents, not keywords: usable as column names)
 WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank", "lag", "lead")
+
+# generic scalar functions parsed as ``name(arg, ...)`` (idents, not
+# keywords — still usable as column names when not followed by "(")
+SCALAR_FUNCTIONS = (
+    "coalesce", "nullif", "abs", "round", "upper", "lower", "length",
+)
 
 
 @dataclass
@@ -506,6 +513,15 @@ class Parser:
                 self.expect("kw", "join")
             elif self.accept("kw", "left"):
                 kind = "left"
+                self.accept("kw", "outer")
+                self.expect("kw", "join")
+            elif self.accept("kw", "right"):
+                kind = "right"
+                self.accept("kw", "outer")
+                self.expect("kw", "join")
+            elif self.accept("kw", "full"):
+                kind = "full"
+                self.accept("kw", "outer")
                 self.expect("kw", "join")
             elif self.accept("kw", "join"):
                 kind = "inner"
@@ -780,6 +796,17 @@ class Parser:
                 and self.tokens[self.pos + 1].kind == "op" \
                 and self.tokens[self.pos + 1].value == "(":
             return self._window_call()
+        if tok.kind == "ident" and tok.value.lower() in SCALAR_FUNCTIONS \
+                and self.pos + 1 < len(self.tokens) \
+                and self.tokens[self.pos + 1].kind == "op" \
+                and self.tokens[self.pos + 1].value == "(":
+            name = self.next().value.lower()
+            self.expect("op", "(")
+            args = [self._arith_expr()]
+            while self.accept("op", ","):
+                args.append(self._arith_expr())
+            self.expect("op", ")")
+            return Func(name, args)
         if tok.kind == "ident" and tok.value.lower() in ("timestamp", "date") \
                 and self.pos + 1 < len(self.tokens) \
                 and self.tokens[self.pos + 1].kind == "string":

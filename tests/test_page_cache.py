@@ -171,7 +171,11 @@ class TestCacheConcurrency:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=30)
+            # generous: on a loaded 1-core host an expired join would leave
+            # readers racing the accounting snapshot below (flaky mismatch);
+            # a genuine deadlock fails the explicit liveness assert instead
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "reader deadlocked"
         assert not errors
         s = cache.snapshot()
         assert s["bytes"] == sum(
@@ -202,6 +206,7 @@ class TestCacheConcurrency:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=60)
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "reader deadlocked"
         assert not errors
         assert cache.current_bytes() <= 48 << 10

@@ -1100,3 +1100,193 @@ class TestAndConjunctBucketPruning:
         h = col("id").is_in([5, 600]) & (col("v") >= 0)
         rows = t.scan().filter(h).to_arrow().column("id").to_pylist()
         assert sorted(rows) == [5, 600]
+
+
+class TestOuterJoins:
+    """RIGHT / FULL OUTER JOIN (r5): the reference's embedded DataFusion
+    serves all join types; the dialect now covers the OUTER family (LEFT
+    OUTER already existed as LEFT)."""
+
+    @pytest.fixture()
+    def jsession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE a (k bigint, x string)")
+        s.execute("CREATE TABLE b (k bigint, y double)")
+        s.execute("INSERT INTO a VALUES (1,'one'), (2,'two'), (3,'three')")
+        s.execute("INSERT INTO b VALUES (2, 2.5), (3, 3.5), (4, 4.5)")
+        return s
+
+    def test_right_join(self, jsession):
+        out = jsession.execute(
+            "SELECT a.k, x, y FROM a RIGHT JOIN b ON a.k = b.k ORDER BY y"
+        )
+        assert out.column("y").to_pylist() == [2.5, 3.5, 4.5]
+        assert out.column("x").to_pylist() == ["two", "three", None]
+
+    def test_right_outer_spelling(self, jsession):
+        out = jsession.execute(
+            "SELECT x FROM a RIGHT OUTER JOIN b ON a.k = b.k"
+        )
+        assert sorted(v or "" for v in out.column("x").to_pylist()) \
+            == ["", "three", "two"]
+
+    def test_full_outer_join(self, jsession):
+        # a.k is NULL on the right-only row — ON keeps BOTH key columns,
+        # unlike USING (no silent key coalescing)
+        out = jsession.execute(
+            "SELECT a.k, x, y FROM a FULL OUTER JOIN b ON a.k = b.k"
+        )
+        rows = sorted(
+            zip(out.column("k").to_pylist(), out.column("x").to_pylist(),
+                out.column("y").to_pylist()),
+            key=lambda r: (r[0] is None, r[0]),
+        )
+        assert rows == [
+            (1, "one", None), (2, "two", 2.5), (3, "three", 3.5),
+            (None, None, 4.5),
+        ]
+
+    def test_right_join_key_null_extension(self, jsession):
+        out = jsession.execute(
+            "SELECT a.k FROM a RIGHT JOIN b ON a.k = b.k ORDER BY y"
+        )
+        assert out.column("k").to_pylist() == [2, 3, None]
+        # and the right-side key is reachable by ITS qualifier
+        out = jsession.execute(
+            "SELECT b.k AS bk FROM a RIGHT JOIN b ON a.k = b.k ORDER BY y"
+        )
+        assert out.column("bk").to_pylist() == [2, 3, 4]
+
+    def test_key_anti_join_on_full_outer(self, jsession):
+        out = jsession.execute(
+            "SELECT y FROM a FULL OUTER JOIN b ON a.k = b.k WHERE a.k IS NULL"
+        )
+        assert out.column("y").to_pylist() == [4.5]
+        out = jsession.execute(
+            "SELECT x FROM a FULL OUTER JOIN b ON a.k = b.k WHERE b.k IS NULL"
+        )
+        assert out.column("x").to_pylist() == ["one"]
+
+    def test_key_anti_join_distinct_names(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE l (k bigint)")
+        s.execute("CREATE TABLE r (kk bigint, z double)")
+        s.execute("INSERT INTO l VALUES (1), (2)")
+        s.execute("INSERT INTO r VALUES (2, 2.5), (9, 9.5)")
+        out = s.execute(
+            "SELECT z FROM l FULL OUTER JOIN r ON l.k = r.kk WHERE k IS NULL"
+        )
+        assert out.column("z").to_pylist() == [9.5]
+        out = s.execute(
+            "SELECT k FROM l FULL OUTER JOIN r ON l.k = r.kk WHERE kk IS NULL"
+        )
+        assert out.column("k").to_pylist() == [1]
+
+    def test_left_outer_spelling(self, jsession):
+        out = jsession.execute(
+            "SELECT k FROM a LEFT OUTER JOIN b ON a.k = b.k WHERE y IS NULL"
+        )
+        assert out.column("k").to_pylist() == [1]
+
+    def test_anti_join_pattern(self, jsession):
+        # the classic NOT-matched pattern over a full outer join
+        out = jsession.execute(
+            "SELECT y FROM a FULL OUTER JOIN b ON a.k = b.k WHERE x IS NULL"
+        )
+        assert out.column("y").to_pylist() == [4.5]
+
+
+class TestScalarFunctions:
+    """COALESCE / NULLIF / ABS / ROUND / UPPER / LOWER / LENGTH (r5)."""
+
+    @pytest.fixture()
+    def fsession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (k bigint, x string, v double)")
+        s.execute(
+            "INSERT INTO t VALUES (1,'one',1.25), (2,'two',-2.5), (3,NULL,NULL)"
+        )
+        return s
+
+    def test_coalesce(self, fsession):
+        out = fsession.execute("SELECT coalesce(x, 'none') AS c FROM t")
+        assert out.column("c").to_pylist() == ["one", "two", "none"]
+        out = fsession.execute("SELECT coalesce(v, 0.0) AS c FROM t")
+        assert out.column("c").to_pylist() == [1.25, -2.5, 0.0]
+
+    def test_nullif(self, fsession):
+        out = fsession.execute("SELECT nullif(k, 2) AS n FROM t")
+        assert out.column("n").to_pylist() == [1, None, 3]
+
+    def test_abs_round(self, fsession):
+        out = fsession.execute("SELECT abs(v) AS a, round(v) AS r FROM t")
+        assert out.column("a").to_pylist() == [1.25, 2.5, None]
+        # SQL rounds half AWAY from zero (not banker's)
+        assert out.column("r").to_pylist() == [1.0, -3.0, None]
+        out = fsession.execute("SELECT round(v, 1) AS r FROM t WHERE k = 1")
+        assert out.column("r").to_pylist() == [1.3]
+
+    def test_string_functions(self, fsession):
+        out = fsession.execute(
+            "SELECT upper(x) AS u, lower(upper(x)) AS l, length(x) AS n FROM t"
+        )
+        assert out.column("u").to_pylist() == ["ONE", "TWO", None]
+        assert out.column("l").to_pylist() == ["one", "two", None]
+        assert out.column("n").to_pylist() == [3, 3, None]
+
+    def test_functions_in_where_and_aggregates(self, fsession):
+        out = fsession.execute(
+            "SELECT count(*) AS c FROM t WHERE coalesce(x, 'none') = 'none'"
+        )
+        assert out.column("c").to_pylist() == [1]
+        out = fsession.execute("SELECT sum(abs(v)) AS s FROM t")
+        assert out.column("s").to_pylist() == [3.75]
+
+    def test_function_names_still_valid_columns(self, tmp_warehouse):
+        # idents, not keywords: a column named `length` keeps working
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE m (length bigint)")
+        s.execute("INSERT INTO m VALUES (7)")
+        assert s.execute("SELECT length FROM m").column("length").to_pylist() == [7]
+
+    def test_arity_errors(self, fsession):
+        with pytest.raises(SqlError, match="two arguments"):
+            fsession.execute("SELECT nullif(k) FROM t")
+        with pytest.raises(SqlError, match="one argument"):
+            fsession.execute("SELECT abs(k, 2) FROM t")
+
+    def test_later_join_on_suffixed_key_either_operand_order(self, tmp_warehouse):
+        """A later ON may reference the suffixed right-join key as either
+        operand; both spellings must bind to the surviving right column."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE a (k bigint)")
+        s.execute("CREATE TABLE b (k bigint, y double)")
+        s.execute("CREATE TABLE c (z bigint, w string)")
+        s.execute("INSERT INTO a VALUES (1), (2)")
+        s.execute("INSERT INTO b VALUES (2, 2.5), (4, 4.5)")
+        s.execute("INSERT INTO c VALUES (2, 'C2'), (4, 'C4')")
+        for on in ("c.z = b.k", "b.k = c.z"):
+            out = s.execute(
+                f"SELECT w FROM a RIGHT JOIN b ON a.k = b.k JOIN c ON {on}"
+            )
+            assert sorted(out.column("w").to_pylist()) == ["C2", "C4"]
+
+    def test_subquery_rebinding_qualifier_untouched(self, tmp_warehouse):
+        """A subquery whose own FROM binds the joined table's name re-scopes
+        the qualifier: its inner references must not be renamed."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE a (k bigint)")
+        s.execute("CREATE TABLE b (k bigint, y double)")
+        s.execute("INSERT INTO a VALUES (1), (2)")
+        s.execute("INSERT INTO b VALUES (2, 2.5), (4, 4.5)")
+        out = s.execute(
+            "SELECT (SELECT max(y) FROM b WHERE b.k = 2) AS m"
+            " FROM a RIGHT JOIN b ON a.k = b.k ORDER BY y"
+        )
+        assert out.column("m").to_pylist() == [2.5, 2.5]
