@@ -439,6 +439,14 @@ def _rename_qualified_refs(node, qual: str, name: str, new: str,
                 _rename_qualified_refs(item, qual, name, new, seen)
 
 
+def _slice_limit_offset(out: pa.Table, stmt) -> pa.Table:
+    """Apply the statement's OFFSET/LIMIT tail (shared by every result
+    path so the sites cannot drift)."""
+    if stmt.offset or stmt.limit is not None:
+        out = out.slice(stmt.offset or 0, stmt.limit)
+    return out
+
+
 def _broadcast(val, n: int):
     """Expression results may be scalars (column-free expressions); broadcast
     them to the table's row count."""
@@ -707,9 +715,7 @@ class SqlSession:
             out = out.sort_by(
                 [(c, "descending" if d else "ascending") for c, d in stmt.order_by]
             )
-        if stmt.limit is not None:
-            out = out.slice(0, stmt.limit)
-        return out
+        return _slice_limit_offset(out, stmt)
 
     def _base_scan(self, stmt: ast.Select):
         """Scan of the FROM table, positioned at AS OF when time-traveling."""
@@ -765,8 +771,9 @@ class SqlSession:
             and not stmt.distinct
         ):
             # LIMIT without ORDER BY returns arbitrary rows, so the scan
-            # can stop early (unread units are skipped entirely)
-            scan = scan.limit(stmt.limit)
+            # can stop early (unread units are skipped entirely); with an
+            # OFFSET the prefix rows must still be delivered for the slice
+            scan = scan.limit(stmt.limit + (stmt.offset or 0))
         return scan, residual_nodes
 
     def _explain(self, stmt) -> pa.Table:
@@ -782,8 +789,11 @@ class SqlSession:
                 lines.append(f"{indent}SetOp: {s.op}{' all' if s.all else ''}")
                 describe(s.left, indent + "  ")
                 describe(s.right, indent + "  ")
-                if s.order_by:
-                    lines.append(f"{indent}  order_by={s.order_by} limit={s.limit}")
+                if s.order_by or s.limit is not None or s.offset:
+                    lines.append(
+                        f"{indent}  order_by={s.order_by} limit={s.limit}"
+                        + (f" offset={s.offset}" if s.offset else "")
+                    )
                 return
             if not isinstance(s, ast.Select):
                 lines.append(f"{indent}{type(s).__name__}")
@@ -860,8 +870,11 @@ class SqlSession:
                 lines.append(f"{indent}Distinct")
             if s.order_by:
                 lines.append(f"{indent}Sort: {s.order_by}")
-            if s.limit is not None:
-                lines.append(f"{indent}Limit: {s.limit}")
+            if s.limit is not None or s.offset:
+                lines.append(
+                    f"{indent}Limit: {s.limit}"
+                    + (f" offset={s.offset}" if s.offset else "")
+                )
 
         describe(stmt)
         return pa.table({"plan": lines})
@@ -884,6 +897,7 @@ class SqlSession:
             and not stmt.distinct
             and not stmt.star
             and (stmt.limit is None or stmt.limit >= 1)  # LIMIT 0 drops the row
+            and not stmt.offset  # OFFSET 1+ drops the single result row
         )
 
     def _select(self, stmt: ast.Select) -> pa.Table:
@@ -896,9 +910,7 @@ class SqlSession:
             out, hidden = self._project(stmt, one)
             if hidden:
                 out = out.drop_columns(hidden)
-            if stmt.limit is not None:
-                out = out.slice(0, stmt.limit)
-            return out
+            return _slice_limit_offset(out, stmt)
         if self._count_shortcut_applies(stmt):
             n = self._base_scan(stmt).count_rows()
             label = stmt.items[0].alias or "count(*)"
@@ -1024,9 +1036,7 @@ class SqlSession:
             out = out.sort_by(keys)
         if hidden:
             out = out.drop_columns(hidden)
-        if stmt.limit is not None:
-            out = out.slice(0, stmt.limit)
-        return out
+        return _slice_limit_offset(out, stmt)
 
     def _needed_columns(self, stmt: ast.Select, residual_nodes: list) -> set[str]:
         cols: set[str] = set(stmt.group_by)
@@ -1625,6 +1635,31 @@ class SqlSession:
                 return pc.utf8_slice_codeunits(
                     self._eval_expr(arr, table), start=s0, stop=stop
                 )
+            if expr.name == "cast":
+                val, spec = expr.args
+                tname, params = spec.value
+                if tname == "decimal":
+                    if params:
+                        precision = params[0]
+                        scale = params[1] if len(params) > 1 else 0
+                    else:
+                        precision, scale = 38, 10
+                    target = pa.decimal128(precision, scale)
+                elif tname in ("varchar", "char"):
+                    target = pa.string()  # length is advisory in SQL
+                else:
+                    target = _TYPE_MAP.get(tname)
+                if target is None:
+                    raise SqlError(f"unknown type {tname!r} in CAST")
+                try:
+                    # float→int TRUNCATES (standard SQL / Spark / DuckDB);
+                    # malformed strings and overflows still error
+                    opts = pc.CastOptions(
+                        target_type=target, allow_float_truncate=True
+                    )
+                    return pc.cast(self._eval_expr(val, table), options=opts)
+                except (pa.lib.ArrowInvalid, pa.lib.ArrowNotImplementedError) as e:
+                    raise SqlError(f"CAST failed: {e}")
             if expr.name == "coalesce":
                 vals = [
                     _broadcast(self._eval_expr(a, table), len(table))

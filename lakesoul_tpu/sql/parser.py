@@ -252,6 +252,7 @@ class Select:
     having: Any = None
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
     limit: int | None = None
+    offset: int | None = None
 
 
 @dataclass
@@ -272,6 +273,7 @@ class SetOp:
     all: bool = False
     order_by: list[tuple[str, bool]] = field(default_factory=list)
     limit: int | None = None
+    offset: int | None = None
 
 
 @dataclass
@@ -467,9 +469,10 @@ class Parser:
         while isinstance(rightmost.right, SetOp):
             rightmost = rightmost.right
         tail = rightmost.right
-        if tail.order_by or tail.limit is not None:
+        if tail.order_by or tail.limit is not None or tail.offset is not None:
             node.order_by, node.limit = tail.order_by, tail.limit
-            tail.order_by, tail.limit = [], None
+            node.offset = tail.offset
+            tail.order_by, tail.limit, tail.offset = [], None, None
         return node
 
     def parse_select(self) -> Select:
@@ -495,16 +498,21 @@ class Parser:
         if has_from and self.accept("op", "("):
             sel.from_subquery = self.parse_query()
             self.expect("op", ")")
-            self.accept("kw", "as")
-            if self.peek() is not None and self.peek().kind == "ident":
+            explicit_as = bool(self.accept("kw", "as"))
+            if self.peek() is not None and self.peek().kind == "ident" \
+                    and (explicit_as or self.peek().value.lower() != "offset"):
+                # same soft-keyword rule as the base-table alias: a bare
+                # OFFSET after the derived table starts the OFFSET clause
                 sel.from_alias = self.ident()
         elif has_from:
             sel.table = self.ident()
             self._maybe_time_travel(sel)
             # optional table alias (FROM lineitem l) — ignored for resolution,
-            # accepted so qualified queries parse
+            # accepted so qualified queries parse.  "offset" stays a soft
+            # keyword here: `FROM t OFFSET 1` must not read it as an alias.
             nxt = self.peek()
-            if nxt is not None and nxt.kind == "ident":
+            if nxt is not None and nxt.kind == "ident" \
+                    and nxt.value.lower() != "offset":
                 sel.from_alias = self.ident()
         while has_from:
             kind = None
@@ -567,6 +575,12 @@ class Parser:
                     break
         if self.accept("kw", "limit"):
             sel.limit = int(self.expect("number").value)
+        # OFFSET is a soft ident (columns named offset keep working); it
+        # composes with or without LIMIT, per standard SQL
+        nxt = self.peek()
+        if nxt is not None and nxt.kind == "ident" and nxt.value.lower() == "offset":
+            self.next()
+            sel.offset = int(self.expect("number").value)
         return sel
 
     def _maybe_time_travel(self, sel: Select) -> None:
@@ -807,6 +821,27 @@ class Parser:
                 args.append(self._arith_expr())
             self.expect("op", ")")
             return Func(name, args)
+        if tok.kind == "ident" and tok.value.lower() == "cast" \
+                and self.pos + 1 < len(self.tokens) \
+                and self.tokens[self.pos + 1].kind == "op" \
+                and self.tokens[self.pos + 1].value == "(":
+            # CAST(expr AS type) — the standard spelling every ADBC/BI
+            # client emits; the type vocabulary is CREATE TABLE's, plus
+            # parameterized forms (varchar(n) length is advisory-ignored,
+            # decimal(p,s) maps to a real decimal type)
+            self.next()
+            self.expect("op", "(")
+            e = self._arith_expr()
+            self.expect("kw", "as")
+            tname = self.ident().lower()
+            params: list[int] = []
+            if self.accept("op", "("):
+                params.append(int(self.expect("number").value))
+                while self.accept("op", ","):
+                    params.append(int(self.expect("number").value))
+                self.expect("op", ")")
+            self.expect("op", ")")
+            return Func("cast", [e, Literal((tname, tuple(params)))])
         if tok.kind == "ident" and tok.value.lower() in ("timestamp", "date") \
                 and self.pos + 1 < len(self.tokens) \
                 and self.tokens[self.pos + 1].kind == "string":

@@ -1290,3 +1290,101 @@ class TestScalarFunctions:
             " FROM a RIGHT JOIN b ON a.k = b.k ORDER BY y"
         )
         assert out.column("m").to_pylist() == [2.5, 2.5]
+
+
+class TestCastAndOffset:
+    """CAST(expr AS type) and LIMIT/OFFSET (r5) — the surface ADBC/BI
+    clients emit unprompted."""
+
+    @pytest.fixture()
+    def csession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (k bigint, x string, v double)")
+        s.execute(
+            "INSERT INTO t VALUES (1,'10',1.9), (2,'20',2.1), (3,'30',3.5),"
+            " (4,'40',4.4), (5,'50',5.0)"
+        )
+        return s
+
+    def test_cast_string_to_int(self, csession):
+        out = csession.execute("SELECT cast(x AS bigint) AS n FROM t ORDER BY n")
+        assert out.column("n").to_pylist() == [10, 20, 30, 40, 50]
+        assert out.column("n").type == pa.int64()
+
+    def test_cast_double_to_int_and_back(self, csession):
+        out = csession.execute("SELECT cast(k AS double) AS d FROM t WHERE k = 1")
+        assert out.column("d").to_pylist() == [1.0]
+        assert out.column("d").type == pa.float64()
+        out = csession.execute("SELECT cast(k AS string) AS s FROM t WHERE k = 2")
+        assert out.column("s").to_pylist() == ["2"]
+
+    def test_cast_in_where_and_aggregate(self, csession):
+        out = csession.execute(
+            "SELECT sum(cast(x AS bigint)) AS s FROM t WHERE cast(x AS bigint) > 20"
+        )
+        assert out.column("s").to_pylist() == [120]
+
+    def test_cast_unknown_type(self, csession):
+        with pytest.raises(SqlError, match="unknown type"):
+            csession.execute("SELECT cast(k AS blob) FROM t")
+
+    def test_cast_still_valid_column_name(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE m (cast bigint)")
+        s.execute("INSERT INTO m VALUES (7)")
+        assert s.execute("SELECT cast FROM m").column("cast").to_pylist() == [7]
+
+    def test_limit_offset(self, csession):
+        out = csession.execute("SELECT k FROM t ORDER BY k LIMIT 2 OFFSET 1")
+        assert out.column("k").to_pylist() == [2, 3]
+        out = csession.execute("SELECT k FROM t ORDER BY k OFFSET 3")
+        assert out.column("k").to_pylist() == [4, 5]
+        out = csession.execute("SELECT k FROM t ORDER BY k LIMIT 10 OFFSET 10")
+        assert out.column("k").to_pylist() == []
+
+    def test_offset_on_aggregate_and_count_shortcut(self, csession):
+        # count(*) is normally a metadata shortcut; OFFSET must still apply
+        out = csession.execute("SELECT count(*) AS c FROM t OFFSET 1")
+        assert out.num_rows == 0
+        out = csession.execute("SELECT count(*) AS c FROM t")
+        assert out.column("c").to_pylist() == [5]
+
+    def test_offset_on_set_op_chain(self, csession):
+        out = csession.execute(
+            "SELECT k FROM t WHERE k <= 2 UNION ALL SELECT k FROM t WHERE k >= 4"
+            " ORDER BY k LIMIT 2 OFFSET 1"
+        )
+        assert out.column("k").to_pylist() == [2, 4]
+
+    def test_offset_still_valid_column_name(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE m (offset bigint)")
+        s.execute("INSERT INTO m VALUES (3)")
+        assert s.execute("SELECT offset FROM m").column("offset").to_pylist() == [3]
+
+    def test_offset_after_derived_table(self, csession):
+        out = csession.execute(
+            "SELECT k FROM (SELECT k FROM t ORDER BY k) OFFSET 3"
+        )
+        assert out.column("k").to_pylist() == [4, 5]
+
+    def test_cast_float_to_int_truncates(self, csession):
+        # standard SQL / Spark / DuckDB truncate; safe-mode erroring would
+        # break every BI client that rounds through integers
+        out = csession.execute("SELECT cast(v AS bigint) AS n FROM t ORDER BY k")
+        assert out.column("n").to_pylist() == [1, 2, 3, 4, 5]
+
+    def test_cast_parameterized_types(self, csession):
+        out = csession.execute("SELECT cast(k AS varchar(10)) AS s FROM t WHERE k = 1")
+        assert out.column("s").to_pylist() == ["1"]
+        out = csession.execute("SELECT cast(v AS decimal(10, 2)) AS d FROM t WHERE k = 1")
+        assert out.column("d").type == pa.decimal128(10, 2)
+        assert str(out.column("d").to_pylist()[0]) == "1.90"
+
+    def test_explain_shows_offset(self, csession):
+        out = csession.execute("EXPLAIN SELECT k FROM t LIMIT 2 OFFSET 5")
+        text = "\n".join(out.column(out.column_names[0]).to_pylist())
+        assert "offset=5" in text
