@@ -268,6 +268,7 @@ def _subst_aggs(expr, agg_col: dict):
         return ast.Case(
             [(c, _subst_aggs(v, agg_col)) for c, v in expr.whens],
             _subst_aggs(expr.default, agg_col) if expr.default is not None else None,
+            expr.operand,
         )
     if isinstance(expr, ast.Func):
         return ast.Func(
@@ -348,8 +349,13 @@ def _map_node_cols(node, map_col, map_sel=None):
             )
         if isinstance(e, ast.Case):
             return ast.Case(
-                [(walk(c), ren_expr(v)) for c, v in e.whens],
+                # simple-CASE whens hold VALUE expressions, not bool trees
+                [
+                    ((walk(c) if e.operand is None else ren_expr(c)), ren_expr(v))
+                    for c, v in e.whens
+                ],
                 None if e.default is None else ren_expr(e.default),
+                None if e.operand is None else ren_expr(e.operand),
             )
         if isinstance(e, ast.ScalarSubquery):
             return ast.ScalarSubquery(sel(e.select))
@@ -790,10 +796,14 @@ class SqlSession:
                 describe(s.left, indent + "  ")
                 describe(s.right, indent + "  ")
                 if s.order_by or s.limit is not None or s.offset:
-                    lines.append(
-                        f"{indent}  order_by={s.order_by} limit={s.limit}"
-                        + (f" offset={s.offset}" if s.offset else "")
-                    )
+                    tail_bits = []
+                    if s.order_by:
+                        tail_bits.append(f"order_by={s.order_by}")
+                    if s.limit is not None:
+                        tail_bits.append(f"limit={s.limit}")
+                    if s.offset:
+                        tail_bits.append(f"offset={s.offset}")
+                    lines.append(f"{indent}  " + " ".join(tail_bits))
                 return
             if not isinstance(s, ast.Select):
                 lines.append(f"{indent}{type(s).__name__}")
@@ -871,10 +881,12 @@ class SqlSession:
             if s.order_by:
                 lines.append(f"{indent}Sort: {s.order_by}")
             if s.limit is not None or s.offset:
-                lines.append(
-                    f"{indent}Limit: {s.limit}"
-                    + (f" offset={s.offset}" if s.offset else "")
-                )
+                bits = []
+                if s.limit is not None:
+                    bits.append(f"Limit: {s.limit}")
+                if s.offset:
+                    bits.append(f"offset={s.offset}" if bits else f"Offset: {s.offset}")
+                lines.append(f"{indent}" + " ".join(bits))
 
         describe(stmt)
         return pa.table({"plan": lines})
@@ -1644,7 +1656,10 @@ class SqlSession:
                         scale = params[1] if len(params) > 1 else 0
                     else:
                         precision, scale = 38, 10
-                    target = pa.decimal128(precision, scale)
+                    try:
+                        target = pa.decimal128(precision, scale)
+                    except ValueError as e:  # precision out of [1, 38]
+                        raise SqlError(f"CAST failed: {e}")
                 elif tname in ("varchar", "char"):
                     target = pa.string()  # length is advisory in SQL
                 else:
@@ -1863,10 +1878,19 @@ class SqlSession:
         n = len(table)
         remaining = np.ones(n, dtype=bool)
         parts: list[tuple[np.ndarray, pa.Table]] = []
+        # simple CASE: the operand evaluates ONCE, each WHEN compares to it
+        op_val = (
+            _broadcast(self._eval_expr(expr.operand, table), n)
+            if expr.operand is not None else None
+        )
         for cond, value in expr.whens:
-            mask = pc.fill_null(
-                _broadcast(self._eval_bool(cond, table), n), False
-            )
+            if op_val is not None:
+                raw = pc.equal(
+                    op_val, _broadcast(self._eval_expr(cond, table), n)
+                )
+            else:
+                raw = _broadcast(self._eval_bool(cond, table), n)
+            mask = pc.fill_null(raw, False)
             m = np.asarray(mask) & remaining
             rows = np.nonzero(m)[0]
             if rows.size:

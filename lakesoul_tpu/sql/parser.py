@@ -110,10 +110,15 @@ class Arith:
 
 @dataclass
 class Case:
-    """CASE WHEN cond THEN expr [...] [ELSE expr] END."""
+    """CASE WHEN cond THEN expr [...] [ELSE expr] END.
 
-    whens: list  # [(bool_node, value_expr), ...]
+    Simple form (``CASE x WHEN v THEN r``): ``operand`` holds ``x`` and
+    each when's first element is the comparison VALUE expression — the
+    evaluator computes the operand once, not once per branch."""
+
+    whens: list  # [(bool_node | value_expr, value_expr), ...]
     default: object | None = None
+    operand: object | None = None
 
 
 @dataclass
@@ -905,10 +910,17 @@ class Parser:
 
     def _case_expr(self) -> Case:
         self.expect("kw", "case")
+        operand = None
+        nxt = self.peek()
+        if nxt is not None and not (nxt.kind == "kw" and nxt.value == "when"):
+            # simple CASE (`CASE x WHEN v THEN r ...`): desugars to the
+            # searched form with equality tests — a NULL operand matches
+            # no WHEN (standard SQL equality semantics)
+            operand = self._arith_expr()
         whens = []
         default = None
         while self.accept("kw", "when"):
-            cond = self._bool_expr()
+            cond = self._arith_expr() if operand is not None else self._bool_expr()
             self.expect("kw", "then")
             whens.append((cond, self._arith_expr()))
         if self.accept("kw", "else"):
@@ -916,7 +928,7 @@ class Parser:
         self.expect("kw", "end")
         if not whens:
             raise SqlError("CASE requires at least one WHEN")
-        return Case(whens, default)
+        return Case(whens, default, operand)
 
     def _substring_expr(self) -> Func:
         self.expect("kw", "substring")

@@ -1388,3 +1388,16 @@ class TestCastAndOffset:
         out = csession.execute("EXPLAIN SELECT k FROM t LIMIT 2 OFFSET 5")
         text = "\n".join(out.column(out.column_names[0]).to_pylist())
         assert "offset=5" in text
+
+    def test_simple_case_form(self, csession):
+        out = csession.execute(
+            "SELECT CASE k WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'many' END"
+            " AS w FROM t ORDER BY k"
+        )
+        assert out.column("w").to_pylist() == ["one", "two", "many", "many", "many"]
+        # NULL operand matches no WHEN → ELSE
+        out = csession.execute(
+            "SELECT CASE nullif(k, 1) WHEN 1 THEN 'x' ELSE 'e' END AS w"
+            " FROM t WHERE k = 1"
+        )
+        assert out.column("w").to_pylist() == ["e"]
