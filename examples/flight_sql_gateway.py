@@ -76,6 +76,53 @@ def main() -> None:
             print(f"order {want} amt:", row.column("amt").to_pylist())
         client.close_prepared(handle)
 
+        # explicit transactions — the flow an ADBC driver with
+        # autocommit=False issues: begin → staged ingest → commit; a
+        # rolled-back transaction leaves no rows behind
+        txn2 = client.begin_transaction()
+        client.ingest(
+            "events", pa.table({"ts": np.arange(100, 150), "kind": ["view"] * 50}),
+            transaction_id=txn2,
+        )
+        assert client.execute("SELECT count(*) AS c FROM events").column(
+            "c"
+        ).to_pylist() == [100]  # staged, not visible yet
+        client.commit(txn2)
+        assert client.execute("SELECT count(*) AS c FROM events").column(
+            "c"
+        ).to_pylist() == [150]
+        txn3 = client.begin_transaction()
+        client.ingest(
+            "events", pa.table({"ts": [999], "kind": ["oops"]}),
+            transaction_id=txn3,
+        )
+        client.rollback(txn3)
+        assert client.execute(
+            "SELECT count(*) AS c FROM events WHERE ts = 999"
+        ).column("c").to_pylist() == [0]
+        print("transactions: commit visible, rollback clean")
+
+        # the BI-tool surface: outer joins, CAST, OFFSET pagination
+        page2 = client.execute(
+            "SELECT cast(id AS string) AS sid, amt FROM orders"
+            " ORDER BY id LIMIT 5 OFFSET 5"
+        )
+        assert page2.column("sid").to_pylist() == ["5", "6", "7", "8", "9"]
+        client.execute_update(
+            "CREATE TABLE regions (region string, mgr string)"
+        )
+        client.execute_update(
+            "INSERT INTO regions VALUES ('emea', 'ana'), ('amer', 'bo')"
+        )
+        unmanaged = client.execute(
+            "SELECT count(*) AS c FROM orders"
+            " FULL OUTER JOIN regions ON orders.region = regions.region"
+            " WHERE mgr IS NULL"
+        )
+        # every apac order has no manager row; amer has no orders
+        assert unmanaged.column("c").to_pylist()[0] > 0
+        print("outer join over the wire:", unmanaged.column("c").to_pylist())
+
         # catalog metadata, as a JDBC driver would browse it
         print("tables:", client.get_tables().column("table_name").to_pylist())
         print(
