@@ -46,8 +46,14 @@ def _expr_columns(expr) -> set[str]:
         return _expr_columns(expr.arg) if expr.arg is not None else set()
     if isinstance(expr, ast.Case):
         cols = set()
+        if expr.operand is not None:
+            cols |= _expr_columns(expr.operand)
         for cond, value in expr.whens:
-            cols |= _node_columns(cond) | _expr_columns(value)
+            # simple-CASE whens hold VALUE expressions, not bool trees
+            cols |= (
+                _expr_columns(cond) if expr.operand is not None
+                else _node_columns(cond)
+            ) | _expr_columns(value)
         if expr.default is not None:
             cols |= _expr_columns(expr.default)
         return cols
@@ -130,8 +136,11 @@ def _subquery_outer_candidates(node) -> set[str]:
                 if a is not None:
                     walk_expr(a)
         elif isinstance(e, ast.Case):
+            if e.operand is not None:
+                walk_expr(e.operand)
             for cond, val in e.whens:
-                walk(cond)
+                # simple-CASE whens are VALUE expressions, not bool trees
+                (walk_expr if e.operand is not None else walk)(cond)
                 walk_expr(val)
             if e.default is not None:
                 walk_expr(e.default)
@@ -174,8 +183,11 @@ def _node_column_refs(node) -> list:
                 if a is not None:
                     expr_refs(a)
         elif isinstance(e, ast.Case):
+            if e.operand is not None:
+                expr_refs(e.operand)
             for cond, val in e.whens:
-                walk(cond)
+                # simple-CASE whens are VALUE expressions, not bool trees
+                (expr_refs if e.operand is not None else walk)(cond)
                 expr_refs(val)
             if e.default is not None:
                 expr_refs(e.default)

@@ -1401,3 +1401,24 @@ class TestCastAndOffset:
             " FROM t WHERE k = 1"
         )
         assert out.column("w").to_pylist() == ["e"]
+
+    def test_simple_case_in_correlated_contexts(self, tmp_warehouse):
+        """Simple-CASE operands/values must be visible to projection
+        pruning AND correlated-subquery scope resolution (fuzz + review
+        r5 findings)."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE o (rid bigint, k bigint, s string)")
+        s.execute("CREATE TABLE i (k bigint, b double, rid2 bigint)")
+        s.execute("INSERT INTO o VALUES (1, 1, 'red'), (2, 2, 'blue'), (3, 9, NULL)")
+        s.execute("INSERT INTO i VALUES (1, 5.0, 1), (2, 1.5, 2)")
+        out = s.execute(
+            "SELECT rid FROM o WHERE EXISTS (SELECT 1 FROM i WHERE i.k = o.k"
+            " AND i.b > CASE o.s WHEN 'red' THEN 1 ELSE 2 END)"
+        )
+        assert out.column("rid").to_pylist() == [1]
+        out = s.execute(
+            "SELECT CASE k WHEN (SELECT max(k) FROM i WHERE i.rid2 = o.rid)"
+            " THEN 1 ELSE 0 END AS c FROM o ORDER BY rid"
+        )
+        assert out.column("c").to_pylist() == [1, 1, 0]
