@@ -35,6 +35,22 @@ _TYPE_MAP = {
 }
 
 
+def _walk_case(case, on_value, on_bool) -> None:
+    """THE place that knows which CASE parts are VALUE expressions and
+    which are boolean trees: simple-form whens (``CASE x WHEN v``) hold
+    value expressions and the operand is a value; searched-form whens are
+    boolean conditions.  Every AST walker traverses CASE through this
+    helper so the distinction cannot drift per-walker (three walkers got
+    it independently wrong before it existed)."""
+    if case.operand is not None:
+        on_value(case.operand)
+    for cond, val in case.whens:
+        (on_value if case.operand is not None else on_bool)(cond)
+        on_value(val)
+    if case.default is not None:
+        on_value(case.default)
+
+
 def _expr_columns(expr) -> set[str]:
     """Columns a value expression references (does NOT descend into
     subqueries — those resolve against their own tables)."""
@@ -45,17 +61,12 @@ def _expr_columns(expr) -> set[str]:
     if isinstance(expr, ast.Agg):
         return _expr_columns(expr.arg) if expr.arg is not None else set()
     if isinstance(expr, ast.Case):
-        cols = set()
-        if expr.operand is not None:
-            cols |= _expr_columns(expr.operand)
-        for cond, value in expr.whens:
-            # simple-CASE whens hold VALUE expressions, not bool trees
-            cols |= (
-                _expr_columns(cond) if expr.operand is not None
-                else _node_columns(cond)
-            ) | _expr_columns(value)
-        if expr.default is not None:
-            cols |= _expr_columns(expr.default)
+        cols: set[str] = set()
+        _walk_case(
+            expr,
+            lambda e: cols.update(_expr_columns(e)),
+            lambda n: cols.update(_node_columns(n)),
+        )
         return cols
     if isinstance(expr, ast.Func):
         cols = set()
@@ -136,14 +147,7 @@ def _subquery_outer_candidates(node) -> set[str]:
                 if a is not None:
                     walk_expr(a)
         elif isinstance(e, ast.Case):
-            if e.operand is not None:
-                walk_expr(e.operand)
-            for cond, val in e.whens:
-                # simple-CASE whens are VALUE expressions, not bool trees
-                (walk_expr if e.operand is not None else walk)(cond)
-                walk_expr(val)
-            if e.default is not None:
-                walk_expr(e.default)
+            _walk_case(e, walk_expr, walk)
 
     # accept either a boolean node or a bare value expression
     if isinstance(e := node, (ast.ScalarSubquery, ast.Arith, ast.Agg, ast.Func,
@@ -183,14 +187,7 @@ def _node_column_refs(node) -> list:
                 if a is not None:
                     expr_refs(a)
         elif isinstance(e, ast.Case):
-            if e.operand is not None:
-                expr_refs(e.operand)
-            for cond, val in e.whens:
-                # simple-CASE whens are VALUE expressions, not bool trees
-                (expr_refs if e.operand is not None else walk)(cond)
-                expr_refs(val)
-            if e.default is not None:
-                expr_refs(e.default)
+            _walk_case(e, expr_refs, walk)
 
     def walk(n):
         if isinstance(n, ast.Compare):

@@ -107,7 +107,61 @@ def test_random_query_matches_pandas(tmp_path, seed):
     rng = np.random.default_rng(seed)
     t1, t2 = _frames(rng)
     s = _session(tmp_path, t1, t2)
-    shape = int(rng.integers(0, 3))
+    shape = int(rng.integers(0, 5))
+
+    if shape == 3:
+        # join of a random kind + POST-JOIN WHERE on one side's payload
+        # (under right/full joins the predicate must not push below the
+        # join — it would drop NULL-extended rows' partners)
+        kind, how = [
+            ("JOIN", "inner"), ("LEFT JOIN", "left"),
+            ("RIGHT JOIN", "right"), ("FULL OUTER JOIN", "outer"),
+        ][int(rng.integers(0, 4))]
+        col = "a" if rng.random() < 0.5 else "b"
+        lo = float(np.round(rng.normal(), 2))
+        sql = (
+            f"SELECT rid, rid2 FROM t1 {kind} t2 ON t1.k = t2.k"
+            f" WHERE {col} > {lo} ORDER BY rid, rid2"
+        )
+        merged = t1.merge(t2, on="k", how=how)
+        want = merged.loc[merged[col] > lo, ["rid", "rid2"]]
+        want = want.sort_values(
+            ["rid", "rid2"], na_position="last"
+        ).reset_index(drop=True)
+        _compare(s.execute(sql), want)
+        return
+
+    if shape == 4:
+        # [NOT] IN subquery with SQL three-valued logic: probe side (t1.a)
+        # and subquery side (t2.b) both carry NULLs
+        negated = rng.random() < 0.5
+        with_where = rng.random() < 0.5
+        c = float(np.round(rng.normal(), 2))
+        where = f" WHERE b > {c}" if with_where else ""
+        sql = (
+            f"SELECT rid FROM t1 WHERE a {'NOT ' if negated else ''}IN"
+            f" (SELECT b FROM t2{where}) ORDER BY rid"
+        )
+        sub = t2.loc[t2["b"] > c, "b"] if with_where else t2["b"]
+        values = set(sub.dropna().tolist())
+        set_has_null = bool(sub.isna().any())
+        set_empty = len(sub) == 0
+        keep = []
+        for _, row in t1.iterrows():
+            x = row["a"]
+            x_null = pd.isna(x)
+            if not negated:
+                keep.append((not x_null) and x in values)
+            elif set_empty:
+                keep.append(True)  # NOT IN () is TRUE, even for NULL x
+            else:
+                keep.append(
+                    (not x_null) and (not set_has_null) and x not in values
+                )
+        want = pd.DataFrame({"rid": t1.loc[keep, "rid"]})
+        want = want.sort_values("rid").reset_index(drop=True)
+        _compare(s.execute(sql), want)
+        return
 
     if shape == 0:
         # single table: scalar expr + WHERE + ORDER + LIMIT/OFFSET
