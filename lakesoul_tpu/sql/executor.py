@@ -39,9 +39,11 @@ def _walk_case(case, on_value, on_bool) -> None:
     """THE place that knows which CASE parts are VALUE expressions and
     which are boolean trees: simple-form whens (``CASE x WHEN v``) hold
     value expressions and the operand is a value; searched-form whens are
-    boolean conditions.  Every AST walker traverses CASE through this
-    helper so the distinction cannot drift per-walker (three walkers got
-    it independently wrong before it existed)."""
+    boolean conditions.  Every COLLECTING walker traverses CASE through
+    this helper so the distinction cannot drift per-walker (three walkers
+    got it independently wrong before it existed); the REBUILDING
+    rewriters (_subst_aggs, _map_node_cols) encode the same form
+    dispatch inline because they return new nodes."""
     if case.operand is not None:
         on_value(case.operand)
     for cond, val in case.whens:
@@ -237,10 +239,15 @@ def _walk_aggs(expr):
         yield from _walk_aggs(expr.left)
         yield from _walk_aggs(expr.right)
     elif isinstance(expr, ast.Case):
-        for cond, value in expr.whens:
-            yield from _walk_aggs(value)
-        if expr.default is not None:
-            yield from _walk_aggs(expr.default)
+        found: list = []
+        _walk_case(
+            expr,
+            lambda e: found.extend(_walk_aggs(e)),
+            lambda n: found.extend(
+                a for sub in _bool_exprs(n) for a in _walk_aggs(sub)
+            ),
+        )
+        yield from found
     elif isinstance(expr, ast.Func):
         for a in expr.args:
             if a is not None:
@@ -274,10 +281,16 @@ def _subst_aggs(expr, agg_col: dict):
             expr.op, _subst_aggs(expr.left, agg_col), _subst_aggs(expr.right, agg_col)
         )
     if isinstance(expr, ast.Case):
+        # conds carry aggregates too: searched CASE WHEN count(*) > 2 ...,
+        # simple CASE sum(x) WHEN ... — substitute per the form
+        subst_cond = (
+            (lambda c: _subst_aggs(c, agg_col)) if expr.operand is not None
+            else (lambda c: _subst_aggs_bool(c, agg_col))
+        )
         return ast.Case(
-            [(c, _subst_aggs(v, agg_col)) for c, v in expr.whens],
+            [(subst_cond(c), _subst_aggs(v, agg_col)) for c, v in expr.whens],
             _subst_aggs(expr.default, agg_col) if expr.default is not None else None,
-            expr.operand,
+            _subst_aggs(expr.operand, agg_col) if expr.operand is not None else None,
         )
     if isinstance(expr, ast.Func):
         return ast.Func(

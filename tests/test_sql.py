@@ -1422,3 +1422,23 @@ class TestCastAndOffset:
             " THEN 1 ELSE 0 END AS c FROM o ORDER BY rid"
         )
         assert out.column("c").to_pylist() == [1, 1, 0]
+
+    def test_case_over_aggregates(self, tmp_warehouse):
+        """Aggregates inside CASE conds/operands (searched AND simple forms)
+        collect and substitute like any other aggregate expression."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (k bigint, a double)")
+        s.execute(
+            "INSERT INTO t VALUES (1, 1.0), (1, 2.0), (1, 3.0), (2, 4.0)"
+        )
+        out = s.execute(
+            "SELECT k, CASE WHEN count(*) > 2 THEN 'big' ELSE 'small' END AS c"
+            " FROM t GROUP BY k ORDER BY k"
+        )
+        assert out.column("c").to_pylist() == ["big", "small"]
+        out = s.execute(
+            "SELECT k, CASE count(*) WHEN 3 THEN 'three' ELSE 'other' END AS c"
+            " FROM t GROUP BY k ORDER BY k"
+        )
+        assert out.column("c").to_pylist() == ["three", "other"]

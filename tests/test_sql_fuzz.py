@@ -23,6 +23,13 @@ from lakesoul_tpu.sql import SqlSession
 
 N_SEEDS = 120
 
+# (SQL join spelling, pandas merge how) — shared by every join shape so a
+# one-sided edit cannot silently narrow one shape's coverage
+JOIN_KINDS = [
+    ("JOIN", "inner"), ("LEFT JOIN", "left"),
+    ("RIGHT JOIN", "right"), ("FULL OUTER JOIN", "outer"),
+]
+
 
 def _frames(rng):
     n1 = int(rng.integers(8, 40))
@@ -107,16 +114,42 @@ def test_random_query_matches_pandas(tmp_path, seed):
     rng = np.random.default_rng(seed)
     t1, t2 = _frames(rng)
     s = _session(tmp_path, t1, t2)
-    shape = int(rng.integers(0, 5))
+    shape = int(rng.integers(0, 7))
+
+    if shape == 5:
+        # window function: row_number/rank PARTITION BY k ORDER BY rid
+        fn = ["row_number()", "rank()"][int(rng.integers(0, 2))]
+        sql = (
+            f"SELECT rid, {fn} OVER (PARTITION BY k ORDER BY rid) AS w"
+            " FROM t1 ORDER BY rid"
+        )
+        want = t1.sort_values("rid").copy()
+        # rid is unique, so rank == row_number within each partition
+        want["w"] = want.groupby("k")["rid"].rank(method="first").astype("int64")
+        want = want[["rid", "w"]].sort_values("rid").reset_index(drop=True)
+        _compare(s.execute(sql), want)
+        return
+
+    if shape == 6:
+        # HAVING over a grouped aggregate
+        thresh = int(rng.integers(1, 5))
+        sql = (
+            "SELECT k, count(*) AS n FROM t1 GROUP BY k"
+            f" HAVING count(*) >= {thresh} ORDER BY k"
+        )
+        grouped = t1.groupby("k").size()
+        grouped = grouped[grouped >= thresh]
+        want = pd.DataFrame({
+            "k": grouped.index.astype("int64"), "n": grouped.values.astype("int64"),
+        }).sort_values("k").reset_index(drop=True)
+        _compare(s.execute(sql), want)
+        return
 
     if shape == 3:
         # join of a random kind + POST-JOIN WHERE on one side's payload
         # (under right/full joins the predicate must not push below the
         # join — it would drop NULL-extended rows' partners)
-        kind, how = [
-            ("JOIN", "inner"), ("LEFT JOIN", "left"),
-            ("RIGHT JOIN", "right"), ("FULL OUTER JOIN", "outer"),
-        ][int(rng.integers(0, 4))]
+        kind, how = JOIN_KINDS[int(rng.integers(0, len(JOIN_KINDS)))]
         col = "a" if rng.random() < 0.5 else "b"
         lo = float(np.round(rng.normal(), 2))
         sql = (
@@ -181,10 +214,7 @@ def test_random_query_matches_pandas(tmp_path, seed):
 
     if shape == 1:
         # two-table join of a random kind, keys + one payload per side
-        kind, how = [
-            ("JOIN", "inner"), ("LEFT JOIN", "left"),
-            ("RIGHT JOIN", "right"), ("FULL OUTER JOIN", "outer"),
-        ][int(rng.integers(0, 4))]
+        kind, how = JOIN_KINDS[int(rng.integers(0, len(JOIN_KINDS)))]
         sql = (
             f"SELECT rid, rid2, a, b FROM t1 {kind} t2 ON t1.k = t2.k"
             " ORDER BY rid, rid2"
