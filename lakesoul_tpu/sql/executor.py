@@ -477,9 +477,11 @@ def _slice_limit_offset(out: pa.Table, stmt) -> pa.Table:
 
 def _broadcast(val, n: int):
     """Expression results may be scalars (column-free expressions); broadcast
-    them to the table's row count."""
+    them to the table's row count.  The scalar's TYPE is preserved — on a
+    zero-row table an untyped pa.array([]) would come out null-typed and
+    break downstream kernels (coalesce, comparisons)."""
     if isinstance(val, pa.Scalar):
-        return pa.chunked_array([pa.array([val.as_py()] * n)])
+        return pa.chunked_array([pa.array([val.as_py()] * n, type=val.type)])
     if isinstance(val, pa.Array):
         return pa.chunked_array([val])
     return val

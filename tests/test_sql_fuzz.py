@@ -109,125 +109,139 @@ def _compare(got: pa.Table, want: pd.DataFrame):
                 assert gv == wv, (col, gv, wv)
 
 
-@pytest.mark.parametrize("seed", range(N_SEEDS))
-def test_random_query_matches_pandas(tmp_path, seed):
-    rng = np.random.default_rng(seed)
-    t1, t2 = _frames(rng)
-    s = _session(tmp_path, t1, t2)
-    shape = int(rng.integers(0, 7))
+def _shape_setop(s, t1, t2, rng):
+    # set operation between two selections of the same column
+    op, fn = [
+        ("UNION", lambda l, r: sorted(set(l) | set(r))),
+        ("UNION ALL", lambda l, r: sorted(l + r)),
+        ("INTERSECT", lambda l, r: sorted(set(l) & set(r))),
+        ("EXCEPT", lambda l, r: sorted(set(l) - set(r))),
+    ][int(rng.integers(0, 4))]
+    c1 = int(rng.integers(2, 10))
+    c2 = int(rng.integers(2, 10))
+    sql = (
+        f"SELECT k FROM t1 WHERE k < {c1} {op}"
+        f" SELECT k FROM t2 WHERE k < {c2} ORDER BY k"
+    )
+    left = t1.loc[t1["k"] < c1, "k"].tolist()
+    right = t2.loc[t2["k"] < c2, "k"].tolist()
+    want = pd.DataFrame({"k": fn(left, right)}, dtype="int64")
+    _compare(s.execute(sql), want)
 
-    if shape == 5:
-        # window function: row_number/rank PARTITION BY k ORDER BY rid
-        fn = ["row_number()", "rank()"][int(rng.integers(0, 2))]
-        sql = (
-            f"SELECT rid, {fn} OVER (PARTITION BY k ORDER BY rid) AS w"
-            " FROM t1 ORDER BY rid"
-        )
-        want = t1.sort_values("rid").copy()
-        # rid is unique, so rank == row_number within each partition
-        want["w"] = want.groupby("k")["rid"].rank(method="first").astype("int64")
-        want = want[["rid", "w"]].sort_values("rid").reset_index(drop=True)
-        _compare(s.execute(sql), want)
-        return
 
-    if shape == 6:
-        # HAVING over a grouped aggregate
-        thresh = int(rng.integers(1, 5))
-        sql = (
-            "SELECT k, count(*) AS n FROM t1 GROUP BY k"
-            f" HAVING count(*) >= {thresh} ORDER BY k"
-        )
-        grouped = t1.groupby("k").size()
-        grouped = grouped[grouped >= thresh]
-        want = pd.DataFrame({
-            "k": grouped.index.astype("int64"), "n": grouped.values.astype("int64"),
-        }).sort_values("k").reset_index(drop=True)
-        _compare(s.execute(sql), want)
-        return
+def _shape_window(s, t1, t2, rng):
+    # window function: row_number/rank PARTITION BY k ORDER BY rid
+    fn = ["row_number()", "rank()"][int(rng.integers(0, 2))]
+    sql = (
+        f"SELECT rid, {fn} OVER (PARTITION BY k ORDER BY rid) AS w"
+        " FROM t1 ORDER BY rid"
+    )
+    want = t1.sort_values("rid").copy()
+    # rid is unique, so rank == row_number within each partition
+    want["w"] = want.groupby("k")["rid"].rank(method="first").astype("int64")
+    want = want[["rid", "w"]].sort_values("rid").reset_index(drop=True)
+    _compare(s.execute(sql), want)
 
-    if shape == 3:
-        # join of a random kind + POST-JOIN WHERE on one side's payload
-        # (under right/full joins the predicate must not push below the
-        # join — it would drop NULL-extended rows' partners)
-        kind, how = JOIN_KINDS[int(rng.integers(0, len(JOIN_KINDS)))]
-        col = "a" if rng.random() < 0.5 else "b"
-        lo = float(np.round(rng.normal(), 2))
-        sql = (
-            f"SELECT rid, rid2 FROM t1 {kind} t2 ON t1.k = t2.k"
-            f" WHERE {col} > {lo} ORDER BY rid, rid2"
-        )
-        merged = t1.merge(t2, on="k", how=how)
-        want = merged.loc[merged[col] > lo, ["rid", "rid2"]]
-        want = want.sort_values(
-            ["rid", "rid2"], na_position="last"
-        ).reset_index(drop=True)
-        _compare(s.execute(sql), want)
-        return
 
-    if shape == 4:
-        # [NOT] IN subquery with SQL three-valued logic: probe side (t1.a)
-        # and subquery side (t2.b) both carry NULLs
-        negated = rng.random() < 0.5
-        with_where = rng.random() < 0.5
-        c = float(np.round(rng.normal(), 2))
-        where = f" WHERE b > {c}" if with_where else ""
-        sql = (
-            f"SELECT rid FROM t1 WHERE a {'NOT ' if negated else ''}IN"
-            f" (SELECT b FROM t2{where}) ORDER BY rid"
-        )
-        sub = t2.loc[t2["b"] > c, "b"] if with_where else t2["b"]
-        values = set(sub.dropna().tolist())
-        set_has_null = bool(sub.isna().any())
-        set_empty = len(sub) == 0
-        keep = []
-        for _, row in t1.iterrows():
-            x = row["a"]
-            x_null = pd.isna(x)
-            if not negated:
-                keep.append((not x_null) and x in values)
-            elif set_empty:
-                keep.append(True)  # NOT IN () is TRUE, even for NULL x
-            else:
-                keep.append(
-                    (not x_null) and (not set_has_null) and x not in values
-                )
-        want = pd.DataFrame({"rid": t1.loc[keep, "rid"]})
-        want = want.sort_values("rid").reset_index(drop=True)
-        _compare(s.execute(sql), want)
-        return
+def _shape_having(s, t1, t2, rng):
+    # HAVING over a grouped aggregate
+    thresh = int(rng.integers(1, 5))
+    sql = (
+        "SELECT k, count(*) AS n FROM t1 GROUP BY k"
+        f" HAVING count(*) >= {thresh} ORDER BY k"
+    )
+    grouped = t1.groupby("k").size()
+    grouped = grouped[grouped >= thresh]
+    want = pd.DataFrame({
+        "k": grouped.index.astype("int64"), "n": grouped.values.astype("int64"),
+    }).sort_values("k").reset_index(drop=True)
+    _compare(s.execute(sql), want)
 
-    if shape == 0:
-        # single table: scalar expr + WHERE + ORDER + LIMIT/OFFSET
-        expr, series, name = _oracle_scalar(t1, rng)
-        lo = float(np.round(rng.normal(), 2))
-        limit = int(rng.integers(1, 20))
-        offset = int(rng.integers(0, 5))
-        sql = (
-            f"SELECT rid, {expr} AS {name} FROM t1 WHERE a > {lo}"
-            f" ORDER BY rid LIMIT {limit} OFFSET {offset}"
-        )
-        mask = t1["a"] > lo  # NaN > x is False: matches SQL NULL → filtered
-        want = pd.DataFrame({"rid": t1.loc[mask, "rid"], name: series[mask]})
-        want = want.sort_values("rid").iloc[offset:offset + limit]
-        _compare(s.execute(sql), want.reset_index(drop=True))
-        return
 
-    if shape == 1:
-        # two-table join of a random kind, keys + one payload per side
-        kind, how = JOIN_KINDS[int(rng.integers(0, len(JOIN_KINDS)))]
-        sql = (
-            f"SELECT rid, rid2, a, b FROM t1 {kind} t2 ON t1.k = t2.k"
-            " ORDER BY rid, rid2"
-        )
-        want = t1.merge(t2, on="k", how=how)[["rid", "rid2", "a", "b"]]
-        want = want.sort_values(
-            ["rid", "rid2"], na_position="last"
-        ).reset_index(drop=True)
-        got = s.execute(sql)
-        # engine sorts NULL keys last too (pyarrow default); compare sorted
-        _compare(got, want)
-        return
+def _shape_join_where(s, t1, t2, rng):
+    # join of a random kind + POST-JOIN WHERE on one side's payload
+    # (under right/full joins the predicate must not push below the
+    # join — it would drop NULL-extended rows' partners)
+    kind, how = JOIN_KINDS[int(rng.integers(0, len(JOIN_KINDS)))]
+    col = "a" if rng.random() < 0.5 else "b"
+    lo = float(np.round(rng.normal(), 2))
+    sql = (
+        f"SELECT rid, rid2 FROM t1 {kind} t2 ON t1.k = t2.k"
+        f" WHERE {col} > {lo} ORDER BY rid, rid2"
+    )
+    merged = t1.merge(t2, on="k", how=how)
+    want = merged.loc[merged[col] > lo, ["rid", "rid2"]]
+    want = want.sort_values(
+        ["rid", "rid2"], na_position="last"
+    ).reset_index(drop=True)
+    _compare(s.execute(sql), want)
 
+
+def _shape_in_subquery(s, t1, t2, rng):
+    # [NOT] IN subquery with SQL three-valued logic: probe side (t1.a)
+    # and subquery side (t2.b) both carry NULLs
+    negated = rng.random() < 0.5
+    with_where = rng.random() < 0.5
+    c = float(np.round(rng.normal(), 2))
+    where = f" WHERE b > {c}" if with_where else ""
+    sql = (
+        f"SELECT rid FROM t1 WHERE a {'NOT ' if negated else ''}IN"
+        f" (SELECT b FROM t2{where}) ORDER BY rid"
+    )
+    sub = t2.loc[t2["b"] > c, "b"] if with_where else t2["b"]
+    values = set(sub.dropna().tolist())
+    set_has_null = bool(sub.isna().any())
+    set_empty = len(sub) == 0
+    keep = []
+    for _, row in t1.iterrows():
+        x = row["a"]
+        x_null = pd.isna(x)
+        if not negated:
+            keep.append((not x_null) and x in values)
+        elif set_empty:
+            keep.append(True)  # NOT IN () is TRUE, even for NULL x
+        else:
+            keep.append(
+                (not x_null) and (not set_has_null) and x not in values
+            )
+    want = pd.DataFrame({"rid": t1.loc[keep, "rid"]})
+    want = want.sort_values("rid").reset_index(drop=True)
+    _compare(s.execute(sql), want)
+
+
+def _shape_scalar_where(s, t1, t2, rng):
+    # single table: scalar expr + WHERE + ORDER + LIMIT/OFFSET
+    expr, series, name = _oracle_scalar(t1, rng)
+    lo = float(np.round(rng.normal(), 2))
+    limit = int(rng.integers(1, 20))
+    offset = int(rng.integers(0, 5))
+    sql = (
+        f"SELECT rid, {expr} AS {name} FROM t1 WHERE a > {lo}"
+        f" ORDER BY rid LIMIT {limit} OFFSET {offset}"
+    )
+    mask = t1["a"] > lo  # NaN > x is False: matches SQL NULL → filtered
+    want = pd.DataFrame({"rid": t1.loc[mask, "rid"], name: series[mask]})
+    want = want.sort_values("rid").iloc[offset:offset + limit]
+    _compare(s.execute(sql), want.reset_index(drop=True))
+
+
+def _shape_join(s, t1, t2, rng):
+    # two-table join of a random kind, keys + one payload per side
+    kind, how = JOIN_KINDS[int(rng.integers(0, len(JOIN_KINDS)))]
+    sql = (
+        f"SELECT rid, rid2, a, b FROM t1 {kind} t2 ON t1.k = t2.k"
+        " ORDER BY rid, rid2"
+    )
+    want = t1.merge(t2, on="k", how=how)[["rid", "rid2", "a", "b"]]
+    want = want.sort_values(
+        ["rid", "rid2"], na_position="last"
+    ).reset_index(drop=True)
+    got = s.execute(sql)
+    # engine sorts NULL keys last too (pyarrow default); compare sorted
+    _compare(got, want)
+
+
+def _shape_aggregate(s, t1, t2, rng):
     # aggregate: GROUP BY s with a random aggregate over a
     fn, pdfn = [
         ("count(a)", "count"), ("sum(a)", "sum"), ("min(a)", "min"),
@@ -245,3 +259,20 @@ def test_random_query_matches_pandas(tmp_path, seed):
         want["v"] = want["v"].astype("int64")
     want = want.sort_values("g").reset_index(drop=True)
     _compare(s.execute(sql), want)
+
+
+_SHAPES = [
+    _shape_scalar_where, _shape_join, _shape_aggregate, _shape_join_where,
+    _shape_in_subquery, _shape_window, _shape_having, _shape_setop,
+]
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_random_query_matches_pandas(tmp_path, seed):
+    """EVERY shape runs for EVERY seed (N_SEEDS differential runs per
+    shape), each with its own deterministic generator."""
+    rng = np.random.default_rng(seed)
+    t1, t2 = _frames(rng)
+    s = _session(tmp_path, t1, t2)
+    for i, shape in enumerate(_SHAPES):
+        shape(s, t1, t2, np.random.default_rng([seed, i]))
