@@ -354,15 +354,23 @@ class LakeSoulTable:
         mask[matched] = True
         return mask
 
-    def _rewrite_where(self, flt: Filter, mutate) -> int:
+    def _rewrite_where(self, flt: Filter | None, mutate, *, mask_fn=None) -> int:
         """Shared engine for row-level UPDATE/DELETE (reference:
         lakesoul-datafusion update/delete planning): per matching partition,
         rewrite the merged data with ``mutate(table, mask)`` applied and
         commit an UpdateCommit (snapshot replace, conflict checked against
-        the read head).  Returns affected row count."""
+        the read head).  Returns affected row count.
+
+        ``mask_fn(table) -> bool ndarray`` replaces the Filter-derived match
+        mask for predicates the pushdown AST cannot express (function
+        calls, subqueries — the SQL layer's general evaluator); with no
+        Filter, every partition is scanned."""
         client = self.catalog.client
         total_affected = 0
-        constraints = self._partition_constraints(flt, self._info.range_partition_columns)
+        constraints = (
+            self._partition_constraints(flt, self._info.range_partition_columns)
+            if flt is not None else {}
+        )
         heads = client._select_partitions(self._info, constraints or None)
         for head in heads:
             units = client.get_scan_plan_partitions(
@@ -386,7 +394,10 @@ class LakeSoulTable:
             if not tables:
                 continue
             merged = pa.concat_tables(tables)
-            mask = self._match_mask(merged, flt)
+            mask = (
+                mask_fn(merged) if mask_fn is not None
+                else self._match_mask(merged, flt)
+            )
             affected = int(mask.sum())
             if affected == 0:
                 continue
@@ -400,22 +411,27 @@ class LakeSoulTable:
             total_affected += affected
         return total_affected
 
-    def delete_where(self, flt: Filter) -> int:
+    def delete_where(self, flt: Filter | None, *, mask_fn=None) -> int:
         """Row-level delete: rewrite matching partitions without the matching
         rows.  Returns the number of rows deleted."""
 
         def mutate(table, mask):
             return table.filter(pa.array(~mask))
 
-        return self._rewrite_where(flt, mutate)
+        return self._rewrite_where(flt, mutate, mask_fn=mask_fn)
 
-    def update_where(self, flt: Filter, assignments: dict) -> int:
+    def update_where(self, flt: Filter | None, assignments: dict, *,
+                     mask_fn=None, expr_assignments: dict | None = None) -> int:
         """Row-level update: SET column=value on rows matching the filter.
-        Returns the number of rows updated."""
+        ``assignments`` maps columns to plain Python literals;
+        ``expr_assignments`` maps columns to callables ``fn(table) ->
+        Array`` evaluated over the merged partition (the SQL layer's
+        SET-expression path).  Returns the number of rows updated."""
         import pyarrow.compute as pc
 
+        expr_assignments = expr_assignments or {}
         schema = self.schema
-        for col_name in assignments:
+        for col_name in (*assignments, *expr_assignments):
             if col_name not in schema.names:
                 raise MetadataError(f"unknown column {col_name!r} in UPDATE")
             if col_name in self._info.primary_keys:
@@ -426,17 +442,43 @@ class LakeSoulTable:
                 raise MetadataError("cannot UPDATE a range-partition column")
 
         def mutate(table, mask):
+            import numpy as np
+
             mask_arr = pa.array(mask)
+            # SET expressions evaluate over the MATCHED rows only (standard
+            # SQL): a non-matching row must not be able to abort the
+            # statement (e.g. SET v = 10 / k WHERE k <> 0)
+            matched = table.take(pa.array(np.nonzero(mask)[0]))
             arrays = []
             for fld in schema:
                 col = table.column(fld.name)
                 if fld.name in assignments:
                     val = pa.scalar(assignments[fld.name], type=fld.type)
                     col = pc.if_else(mask_arr, val, col)
+                elif fld.name in expr_assignments:
+                    try:
+                        new = pc.cast(
+                            expr_assignments[fld.name](matched),
+                            options=pc.CastOptions(
+                                target_type=fld.type, allow_float_truncate=True
+                            ),
+                        )
+                    except (pa.lib.ArrowInvalid,
+                            pa.lib.ArrowNotImplementedError) as e:
+                        raise MetadataError(
+                            f"UPDATE SET {fld.name}: CAST failed: {e}"
+                        )
+                    if isinstance(new, pa.ChunkedArray):
+                        new = new.combine_chunks()
+                    col = pc.replace_with_mask(
+                        col.combine_chunks() if isinstance(col, pa.ChunkedArray)
+                        else col,
+                        mask_arr, new,
+                    )
                 arrays.append(col)
             return pa.table(arrays, schema=schema)
 
-        return self._rewrite_where(flt, mutate)
+        return self._rewrite_where(flt, mutate, mask_fn=mask_fn)
 
     # ----------------------------------------------------------- maintenance
     def rollback(

@@ -651,14 +651,34 @@ class SqlSession:
         if isinstance(stmt, ast.Call):
             return self._call(stmt)
         if isinstance(stmt, ast.Update):
-            n = self.catalog.table(stmt.table, self.namespace).update_where(
-                _where_to_filter(stmt.where), stmt.assignments
-            )
+            flt, mask_fn = self._dml_predicate(stmt.where)
+            literals: dict = {}
+            exprs: dict = {}
+            for col, val in stmt.assignments.items():
+                if isinstance(val, ast.Literal):
+                    literals[col] = val.value
+                else:
+                    # evaluated over the MATCHED rows at rewrite time
+                    exprs[col] = (
+                        lambda tbl, e=val: _broadcast(
+                            self._eval_expr(e, tbl), len(tbl)
+                        )
+                    )
+            try:
+                n = self.catalog.table(stmt.table, self.namespace).update_where(
+                    flt, literals, mask_fn=mask_fn, expr_assignments=exprs
+                )
+            finally:
+                self._stmt_query_memo = None
             return pa.table({"updated": pa.array([n], pa.int64())})
         if isinstance(stmt, ast.Delete):
-            n = self.catalog.table(stmt.table, self.namespace).delete_where(
-                _where_to_filter(stmt.where)
-            )
+            flt, mask_fn = self._dml_predicate(stmt.where)
+            try:
+                n = self.catalog.table(stmt.table, self.namespace).delete_where(
+                    flt, mask_fn=mask_fn
+                )
+            finally:
+                self._stmt_query_memo = None
             return pa.table({"deleted": pa.array([n], pa.int64())})
         if isinstance(stmt, ast.Describe):
             t = self.catalog.table(stmt.table, self.namespace)
@@ -670,6 +690,46 @@ class SqlSession:
                 }
             )
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _dml_predicate(self, where):
+        """UPDATE/DELETE WHERE → (pushdown Filter, mask_fn).
+
+        Fully pushdown-expressible predicates keep the Filter fast path
+        (partition pruning + vectorized match, no general evaluator).
+        Otherwise the GENERAL predicate — functions, CASE, subqueries —
+        evaluates through the full boolean evaluator per partition, while
+        any pushable AND-conjuncts still ride along as a Filter so
+        partition pruning survives mixed predicates.  Uncorrelated
+        subqueries are memoized for the STATEMENT, so every partition sees
+        the same pre-statement snapshot of any table the subquery reads
+        (partition 1's committed rewrite must not change partition 2's
+        predicate)."""
+        import numpy as np
+
+        try:
+            return _where_to_filter(where), None
+        except SqlError:
+            pass
+        push_nodes, _residual = _split_where(where)
+        flt = None
+        if push_nodes:
+            flt = _where_to_filter(push_nodes[0])
+            for n in push_nodes[1:]:
+                flt = flt & _where_to_filter(n)
+
+        def mask_fn(table: pa.Table):
+            # arm the statement-scoped subquery memo (cleared by the
+            # Update/Delete branch once the whole statement commits)
+            if getattr(self, "_stmt_query_memo", None) is None:
+                self._stmt_query_memo = {}
+            mask = pc.fill_null(
+                _broadcast(self._eval_bool(where, table), len(table)), False
+            )
+            if isinstance(mask, pa.ChunkedArray):
+                mask = mask.combine_chunks()
+            return np.asarray(mask.to_numpy(zero_copy_only=False), dtype=bool)
+
+        return flt, mask_fn
 
     _CALL_ARITY = {"compact": 1, "rollback": 2, "build_vector_index": 2, "clean": 0}
 
@@ -701,10 +761,23 @@ class SqlSession:
 
     # ------------------------------------------------------------------- DQL
     def _query(self, stmt) -> pa.Table:
-        """Select or set-op subtree (derived tables / CTE bodies)."""
+        """Select or set-op subtree (derived tables / CTE bodies).
+
+        During a general-predicate DML statement, results are memoized per
+        AST node (the statement is parsed once, so each subquery node is
+        stable): every partition's mask evaluation then reads the SAME
+        pre-statement snapshot instead of re-scanning tables this very
+        statement may already have rewritten."""
+        memo = getattr(self, "_stmt_query_memo", None)
+        if memo is not None and id(stmt) in memo:
+            return memo[id(stmt)]
         if isinstance(stmt, ast.SetOp):
-            return self._set_op(stmt)
-        return self._select(stmt)
+            out = self._set_op(stmt)
+        else:
+            out = self._select(stmt)
+        if memo is not None:
+            memo[id(stmt)] = out
+        return out
 
     def _set_op(self, stmt: ast.SetOp) -> pa.Table:
         """UNION [ALL] / INTERSECT / EXCEPT with SQL set semantics (distinct

@@ -1207,7 +1207,8 @@ class Parser:
         while True:
             col = self.ident()
             self.expect("op", "=")
-            assignments[col] = self._value()
+            # full value expressions (SET v = abs(v) + 1), not just literals
+            assignments[col] = self._arith_expr()
             if not self.accept("op", ","):
                 break
         self.expect("kw", "where")  # whole-table updates must be explicit

@@ -1442,3 +1442,105 @@ class TestCastAndOffset:
             " FROM t GROUP BY k ORDER BY k"
         )
         assert out.column("c").to_pylist() == ["three", "other"]
+
+
+class TestDmlExpressions:
+    """UPDATE SET <expr> and general (non-pushdown) WHERE predicates for
+    UPDATE/DELETE (r5) — DataFusion accepts arbitrary expressions here."""
+
+    @pytest.fixture()
+    def dsession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (k bigint PRIMARY KEY, v double, s string)")
+        s.execute(
+            "INSERT INTO t VALUES (1, -1.5, 'low'), (2, 2.5, 'high'),"
+            " (3, -3.5, 'LOW')"
+        )
+        return s
+
+    def test_update_set_expression(self, dsession):
+        out = dsession.execute("UPDATE t SET v = abs(v) WHERE lower(s) = 'low'")
+        assert out.column("updated").to_pylist() == [2]
+        got = dsession.execute("SELECT v FROM t ORDER BY k")
+        assert got.column("v").to_pylist() == [1.5, 2.5, 3.5]
+
+    def test_update_set_arithmetic_on_self(self, dsession):
+        dsession.execute("UPDATE t SET v = v * 2 + 1 WHERE k = 2")
+        got = dsession.execute("SELECT v FROM t WHERE k = 2")
+        assert got.column("v").to_pylist() == [6.0]
+
+    def test_update_set_case_expression(self, dsession):
+        dsession.execute(
+            "UPDATE t SET s = CASE WHEN v > 0 THEN 'pos' ELSE 'neg' END"
+            " WHERE k > 0"
+        )
+        got = dsession.execute("SELECT s FROM t ORDER BY k")
+        assert got.column("s").to_pylist() == ["neg", "pos", "neg"]
+
+    def test_delete_with_function_predicate(self, dsession):
+        out = dsession.execute("DELETE FROM t WHERE upper(s) = 'LOW'")
+        assert out.column("deleted").to_pylist() == [2]
+        assert dsession.execute("SELECT count(*) AS c FROM t") \
+            .column("c").to_pylist() == [1]
+
+    def test_delete_with_subquery_predicate(self, dsession):
+        dsession.execute("CREATE TABLE dead (k bigint)")
+        dsession.execute("INSERT INTO dead VALUES (1), (3)")
+        out = dsession.execute("DELETE FROM t WHERE k IN (SELECT k FROM dead)")
+        assert out.column("deleted").to_pylist() == [2]
+        assert dsession.execute("SELECT k FROM t").column("k").to_pylist() == [2]
+
+    def test_pushdown_predicates_still_prune(self, dsession):
+        # simple predicates keep the Filter fast path (partition pruning)
+        out = dsession.execute("UPDATE t SET v = 0 WHERE k = 1")
+        assert out.column("updated").to_pylist() == [1]
+
+    def test_pk_update_still_rejected(self, dsession):
+        from lakesoul_tpu.errors import LakeSoulError
+
+        with pytest.raises(LakeSoulError, match="primary-key"):
+            dsession.execute("UPDATE t SET k = k + 1 WHERE v > 0")
+
+    def test_set_literal_still_works(self, dsession):
+        dsession.execute("UPDATE t SET s = 'x', v = -1.25 WHERE k = 1")
+        got = dsession.execute("SELECT s, v FROM t WHERE k = 1")
+        assert got.column("s").to_pylist() == ["x"]
+        assert got.column("v").to_pylist() == [-1.25]
+
+    def test_set_expression_evaluates_matched_rows_only(self, tmp_warehouse):
+        """A non-matching row must not abort the statement (SQL evaluates
+        SET over qualifying rows only): 10 / k with a k=0 row excluded."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE z (k bigint, v double)")
+        s.execute("INSERT INTO z VALUES (0, 1.0), (2, 1.0), (5, 1.0)")
+        s.execute("UPDATE z SET v = 10 / k WHERE k > 0")
+        got = s.execute("SELECT k, v FROM z ORDER BY k")
+        assert got.column("v").to_pylist() == [1.0, 5.0, 2.0]
+
+    def test_dml_subquery_sees_pre_statement_snapshot(self, tmp_warehouse):
+        """A self-referencing uncorrelated subquery evaluates ONCE per
+        statement: partition 1's committed rewrite must not change
+        partition 2's predicate."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute(
+            "CREATE TABLE p (d string, k bigint, v double) PARTITIONED BY (d)"
+        )
+        s.execute(
+            "INSERT INTO p VALUES ('a', 1, 9.0), ('a', 2, 1.0),"
+            " ('b', 3, 9.0), ('b', 4, 2.0)"
+        )
+        # max(v) = 9.0 pre-statement; both 9.0 rows (one per partition)
+        # must update even though the first partition's commit lowers max
+        out = s.execute(
+            "UPDATE p SET v = 0 WHERE v = (SELECT max(v) FROM p)"
+        )
+        assert out.column("updated").to_pylist() == [2]
+        got = s.execute("SELECT count(*) AS c FROM p WHERE v = 0")
+        assert got.column("c").to_pylist() == [2]
+        # the memo is statement-scoped: a fresh statement re-evaluates
+        # against the updated data (max is now 2.0 → exactly one row)
+        out = s.execute("DELETE FROM p WHERE v = (SELECT max(v) FROM p)")
+        assert out.column("deleted").to_pylist() == [1]
