@@ -261,9 +261,33 @@ def _shape_aggregate(s, t1, t2, rng):
     _compare(s.execute(sql), want)
 
 
+
+def _shape_update_dml(s, t1, t2, rng):
+    """MUTATING shape — must stay LAST in _SHAPES: random expression
+    UPDATE followed by a full-table readback vs the pandas-applied
+    mutation."""
+    lo = float(np.round(rng.normal(), 2))
+    expr, series = [
+        ("abs(a) + 1", t1["a"].abs() + 1),
+        ("a * 2", t1["a"] * 2),
+        ("coalesce(a, 0.0)", t1["a"].fillna(0.0)),
+    ][int(rng.integers(0, 3))]
+    # the OR IS NULL arm makes coalesce's NULL branch reachable (WHERE a >
+    # lo alone can never match a NULL row in engine or oracle)
+    where = f"a > {lo} OR a IS NULL"
+    out = s.execute(f"UPDATE t1 SET a = {expr} WHERE {where}")
+    mask = (t1["a"] > lo) | t1["a"].isna()
+    assert out.column("updated").to_pylist() == [int(mask.sum())]
+    want = t1.copy()
+    want.loc[mask, "a"] = series[mask]
+    want = want[["rid", "a"]].sort_values("rid").reset_index(drop=True)
+    _compare(s.execute("SELECT rid, a FROM t1 ORDER BY rid"), want)
+
+
 _SHAPES = [
     _shape_scalar_where, _shape_join, _shape_aggregate, _shape_join_where,
     _shape_in_subquery, _shape_window, _shape_having, _shape_setop,
+    _shape_update_dml,  # mutates t1: MUST stay last
 ]
 
 
@@ -274,5 +298,6 @@ def test_random_query_matches_pandas(tmp_path, seed):
     rng = np.random.default_rng(seed)
     t1, t2 = _frames(rng)
     s = _session(tmp_path, t1, t2)
+    assert _SHAPES[-1] is _shape_update_dml  # mutators run last, enforced
     for i, shape in enumerate(_SHAPES):
         shape(s, t1, t2, np.random.default_rng([seed, i]))
