@@ -426,6 +426,63 @@ def _select_rebinds(sel, qual: str) -> bool:
     return any(j.table == qual or j.alias == qual for j in sel.joins)
 
 
+def _unqualified(node):
+    """Copy of an expression tree with every qualifier dropped — GROUP BY
+    key matching is structural (``upper(t.s)`` groups by ``upper(s)``)."""
+    import copy as _copy
+    import dataclasses
+
+    if not dataclasses.is_dataclass(node) or isinstance(node, ast.Token):
+        return node
+    out = _copy.copy(node)
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if f.name in ("qual", "col_qual"):
+            setattr(out, f.name, None)
+        elif isinstance(v, list):
+            setattr(out, f.name, [
+                tuple(_unqualified(y) for y in x) if isinstance(x, tuple)
+                else _unqualified(x)
+                for x in v
+            ])
+        elif dataclasses.is_dataclass(v) and not isinstance(v, ast.Token):
+            setattr(out, f.name, _unqualified(v))
+    return out
+
+
+def _norm_repr(node) -> str:
+    return repr(_unqualified(node))
+
+
+def _subst_group_keys(node, by_norm: dict):
+    """Rebuild an expression/boolean tree replacing every subtree that is
+    STRUCTURALLY one of the GROUP BY key expressions (qualifier-insensitive)
+    with its synthesized key column — items AND HAVING both resolve
+    ``upper(s)`` onto ``__grp_0`` after aggregation drops ``s``.  Nested
+    sub-Selects keep their own scope untouched."""
+    import copy as _copy
+    import dataclasses
+
+    if not dataclasses.is_dataclass(node) or isinstance(
+        node, (ast.Token, ast.Select, ast.SetOp, ast.Literal, ast.Agg)
+    ):
+        return node
+    if _norm_repr(node) in by_norm:
+        return ast.Column(by_norm[_norm_repr(node)])
+    out = _copy.copy(node)
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, list):
+            setattr(out, f.name, [
+                tuple(_subst_group_keys(y, by_norm) for y in x)
+                if isinstance(x, tuple) else _subst_group_keys(x, by_norm)
+                for x in v
+            ])
+        elif dataclasses.is_dataclass(v) and not isinstance(v, ast.Token):
+            setattr(out, f.name, _subst_group_keys(v, by_norm))
+    return out
+
+
 def _rename_qualified_refs(node, qual: str, name: str, new: str,
                            _seen: set | None = None) -> None:
     """IN-PLACE: every reference written ``<qual>.<name>`` becomes the bare
@@ -1149,6 +1206,9 @@ class SqlSession:
 
     def _needed_columns(self, stmt: ast.Select, residual_nodes: list) -> set[str]:
         cols: set[str] = set(stmt.group_by)
+        for name, e in stmt.group_exprs:
+            cols.discard(name)  # synthesized, not a base column
+            cols |= _expr_columns(e)
         for it in stmt.items:
             cols |= _expr_columns(it.expr)
             cols |= _subquery_outer_candidates(it.expr)
@@ -1183,6 +1243,28 @@ class SqlSession:
     def _aggregate(self, stmt: ast.Select, table: pa.Table) -> tuple[pa.Table, list[str]]:
         """GROUP BY / global aggregation with HAVING and expressions over
         aggregates (e.g. ``100 * sum(a) / sum(b)``)."""
+        # GROUP BY <expr>: materialize each synthesized key column over the
+        # pre-aggregation table, then rewrite every STRUCTURAL occurrence of
+        # a key expression (qualifier-insensitive, as a subexpression) in
+        # the select items and HAVING onto the key column — after
+        # aggregation the base columns are gone
+        if stmt.group_exprs:
+            by_norm = {}
+            for name, e in stmt.group_exprs:
+                table = table.append_column(
+                    name, _broadcast(self._eval_expr(e, table), len(table))
+                )
+                by_norm[_norm_repr(e)] = name
+            new_items = []
+            for it in stmt.items:
+                sub = _subst_group_keys(it.expr, by_norm)
+                alias = it.alias
+                if sub is not it.expr and alias is None:
+                    alias = _expr_label(it.expr)
+                new_items.append(ast.SelectItem(sub, alias))
+            stmt.items = new_items
+            if stmt.having is not None:
+                stmt.having = _subst_group_keys(stmt.having, by_norm)
         # alias resolution for HAVING/expressions: alias → item expression
         alias_map = {it.alias: it.expr for it in stmt.items if it.alias}
 
@@ -1249,7 +1331,13 @@ class SqlSession:
         grouped = parts[0] if len(parts) == 1 else pa.concat_tables(parts)
 
         if having is not None:
-            mask = self._eval_bool(_subst_aggs_bool(having, agg_col), grouped)
+            try:
+                mask = self._eval_bool(_subst_aggs_bool(having, agg_col), grouped)
+            except KeyError as e:
+                raise SqlError(
+                    f"HAVING references {e} which is neither grouped nor"
+                    " inside an aggregate"
+                )
             grouped = grouped.filter(pc.fill_null(_broadcast(mask, len(grouped)), False))
 
         # project select items over the aggregated table
@@ -1262,7 +1350,18 @@ class SqlSession:
                 labels.append(it.alias or it.expr.name)
             else:
                 expr = _subst_aggs(it.expr, agg_col)
-                cols.append(_broadcast(self._eval_expr(expr, grouped), len(grouped)))
+                try:
+                    cols.append(
+                        _broadcast(self._eval_expr(expr, grouped), len(grouped))
+                    )
+                except KeyError as e:
+                    # a non-grouped base column survived substitution: the
+                    # aggregated frame no longer carries it
+                    raise SqlError(
+                        f"select expression references {e} which is neither"
+                        " grouped (column or GROUP BY expression) nor inside"
+                        " an aggregate"
+                    )
                 labels.append(it.alias or _expr_label(it.expr))
         out = pa.table(cols, names=labels)
         # unprojected ORDER BY keys that are group keys ride along hidden

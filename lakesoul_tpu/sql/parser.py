@@ -248,6 +248,10 @@ class Select:
     joins: list = field(default_factory=list)
     where: Any = None
     group_by: list[str] = field(default_factory=list)
+    # GROUP BY <expr> entries: [(synthesized column name, expr AST)] — the
+    # name also appears in group_by; the executor materializes the column
+    # before aggregation and rewrites matching select items onto it
+    group_exprs: list = field(default_factory=list)
     # ROLLUP/CUBE/GROUPING SETS: the list of grouping sets (each a subset of
     # group_by); None = plain GROUP BY (one set = group_by itself)
     grouping_sets: list | None = None
@@ -699,9 +703,32 @@ class Parser:
             sel.group_by = seen
             sel.grouping_sets = sets
             return
-        sel.group_by.append(self._qualified_ident()[1])
+        self._group_by_entry(sel)
         while self.accept("op", ","):
-            sel.group_by.append(self._qualified_ident()[1])
+            self._group_by_entry(sel)
+
+    def _group_by_entry(self, sel: Select) -> None:
+        """One plain GROUP BY entry: a bare column keeps its name; an
+        integer literal is a select-item ORDINAL (GROUP BY 1, the
+        Postgres/Spark convention); any other expression (upper(s),
+        CASE ..., k / 10) gets a synthesized key column the executor
+        materializes pre-aggregation."""
+        expr = self._arith_expr()
+        if isinstance(expr, Literal):
+            if not isinstance(expr.value, int) or isinstance(expr.value, bool):
+                raise SqlError(
+                    "cannot GROUP BY a literal; use a column, an expression,"
+                    " or a select-item ordinal"
+                )
+            if not 1 <= expr.value <= len(sel.items):
+                raise SqlError(f"GROUP BY ordinal {expr.value} is out of range")
+            expr = sel.items[expr.value - 1].expr
+        if isinstance(expr, Column):
+            sel.group_by.append(expr.name)
+            return
+        name = f"__grp_{len(sel.group_exprs)}"
+        sel.group_exprs.append((name, expr))
+        sel.group_by.append(name)
 
     def _qualified_ident(self) -> tuple[str | None, str]:
         """→ (qualifier or None, column)."""

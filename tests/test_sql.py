@@ -1544,3 +1544,100 @@ class TestDmlExpressions:
         # against the updated data (max is now 2.0 → exactly one row)
         out = s.execute("DELETE FROM p WHERE v = (SELECT max(v) FROM p)")
         assert out.column("deleted").to_pylist() == [1]
+
+
+class TestGroupByExpressions:
+    """GROUP BY <expr> (r5) — the BI staple: GROUP BY upper(s), bucketed
+    arithmetic, CASE buckets."""
+
+    @pytest.fixture()
+    def gsession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (k bigint, s string, v double)")
+        s.execute(
+            "INSERT INTO t VALUES (1,'red',1.0), (2,'RED',2.0),"
+            " (3,'blue',3.0), (14,'Red',4.0)"
+        )
+        return s
+
+    def test_group_by_function(self, gsession):
+        out = gsession.execute(
+            "SELECT upper(s) AS u, count(*) AS n, sum(v) AS sv FROM t"
+            " GROUP BY upper(s) ORDER BY u"
+        )
+        assert out.column("u").to_pylist() == ["BLUE", "RED"]
+        assert out.column("n").to_pylist() == [1, 3]
+        assert out.column("sv").to_pylist() == [3.0, 7.0]
+
+    def test_group_by_arithmetic_bucket(self, gsession):
+        out = gsession.execute(
+            "SELECT k / 10 AS b, count(*) AS n FROM t GROUP BY k / 10 ORDER BY b"
+        )
+        assert out.column("b").to_pylist() == [0, 1]
+        assert out.column("n").to_pylist() == [3, 1]
+
+    def test_group_by_case(self, gsession):
+        out = gsession.execute(
+            "SELECT CASE WHEN v > 2 THEN 'hi' ELSE 'lo' END AS b, count(*) AS n"
+            " FROM t GROUP BY CASE WHEN v > 2 THEN 'hi' ELSE 'lo' END ORDER BY b"
+        )
+        assert out.column("b").to_pylist() == ["hi", "lo"]
+        assert out.column("n").to_pylist() == [2, 2]
+
+    def test_group_expr_without_projecting_it(self, gsession):
+        out = gsession.execute(
+            "SELECT count(*) AS n FROM t GROUP BY upper(s) ORDER BY n DESC"
+        )
+        assert out.column("n").to_pylist() == [3, 1]
+
+    def test_mixed_column_and_expr_keys(self, gsession):
+        gsession.execute("INSERT INTO t VALUES (5, 'red', 9.0)")
+        out = gsession.execute(
+            "SELECT s, k / 10 AS b, count(*) AS n FROM t"
+            " GROUP BY s, k / 10 ORDER BY s, b"
+        )
+        # ('RED',0), ('Red',1), ('blue',0), ('red',0 ×2)
+        assert out.column("n").to_pylist() == [1, 1, 1, 2]
+
+    def test_plain_group_by_unchanged(self, gsession):
+        out = gsession.execute(
+            "SELECT s, count(*) AS n FROM t GROUP BY s ORDER BY s"
+        )
+        assert out.num_rows == 4  # case-sensitive distinct values
+
+    def test_having_on_group_expression(self, gsession):
+        out = gsession.execute(
+            "SELECT upper(s) AS u, count(*) AS n FROM t"
+            " GROUP BY upper(s) HAVING upper(s) = 'RED'"
+        )
+        assert out.column("u").to_pylist() == ["RED"]
+        assert out.column("n").to_pylist() == [3]
+
+    def test_expression_on_top_of_group_key(self, gsession):
+        out = gsession.execute(
+            "SELECT k / 10 + 1 AS b1, count(*) AS n FROM t"
+            " GROUP BY k / 10 ORDER BY b1"
+        )
+        assert out.column("b1").to_pylist() == [1, 2]
+
+    def test_qualifier_insensitive_key_match(self, gsession):
+        out = gsession.execute(
+            "SELECT upper(t.s) AS u, count(*) AS n FROM t"
+            " GROUP BY upper(s) ORDER BY u"
+        )
+        assert out.column("u").to_pylist() == ["BLUE", "RED"]
+
+    def test_group_by_ordinal(self, gsession):
+        out = gsession.execute(
+            "SELECT upper(s) AS u, count(*) AS n FROM t GROUP BY 1 ORDER BY u"
+        )
+        assert out.column("u").to_pylist() == ["BLUE", "RED"]
+        with pytest.raises(SqlError, match="out of range"):
+            gsession.execute("SELECT s, count(*) FROM t GROUP BY 9")
+        with pytest.raises(SqlError, match="literal"):
+            gsession.execute("SELECT s, count(*) FROM t GROUP BY 'x'")
+
+    def test_non_grouped_reference_clean_error(self, gsession):
+        with pytest.raises(SqlError, match="GROUP BY"):
+            gsession.execute("SELECT v, count(*) AS n FROM t GROUP BY upper(s)")
