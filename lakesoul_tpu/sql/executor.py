@@ -1884,6 +1884,47 @@ class SqlSession:
                 b = _broadcast(self._eval_expr(expr.args[1], table), len(table))
                 eq = pc.fill_null(pc.equal(a, b), False)
                 return pc.if_else(eq, pa.scalar(None, a.type), a)
+            if expr.name in ("trim", "ltrim", "rtrim"):
+                if len(expr.args) != 1:
+                    raise SqlError(f"{expr.name} takes exactly one argument")
+                fn = {
+                    "trim": pc.utf8_trim_whitespace,
+                    "ltrim": pc.utf8_ltrim_whitespace,
+                    "rtrim": pc.utf8_rtrim_whitespace,
+                }[expr.name]
+                return fn(self._eval_expr(expr.args[0], table))
+            if expr.name == "replace":
+                if len(expr.args) != 3:
+                    raise SqlError("replace takes exactly three arguments")
+                pat, rep = expr.args[1], expr.args[2]
+                if not isinstance(pat, ast.Literal) or not isinstance(rep, ast.Literal):
+                    raise SqlError("replace pattern and replacement must be literals")
+                if pat.value is None or rep.value is None:
+                    # SQL: any NULL argument nulls the result — never the
+                    # text "None"
+                    return pa.nulls(len(table), pa.string())
+                return pc.replace_substring(
+                    self._eval_expr(expr.args[0], table),
+                    pattern=str(pat.value), replacement=str(rep.value),
+                )
+            if expr.name == "concat":
+                if not expr.args:
+                    raise SqlError("concat takes at least one argument")
+                parts = [
+                    pc.cast(
+                        _broadcast(self._eval_expr(a, table), len(table)),
+                        pa.string(),
+                    )
+                    for a in expr.args
+                ]
+                if len(parts) == 1:
+                    return parts[0]
+                # NULL arguments are SKIPPED (Postgres/DataFusion concat
+                # semantics — the engine this dialect claims parity with;
+                # Spark/MySQL instead null the whole result)
+                return pc.binary_join_element_wise(
+                    *parts, "", null_handling="skip"
+                )
             if expr.name in ("abs", "upper", "lower", "length", "round"):
                 if expr.name == "round":
                     if not 1 <= len(expr.args) <= 2:

@@ -51,6 +51,7 @@ WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank", "lag", "lead")
 # keywords — still usable as column names when not followed by "(")
 SCALAR_FUNCTIONS = (
     "coalesce", "nullif", "abs", "round", "upper", "lower", "length",
+    "trim", "ltrim", "rtrim", "replace", "concat",
 )
 
 

@@ -1641,3 +1641,52 @@ class TestGroupByExpressions:
     def test_non_grouped_reference_clean_error(self, gsession):
         with pytest.raises(SqlError, match="GROUP BY"):
             gsession.execute("SELECT v, count(*) AS n FROM t GROUP BY upper(s)")
+
+
+class TestStringFunctions:
+    """trim/ltrim/rtrim/replace/concat (r5)."""
+
+    @pytest.fixture()
+    def ssession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (k bigint, s string)")
+        s.execute(
+            "INSERT INTO t VALUES (1, '  pad  '), (2, 'a-b-c'), (3, NULL)"
+        )
+        return s
+
+    def test_trims(self, ssession):
+        out = ssession.execute(
+            "SELECT trim(s) AS t, ltrim(s) AS l, rtrim(s) AS r FROM t WHERE k = 1"
+        )
+        assert out.column("t").to_pylist() == ["pad"]
+        assert out.column("l").to_pylist() == ["pad  "]
+        assert out.column("r").to_pylist() == ["  pad"]
+
+    def test_replace(self, ssession):
+        out = ssession.execute(
+            "SELECT replace(s, '-', '_') AS r FROM t WHERE k = 2"
+        )
+        assert out.column("r").to_pylist() == ["a_b_c"]
+
+    def test_concat(self, ssession):
+        out = ssession.execute(
+            "SELECT concat(s, ':', cast(k AS string)) AS c FROM t ORDER BY k"
+        )
+        # NULL arguments are SKIPPED (Postgres/DataFusion semantics)
+        assert out.column("c").to_pylist() == ["  pad  :1", "a-b-c:2", ":3"]
+
+    def test_concat_single_arg_and_null_literals(self, ssession):
+        out = ssession.execute("SELECT concat(s) AS c FROM t WHERE k = 2")
+        assert out.column("c").to_pylist() == ["a-b-c"]
+        out = ssession.execute(
+            "SELECT replace(s, NULL, 'x') AS r FROM t WHERE k = 2"
+        )
+        assert out.column("r").to_pylist() == [None]  # NULL arg nulls result
+
+    def test_nested_and_in_where(self, ssession):
+        out = ssession.execute(
+            "SELECT k FROM t WHERE trim(replace(s, '-', ' ')) = 'a b c'"
+        )
+        assert out.column("k").to_pylist() == [2]
