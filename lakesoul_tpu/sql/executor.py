@@ -748,6 +748,15 @@ class SqlSession:
             )
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
 
+    @staticmethod
+    def _apply_fn(name: str, fn, *args):
+        """Apply an Arrow kernel for a SQL function, surfacing type
+        mismatches as SqlError (never a raw Arrow traceback)."""
+        try:
+            return fn(*args)
+        except (pa.lib.ArrowNotImplementedError, pa.lib.ArrowInvalid) as e:
+            raise SqlError(f"{name}(): {e}")
+
     def _dml_predicate(self, where):
         """UPDATE/DELETE WHERE → (pushdown Filter, mask_fn).
 
@@ -1884,6 +1893,23 @@ class SqlSession:
                 b = _broadcast(self._eval_expr(expr.args[1], table), len(table))
                 eq = pc.fill_null(pc.equal(a, b), False)
                 return pc.if_else(eq, pa.scalar(None, a.type), a)
+            if expr.name in ("year", "month", "day"):
+                if len(expr.args) != 1:
+                    raise SqlError(f"{expr.name} takes exactly one argument")
+                fn = {"year": pc.year, "month": pc.month, "day": pc.day}[expr.name]
+                # evaluate the argument OUTSIDE the guard: a failure inside
+                # a nested expression is that expression's error, not a
+                # date-typing complaint from this function
+                arg = self._eval_expr(expr.args[0], table)
+                arg_type = arg.type if hasattr(arg, "type") else None
+                if arg_type is not None and pa.types.is_null(arg_type):
+                    # bare NULL literal: date_part(NULL) is NULL, not an error
+                    return pa.scalar(None, pa.int64())
+                try:
+                    out = fn(arg)
+                except (pa.lib.ArrowNotImplementedError, pa.lib.ArrowInvalid) as e:
+                    raise SqlError(f"{expr.name}() needs a date/timestamp: {e}")
+                return pc.cast(out, pa.int64())  # BI tools expect plain ints
             if expr.name in ("trim", "ltrim", "rtrim"):
                 if len(expr.args) != 1:
                     raise SqlError(f"{expr.name} takes exactly one argument")
@@ -1892,7 +1918,7 @@ class SqlSession:
                     "ltrim": pc.utf8_ltrim_whitespace,
                     "rtrim": pc.utf8_rtrim_whitespace,
                 }[expr.name]
-                return fn(self._eval_expr(expr.args[0], table))
+                return self._apply_fn(expr.name, fn, self._eval_expr(expr.args[0], table))
             if expr.name == "replace":
                 if len(expr.args) != 3:
                     raise SqlError("replace takes exactly three arguments")
@@ -1949,7 +1975,7 @@ class SqlSession:
                     "lower": pc.utf8_lower,
                     "length": pc.utf8_length,
                 }[expr.name]
-                return fn(arg)
+                return self._apply_fn(expr.name, fn, arg)
             raise SqlError(f"unknown function {expr.name!r}")
         if isinstance(expr, ast.ScalarSubquery):
             sel = expr.select

@@ -52,6 +52,7 @@ WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank", "lag", "lead")
 SCALAR_FUNCTIONS = (
     "coalesce", "nullif", "abs", "round", "upper", "lower", "length",
     "trim", "ltrim", "rtrim", "replace", "concat",
+    "year", "month", "day",
 )
 
 
@@ -875,21 +876,10 @@ class Parser:
                 self.expect("op", ")")
             self.expect("op", ")")
             return Func("cast", [e, Literal((tname, tuple(params)))])
-        if tok.kind == "ident" and tok.value.lower() in ("timestamp", "date") \
-                and self.pos + 1 < len(self.tokens) \
-                and self.tokens[self.pos + 1].kind == "string":
+        if self._at_temporal_literal():
             # typed temporal literals: TIMESTAMP '2026-07-02 00:00:00',
             # DATE '2026-07-02' (standard SQL; DataFusion accepts the same)
-            kind = self.next().value.lower()
-            raw = self._value()
-            import datetime as _dt
-
-            try:
-                if kind == "date":
-                    return Literal(_dt.date.fromisoformat(raw))
-                return Literal(_dt.datetime.fromisoformat(raw))
-            except ValueError as e:
-                raise SqlError(f"invalid {kind.upper()} literal {raw!r}: {e}")
+            return Literal(self._temporal_literal())
         qual, name = self._qualified_ident()
         # the qualifier is kept for scope resolution (correlated subqueries
         # decide inner-vs-outer by it); plain evaluation ignores it — names
@@ -1089,6 +1079,30 @@ class Parser:
             raise SqlError("LIKE pattern must be a string literal")
         return v
 
+    def _at_temporal_literal(self) -> bool:
+        nxt = self.peek()
+        return (
+            nxt is not None and nxt.kind == "ident"
+            and nxt.value.lower() in ("timestamp", "date")
+            and self.pos + 1 < len(self.tokens)
+            and self.tokens[self.pos + 1].kind == "string"
+        )
+
+    def _temporal_literal(self):
+        """``TIMESTAMP '...'`` / ``DATE '...'`` → datetime/date value — the
+        ONE parser for typed temporal literals, shared by expressions and
+        INSERT VALUES so the two paths cannot drift."""
+        import datetime as _dt
+
+        kind = self.next().value.lower()
+        raw = self._value()
+        try:
+            if kind == "date":
+                return _dt.date.fromisoformat(raw)
+            return _dt.datetime.fromisoformat(raw)
+        except ValueError as e:
+            raise SqlError(f"invalid {kind.upper()} literal {raw!r}: {e}")
+
     def _value_list(self) -> list:
         self.expect("op", "(")
         vals = [self._value()]
@@ -1103,6 +1117,9 @@ class Parser:
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 raise SqlError("unary minus requires a numeric literal")
             return -v
+        if self._at_temporal_literal():
+            # typed temporal literals in VALUES, same as in expressions
+            return self._temporal_literal()
         tok = self.next()
         if tok.kind == "number":
             return float(tok.value) if "." in tok.value else int(tok.value)

@@ -1690,3 +1690,30 @@ class TestStringFunctions:
             "SELECT k FROM t WHERE trim(replace(s, '-', ' ')) = 'a b c'"
         )
         assert out.column("k").to_pylist() == [2]
+
+    def test_date_parts(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE d (k bigint, ts timestamp, dt date)")
+        s.execute(
+            "INSERT INTO d VALUES (1, TIMESTAMP '2026-07-30 12:34:56',"
+            " DATE '2025-02-28')"
+        )
+        out = s.execute(
+            "SELECT year(ts) AS y, month(ts) AS m, day(dt) AS d2 FROM d"
+        )
+        assert out.column("y").to_pylist() == [2026]
+        assert out.column("m").to_pylist() == [7]
+        assert out.column("d2").to_pylist() == [28]
+        # grouping by a date part — the BI time-bucket staple
+        s.execute(
+            "INSERT INTO d VALUES (2, TIMESTAMP '2026-08-01 00:00:00',"
+            " DATE '2025-03-01')"
+        )
+        out = s.execute(
+            "SELECT month(ts) AS m, count(*) AS n FROM d GROUP BY month(ts)"
+            " ORDER BY m"
+        )
+        assert out.column("m").to_pylist() == [7, 8]
+        with pytest.raises(SqlError, match="date/timestamp"):
+            s.execute("SELECT year(k) FROM d")
