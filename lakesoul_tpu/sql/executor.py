@@ -14,6 +14,12 @@ from lakesoul_tpu.io.filters import Filter
 from lakesoul_tpu.sql import parser as ast
 from lakesoul_tpu.sql.parser import SqlError, parse
 
+# date-part function → Arrow kernel (parser.EXTRACT_PARTS mirrors the keys)
+_DATE_PARTS = {
+    "year": pc.year, "month": pc.month, "day": pc.day,
+    "hour": pc.hour, "minute": pc.minute, "second": pc.second,
+}
+
 _TYPE_MAP = {
     "bigint": pa.int64(),
     "long": pa.int64(),
@@ -1893,10 +1899,10 @@ class SqlSession:
                 b = _broadcast(self._eval_expr(expr.args[1], table), len(table))
                 eq = pc.fill_null(pc.equal(a, b), False)
                 return pc.if_else(eq, pa.scalar(None, a.type), a)
-            if expr.name in ("year", "month", "day"):
+            if expr.name in _DATE_PARTS:
                 if len(expr.args) != 1:
                     raise SqlError(f"{expr.name} takes exactly one argument")
-                fn = {"year": pc.year, "month": pc.month, "day": pc.day}[expr.name]
+                fn = _DATE_PARTS[expr.name]
                 # evaluate the argument OUTSIDE the guard: a failure inside
                 # a nested expression is that expression's error, not a
                 # date-typing complaint from this function
@@ -1905,6 +1911,12 @@ class SqlSession:
                 if arg_type is not None and pa.types.is_null(arg_type):
                     # bare NULL literal: date_part(NULL) is NULL, not an error
                     return pa.scalar(None, pa.int64())
+                if (
+                    arg_type is not None and pa.types.is_date(arg_type)
+                    and expr.name in ("hour", "minute", "second")
+                ):
+                    # DataFusion semantics: time parts of a DATE are 0
+                    arg = pc.cast(arg, pa.timestamp("us"))
                 try:
                     out = fn(arg)
                 except (pa.lib.ArrowNotImplementedError, pa.lib.ArrowInvalid) as e:

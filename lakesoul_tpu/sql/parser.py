@@ -49,11 +49,14 @@ WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank", "lag", "lead")
 
 # generic scalar functions parsed as ``name(arg, ...)`` (idents, not
 # keywords — still usable as column names when not followed by "(")
+# EXTRACT(part FROM expr) parts; each is also callable as a function of
+# the same name (the executor owns the part → Arrow-kernel mapping)
+EXTRACT_PARTS = ("year", "month", "day", "hour", "minute", "second")
+
 SCALAR_FUNCTIONS = (
     "coalesce", "nullif", "abs", "round", "upper", "lower", "length",
     "trim", "ltrim", "rtrim", "replace", "concat",
-    "year", "month", "day",
-)
+) + EXTRACT_PARTS
 
 
 @dataclass
@@ -880,6 +883,21 @@ class Parser:
             # typed temporal literals: TIMESTAMP '2026-07-02 00:00:00',
             # DATE '2026-07-02' (standard SQL; DataFusion accepts the same)
             return Literal(self._temporal_literal())
+        if tok.kind == "ident" and tok.value.lower() == "extract" \
+                and self.pos + 1 < len(self.tokens) \
+                and self.tokens[self.pos + 1].kind == "op" \
+                and self.tokens[self.pos + 1].value == "(":
+            # EXTRACT(part FROM expr) — the standard spelling; sugar for
+            # the part-named scalar function
+            self.next()
+            self.expect("op", "(")
+            part = self.ident().lower()
+            if part not in EXTRACT_PARTS:
+                raise SqlError(f"EXTRACT part {part!r} not supported")
+            self.expect("kw", "from")
+            e = self._arith_expr()
+            self.expect("op", ")")
+            return Func(part, [e])
         qual, name = self._qualified_ident()
         # the qualifier is kept for scope resolution (correlated subqueries
         # decide inner-vs-outer by it); plain evaluation ignores it — names

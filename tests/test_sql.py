@@ -1717,3 +1717,33 @@ class TestStringFunctions:
         assert out.column("m").to_pylist() == [7, 8]
         with pytest.raises(SqlError, match="date/timestamp"):
             s.execute("SELECT year(k) FROM d")
+
+    def test_extract_and_time_parts(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE e (ts timestamp)")
+        s.execute("INSERT INTO e VALUES (TIMESTAMP '2026-07-30 12:34:56')")
+        out = s.execute(
+            "SELECT EXTRACT(year FROM ts) AS y, EXTRACT(month FROM ts) AS m,"
+            " hour(ts) AS h, minute(ts) AS mi, second(ts) AS sec FROM e"
+        )
+        assert out.column("y").to_pylist() == [2026]
+        assert out.column("m").to_pylist() == [7]
+        assert out.column("h").to_pylist() == [12]
+        assert out.column("mi").to_pylist() == [34]
+        assert out.column("sec").to_pylist() == [56]
+        with pytest.raises(SqlError, match="not supported"):
+            s.execute("SELECT EXTRACT(epoch FROM ts) FROM e")
+        # extract as a soft ident: a column named extract keeps working
+        s.execute("CREATE TABLE x (extract bigint)")
+        s.execute("INSERT INTO x VALUES (5)")
+        assert s.execute("SELECT extract FROM x").column("extract").to_pylist() == [5]
+
+    def test_time_parts_of_date_are_zero(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE dd (d date)")
+        s.execute("INSERT INTO dd VALUES (DATE '2026-07-30')")
+        out = s.execute("SELECT hour(d) AS h, EXTRACT(second FROM d) AS s2 FROM dd")
+        assert out.column("h").to_pylist() == [0]
+        assert out.column("s2").to_pylist() == [0]
