@@ -446,6 +446,92 @@ class IvfRabitqIndex:
     def search_filtered(self, query, allowed_ids, params: SearchParams = SearchParams()):
         return self.search(query, params, allowed_ids=np.asarray(allowed_ids, np.uint64))
 
+    def tune_nprobe(
+        self,
+        queries: np.ndarray,
+        *,
+        target_recall: float = 0.95,
+        top_k: int = 10,
+        rerank_depth: int | None = None,
+        candidates: list[int] | None = None,
+        max_queries: int = 128,
+    ) -> dict:
+        """Pick the smallest ``nprobe`` whose measured recall@top_k on the
+        given held-out queries meets ``target_recall`` (the faiss-autotune
+        role; the reference picks nprobe by hand in its e2e tests,
+        python/tests/vector/test_e2e_glove.py:182).
+
+        Ground truth is exact brute force over the raw vectors, so the
+        index must have been built with ``keep_raw=True``.  Returns
+        ``{"nprobe", "recall", "target_met", "measured": [(nprobe,
+        recall), ...]}`` — ``measured`` records every probed point UP TO
+        the chosen one (the sweep stops at the first qualifying nprobe;
+        pass explicit ``candidates`` to force a full curve)."""
+        from lakesoul_tpu.errors import ConfigError
+
+        raws, id_chunks = [], []
+        for c in range(len(self.clusters)):
+            for seg in self._cluster_segments(c):
+                if seg.raw is None:
+                    raise ConfigError(
+                        "tune_nprobe needs raw vectors (build with keep_raw=True)"
+                    )
+                if len(seg.ids):
+                    raws.append(seg.raw)
+                    id_chunks.append(seg.ids)
+        if not raws:
+            raise ConfigError("tune_nprobe on an empty index")
+        base = np.concatenate(raws)
+        base_ids = np.concatenate(id_chunks)
+        queries = np.asarray(queries, np.float32)
+        if len(queries) > max_queries:
+            rng = np.random.default_rng(self.config.seed)
+            queries = queries[rng.choice(len(queries), max_queries, replace=False)]
+        # exact ground truth: top_k by L2 (matches the search metric) — ONE
+        # batched gram matmul for all queries, not a per-query base pass
+        d2 = (
+            np.sum(queries**2, axis=1, keepdims=True)
+            - 2.0 * queries @ base.T
+            + np.sum(base**2, axis=1)[None, :]
+        )
+        k_eff = min(top_k, d2.shape[1])
+        part = np.argpartition(d2, k_eff - 1, axis=1)[:, :k_eff]
+        truth = [set(base_ids[row].tolist()) for row in part]
+        nlist = len(self.clusters)
+        if candidates is None:
+            candidates, p = [], 1
+            while p < nlist:
+                candidates.append(p)
+                p *= 2
+            candidates.append(nlist)
+        measured = []
+        best = None
+        for nprobe in sorted(set(candidates)):
+            params = SearchParams(
+                top_k=top_k, nprobe=nprobe, rerank_depth=rerank_depth
+            )
+            got_ids, _ = self.batch_search(queries, params)
+            hits = sum(
+                len(truth[i] & {int(x) for x in got_ids[i]})
+                for i in range(len(queries))
+            )
+            # denominator = achievable hits (a small index or duplicate ids
+            # can make the truth sets smaller than top_k; perfect search
+            # must be able to reach recall 1.0)
+            recall = hits / max(1, sum(len(t) for t in truth))
+            measured.append((nprobe, recall))
+            if best is None and recall >= target_recall:
+                best = (nprobe, recall)
+                break  # smallest qualifying nprobe: stop sweeping
+        if best is None:
+            best = measured[-1]
+        return {
+            "nprobe": best[0],
+            "recall": best[1],
+            "target_met": best[1] >= target_recall,
+            "measured": measured,
+        }
+
     def batch_search(self, queries: np.ndarray, params: SearchParams = SearchParams()):
         """Search many queries; with the device cache enabled, all queries run
         in ONE device call (amortizing dispatch/readback latency)."""

@@ -686,3 +686,58 @@ class TestAsyncAndServing:
         ep.close()
         with pytest.raises(RuntimeError, match="closed"):
             ep.submit(vecs[0])
+
+
+class TestNprobeAutotune:
+    """tune_nprobe picks the smallest nprobe meeting a recall target on
+    held-out queries (faiss-autotune role; r5)."""
+
+    def _hard_index(self):
+        from lakesoul_tpu.vector.config import VectorIndexConfig
+        from lakesoul_tpu.vector.index import IvfRabitqIndex
+
+        rng = np.random.default_rng(7)
+        n, d = 20_000, 32
+        centers = rng.normal(size=(256, d)).astype(np.float32)
+        vectors = centers[rng.integers(0, 256, n)] + rng.normal(
+            size=(n, d)
+        ).astype(np.float32)
+        ids = np.arange(n, dtype=np.uint64)
+        cfg = VectorIndexConfig(column="emb", dim=d, nlist=64, total_bits=4)
+        index = IvfRabitqIndex.train(vectors, ids, cfg, keep_raw=True)
+        queries = centers[rng.integers(0, 256, 32)] + rng.normal(
+            size=(32, d)
+        ).astype(np.float32)
+        return index, queries
+
+    def test_monotone_and_target(self):
+        index, queries = self._hard_index()
+        out = index.tune_nprobe(queries, target_recall=0.9, top_k=10)
+        assert out["target_met"]
+        assert 1 <= out["nprobe"] <= 64
+        recalls = [r for _, r in out["measured"]]
+        # sweep stops at the first qualifying nprobe (smallest wins)
+        assert recalls[-1] >= 0.9
+        assert all(b >= a - 0.05 for a, b in zip(recalls, recalls[1:]))
+
+    def test_unreachable_target_reports_honestly(self):
+        index, queries = self._hard_index()
+        out = index.tune_nprobe(
+            queries, target_recall=1.01, top_k=10  # impossible by design
+        )
+        assert not out["target_met"]
+        assert out["nprobe"] == 64  # fell back to the deepest sweep point
+
+    def test_requires_raw(self):
+        from lakesoul_tpu.errors import ConfigError
+        from lakesoul_tpu.vector.config import VectorIndexConfig
+        from lakesoul_tpu.vector.index import IvfRabitqIndex
+
+        rng = np.random.default_rng(0)
+        v = rng.normal(size=(500, 16)).astype(np.float32)
+        cfg = VectorIndexConfig(column="emb", dim=16, nlist=8, total_bits=4)
+        index = IvfRabitqIndex.train(
+            v, np.arange(500, dtype=np.uint64), cfg, keep_raw=False
+        )
+        with pytest.raises(ConfigError, match="keep_raw"):
+            index.tune_nprobe(v[:8])
