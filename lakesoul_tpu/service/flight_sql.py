@@ -264,11 +264,18 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
     @staticmethod
     def _abort_all(expired: list[_Transaction]) -> None:
         for txn in expired:
-            # expired staged files would orphan on the store forever; the
-            # txn lock serializes with any stream still writing
-            with txn.lock:
-                txn.closed = True
-                txn.abort()
+            # expired staged files would orphan on the store forever.  The
+            # closed flag is set BEFORE taking the lock (monotonic bool): a
+            # wedged ingest stream may hold txn.lock for its whole duration,
+            # and blocking here would hang every other client's
+            # Begin/EndTransaction behind one dead stream — if the lock is
+            # busy, the stream's own post-loop closed-check cleans up.
+            txn.closed = True
+            if txn.lock.acquire(timeout=0.5):
+                try:
+                    txn.abort()
+                finally:
+                    txn.lock.release()
 
     def _begin_transaction(self) -> list:
         txn_id = uuid.uuid4().bytes
@@ -738,6 +745,13 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         opts = msg.table_definition_options
         ns = msg.schema or "default"
         name = msg.table
+        # resolve the transaction BEFORE any side effect: an ingest
+        # replaying a CLOSED transaction id must error without first
+        # creating the target table
+        txn = (
+            self._get_transaction(bytes(msg.transaction_id))
+            if msg.transaction_id else None
+        )
         exists = name in self.catalog.list_tables(ns)
         replace = False
         if not exists:
@@ -763,14 +777,14 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         table = self.catalog.table(name, ns)
         from lakesoul_tpu.streaming import CheckpointedWriter
 
-        if msg.transaction_id:
-            txn = self._get_transaction(bytes(msg.transaction_id))
-            if txn is not None:
-                # open server transaction: stage only — EndTransaction
-                # COMMIT publishes, ROLLBACK deletes the staged files
-                return self._ingest_into_transaction(
-                    txn, (ns, name), table, reader, replace
-                )
+        if txn is not None:
+            # open server transaction: stage only — EndTransaction COMMIT
+            # publishes, ROLLBACK deletes the staged files.  Table CREATION
+            # (above) is non-transactional, like implicit-commit DDL in
+            # most databases: a rollback keeps the (empty) table.
+            return self._ingest_into_transaction(
+                txn, (ns, name), table, reader, replace
+            )
         w = CheckpointedWriter(table)
         rows = 0
         nbytes = 0
@@ -833,6 +847,13 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
                     # torn back out: poison the transaction so COMMIT refuses
                     txn.failed = True
                     raise
+                if txn.closed:
+                    # evicted while this stream held the lock (the evictor
+                    # could not wait): clean up our own staged files
+                    txn.abort()
+                    raise flight.FlightServerError(
+                        "transaction expired during ingest"
+                    )
             self.metrics.add(rows_in=rows, bytes_in=nbytes)
         except LakeSoulError as e:
             raise flight.FlightServerError(str(e))

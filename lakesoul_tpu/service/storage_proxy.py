@@ -190,10 +190,18 @@ class StorageProxy:
                         if h in headers:
                             self.send_header(h, headers[h])
                     if "Content-Length" not in headers and method != "HEAD":
-                        body = resp.read()
-                        self.send_header("Content-Length", str(len(body)))
+                        # unknown length: stream close-delimited (HTTP/1.0
+                        # semantics this handler speaks) — a multi-GB
+                        # chunked upstream body must never materialize
+                        # whole in proxy memory
+                        self.send_header("Connection", "close")
                         self.end_headers()
-                        self.wfile.write(body)
+                        while True:
+                            piece = resp.read(CHUNK)
+                            if not piece:
+                                break
+                            self.wfile.write(piece)
+                        self.close_connection = True
                         return
                     self.end_headers()
                     if method != "HEAD":
@@ -228,13 +236,20 @@ class StorageProxy:
                 prefix = self._query.get("prefix", "")
                 if proxy.upstream is not None:
                     # re-encode the DECODED prefix: a '&' or '=' inside it
-                    # must not split into extra query parameters
+                    # must not split into extra query parameters.  Paging
+                    # params pass through — dropping continuation-token
+                    # would make the upstream return page 1 forever.
                     quoted = urllib.parse.quote(
                         f"{self._table_key}/{prefix}", safe="/"
                     )
-                    self._relay_upstream(
-                        "GET", key="", query=f"list-type=2&prefix={quoted}"
-                    )
+                    q = f"list-type=2&prefix={quoted}"
+                    for param in ("continuation-token", "max-keys",
+                                  "start-after", "delimiter"):
+                        if param in self._query:
+                            q += f"&{param}=" + urllib.parse.quote(
+                                self._query[param], safe=""
+                            )
+                    self._relay_upstream("GET", key="", query=q)
                     return
                 fs, p = filesystem_for(self._table_path, proxy.catalog.storage_options)
                 root = p.rstrip("/")
@@ -435,10 +450,12 @@ class StorageProxy:
                 self._stream_body_to(part_path)
                 # the abort tombstone is removed from _mpu_active BEFORE the
                 # abort deletes files, so re-checking after the write closes
-                # the race: if the upload died mid-write, drop our part
+                # the race: if the upload was ABORTED mid-write, drop our
+                # part.  A "completing" state is NOT aborted — deleting the
+                # staging dir then would destroy the parts mid-assembly.
                 with proxy._mpu_lock:
-                    live = proxy._mpu_active.get(upload_id) == "open"
-                if not live:
+                    gone = upload_id not in proxy._mpu_active
+                if gone:
                     fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
                     try:
                         fs.rm(sp, recursive=True)

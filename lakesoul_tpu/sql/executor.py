@@ -473,8 +473,9 @@ def _subst_group_keys(node, by_norm: dict):
         node, (ast.Token, ast.Select, ast.SetOp, ast.Literal, ast.Agg)
     ):
         return node
-    if _norm_repr(node) in by_norm:
-        return ast.Column(by_norm[_norm_repr(node)])
+    key = _norm_repr(node)
+    if key in by_norm:
+        return ast.Column(by_norm[key])
     out = _copy.copy(node)
     for f in dataclasses.fields(node):
         v = getattr(node, f.name)
@@ -728,6 +729,11 @@ class SqlSession:
                         )
                     )
             try:
+                # arm the per-statement subquery memo UP FRONT: SET-expression
+                # subqueries must see the pre-statement snapshot even when the
+                # WHERE is pushdown-expressible (mask_fn is None then and
+                # would never arm it)
+                self._stmt_query_memo = {}
                 n = self.catalog.table(stmt.table, self.namespace).update_where(
                     flt, literals, mask_fn=mask_fn, expr_assignments=exprs
                 )
@@ -737,6 +743,7 @@ class SqlSession:
         if isinstance(stmt, ast.Delete):
             flt, mask_fn = self._dml_predicate(stmt.where)
             try:
+                self._stmt_query_memo = {}
                 n = self.catalog.table(stmt.table, self.namespace).delete_where(
                     flt, mask_fn=mask_fn
                 )
@@ -1163,6 +1170,24 @@ class SqlSession:
                     _rename_qualified_refs(stmt, rname, right_key, new)
                     for n2 in residual_nodes:
                         _rename_qualified_refs(n2, rname, right_key, new)
+                    # ORDER BY / GROUP BY store bare names; their recorded
+                    # qualifiers rebind `b.k` onto the suffixed right key
+                    # (silently sorting the NULL-extended left key instead
+                    # would return wrong orderings)
+                    oq = stmt.order_by_quals
+                    stmt.order_by = [
+                        (new, d)
+                        if i < len(oq) and oq[i] == rname and c == right_key
+                        else (c, d)
+                        for i, (c, d) in enumerate(stmt.order_by)
+                    ]
+                    gq = stmt.group_by_quals
+                    stmt.group_by = [
+                        new
+                        if i < len(gq) and gq[i] == rname and c == right_key
+                        else c
+                        for i, c in enumerate(stmt.group_by)
+                    ]
                 continue
             # non-key name collisions: suffix the right side (documented,
             # deterministic; a bare reference resolves to the left table)
@@ -1564,18 +1589,25 @@ class SqlSession:
             raise SqlError(
                 "correlated EXISTS/IN with GROUP BY is not supported"
             )
+        if sel.limit is not None or sel.offset:
+            # decorrelation evaluates the inner ONCE over all groups; a
+            # per-outer-row LIMIT/OFFSET cannot be expressed there — reject
+            # loudly rather than silently dropping it (wrong answers)
+            raise SqlError(
+                "correlated subqueries do not support LIMIT/OFFSET"
+            )
         if needed:
             # project to the correlation keys + mixed-predicate columns:
             # EXISTS over a wide fact table must not materialize every column
             items = [ast.SelectItem(ast.Column(c)) for c in sorted(needed)]
             inner_sel = _dc_replace(
                 sel, items=items, star=False, where=inner_node,
-                order_by=[], limit=None, distinct=True,
+                order_by=[], limit=None, offset=None, distinct=True,
             )
         else:
             inner_sel = _dc_replace(
                 sel, items=[], star=True, where=inner_node, order_by=[],
-                limit=None,
+                limit=None, offset=None,
             )
         return self._query(inner_sel)
 
@@ -1774,6 +1806,10 @@ class SqlSession:
             raise SqlError(
                 "correlated scalar subquery must be a single aggregate"
             )
+        if sel.limit is not None or sel.offset:
+            raise SqlError(
+                "correlated subqueries do not support LIMIT/OFFSET"
+            )
         keys_o = [p[0] for p in eq_pairs]
         keys_i = [p[1] for p in eq_pairs]
         dec = _dc_replace(
@@ -1785,6 +1821,7 @@ class SqlSession:
             group_by=list(keys_i),
             order_by=[],
             limit=None,
+            offset=None,
         )
         grouped = self._select(dec)
         n = len(table)

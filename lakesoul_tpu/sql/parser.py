@@ -265,6 +265,11 @@ class Select:
     as_of_ms: int | None = None
     having: Any = None
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
+    # qualifiers as written for order_by/group_by entries (aligned by index;
+    # may be shorter — ROLLUP/CUBE paths don't record them).  Needed so a
+    # RIGHT/FULL join's suffixed right key can rebind `ORDER BY b.k`.
+    order_by_quals: list = field(default_factory=list)
+    group_by_quals: list = field(default_factory=list)
     limit: int | None = None
     offset: int | None = None
 
@@ -487,6 +492,7 @@ class Parser:
             node.order_by, node.limit = tail.order_by, tail.limit
             node.offset = tail.offset
             tail.order_by, tail.limit, tail.offset = [], None, None
+            tail.order_by_quals = []
         return node
 
     def parse_select(self) -> Select:
@@ -578,13 +584,14 @@ class Parser:
         if self.accept("kw", "order"):
             self.expect("kw", "by")
             while True:
-                col = self._qualified_ident()[1]
+                qual, col = self._qualified_ident()
                 desc = False
                 if self.accept("kw", "desc"):
                     desc = True
                 else:
                     self.accept("kw", "asc")
                 sel.order_by.append((col, desc))
+                sel.order_by_quals.append(qual)
                 if not self.accept("op", ","):
                     break
         if self.accept("kw", "limit"):
@@ -730,10 +737,12 @@ class Parser:
             expr = sel.items[expr.value - 1].expr
         if isinstance(expr, Column):
             sel.group_by.append(expr.name)
+            sel.group_by_quals.append(expr.qual)
             return
         name = f"__grp_{len(sel.group_exprs)}"
         sel.group_exprs.append((name, expr))
         sel.group_by.append(name)
+        sel.group_by_quals.append(None)
 
     def _qualified_ident(self) -> tuple[str | None, str]:
         """→ (qualifier or None, column)."""

@@ -389,3 +389,13 @@ class TestTransactions:
             client.begin_transaction()  # capacity freed
         finally:
             mod._TXN_CAP = old
+
+    def test_closed_transaction_ingest_creates_no_table(self, client, server):
+        """Replaying a CLOSED minted id must error BEFORE any side effect —
+        no table creation (high-review r5)."""
+        _, catalog = server
+        txn = client.begin_transaction()
+        client.commit(txn)
+        with pytest.raises(flight.FlightError, match="already ended"):
+            client.ingest("ghost_tbl", pa.table({"a": [1]}), transaction_id=txn)
+        assert "ghost_tbl" not in catalog.list_tables("default")

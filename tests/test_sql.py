@@ -1747,3 +1747,68 @@ class TestStringFunctions:
         out = s.execute("SELECT hour(d) AS h, EXTRACT(second FROM d) AS s2 FROM dd")
         assert out.column("h").to_pylist() == [0]
         assert out.column("s2").to_pylist() == [0]
+
+    def test_set_expression_subquery_snapshot_on_pushdown_where(self, tmp_warehouse):
+        """The snapshot memo arms even when WHERE is fully pushdown: a SET
+        subquery must not see partition 1's rewrite from partition 2."""
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE t (p string, v bigint) PARTITIONED BY (p)")
+        s.execute("INSERT INTO t VALUES ('a', 1), ('b', 10)")
+        s.execute("UPDATE t SET v = v + (SELECT sum(v) FROM t) WHERE v >= 0")
+        out = s.execute("SELECT v FROM t ORDER BY v")
+        assert out.column("v").to_pylist() == [12, 21]
+
+    def test_correlated_subquery_rejects_limit_offset(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE o (k bigint)")
+        s.execute("CREATE TABLE i (k bigint, x bigint)")
+        s.execute("INSERT INTO o VALUES (1)")
+        s.execute("INSERT INTO i VALUES (1, 10)")
+        with pytest.raises(SqlError, match="LIMIT/OFFSET"):
+            s.execute(
+                "SELECT (SELECT max(x) FROM i WHERE i.k = o.k OFFSET 1) FROM o"
+            )
+        with pytest.raises(SqlError, match="LIMIT/OFFSET"):
+            s.execute(
+                "SELECT k FROM o WHERE EXISTS"
+                " (SELECT 1 FROM i WHERE i.k = o.k LIMIT 1)"
+            )
+
+
+class TestQualifiedOrderGroupOnJoinKeys:
+    """ORDER BY / GROUP BY b.k after a RIGHT/FULL join binds the suffixed
+    right key, not the NULL-extended left key (high-review r5)."""
+
+    @pytest.fixture()
+    def qsession(self, tmp_warehouse):
+        cat = LakeSoulCatalog(str(tmp_warehouse))
+        s = SqlSession(cat)
+        s.execute("CREATE TABLE a (k bigint)")
+        s.execute("CREATE TABLE b (k bigint, y double)")
+        s.execute("INSERT INTO a VALUES (1), (3)")
+        s.execute("INSERT INTO b VALUES (3, 1.0), (3, 2.0), (5, 3.0)")
+        return s
+
+    def test_order_by_right_key(self, qsession):
+        out = qsession.execute(
+            "SELECT b.k AS bk FROM a RIGHT JOIN b ON a.k = b.k ORDER BY b.k DESC"
+        )
+        assert out.column("bk").to_pylist() == [5, 3, 3]
+
+    def test_group_by_right_key(self, qsession):
+        out = qsession.execute(
+            "SELECT b.k AS bk, count(*) AS n FROM a RIGHT JOIN b ON a.k = b.k"
+            " GROUP BY b.k ORDER BY bk"
+        )
+        assert out.column("bk").to_pylist() == [3, 5]
+        assert out.column("n").to_pylist() == [2, 1]
+
+    def test_left_qualifier_still_left(self, qsession):
+        out = qsession.execute(
+            "SELECT a.k AS ak FROM a FULL OUTER JOIN b ON a.k = b.k"
+            " ORDER BY a.k"
+        )
+        # NULL-extended left keys sort last (pyarrow default)
+        assert out.column("ak").to_pylist() == [1, 3, 3, None]
