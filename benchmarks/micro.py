@@ -274,11 +274,44 @@ LEGS = {
 }
 
 
+def _obs_snapshot() -> dict:
+    from lakesoul_tpu.obs import registry
+
+    return registry().snapshot()
+
+
+def _emit_obs(leg: str, before: dict) -> None:
+    """Registry DELTA over one leg (the registry is process-cumulative), so
+    BENCH_*.json rounds can record loader/scan/merge throughput counters
+    alongside wall-clock figures.  Histograms compress to count/sum/mean;
+    series a leg didn't move are dropped."""
+    obs = {}
+    for name, value in sorted(_obs_snapshot().items()):
+        if isinstance(value, dict):
+            prev = before.get(name, {"count": 0, "sum": 0.0})
+            count = value["count"] - prev["count"]
+            total = value["sum"] - prev["sum"]
+            if count:
+                obs[name] = {
+                    "count": count,
+                    "sum": round(total, 6),
+                    "mean": round(total / count, 6),
+                }
+        else:
+            prev = before.get(name, 0)
+            delta = value - prev if isinstance(prev, (int, float)) else value
+            if delta:
+                obs[name] = round(delta, 3) if isinstance(delta, float) else delta
+    print(json.dumps({"bench": leg, "obs": obs}))
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     legs = list(LEGS) if which == "all" else [which]
     for leg in legs:
+        before = _obs_snapshot()
         LEGS[leg]()
+        _emit_obs(leg, before)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 
 from lakesoul_tpu.errors import CommitConflictError
 from lakesoul_tpu.meta.store import CompactionEvent
+from lakesoul_tpu.obs import registry, span
 
 logger = logging.getLogger(__name__)
 
@@ -38,6 +39,9 @@ class CompactionStats:
     def bump(self, name: str) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + 1)
+        # mirrored into the shared registry so one /metrics endpoint covers
+        # the compaction service next to streams/cache/loader
+        registry().counter("lakesoul_compaction_events_total", kind=name).inc()
 
 
 class CompactionService:
@@ -132,6 +136,18 @@ class CompactionService:
                 self._queue.task_done()
 
     def _compact_one(self, event: CompactionEvent) -> None:
+        sp = span("compaction.job", partition=event.partition_desc)
+        try:
+            with sp:
+                self._compact_one_inner(event)
+        finally:
+            # the span already timed the job (duration_s is set even when
+            # the body raised) — feed the histogram from it
+            registry().histogram("lakesoul_compaction_job_seconds").observe(
+                sp.duration_s or 0.0
+            )
+
+    def _compact_one_inner(self, event: CompactionEvent) -> None:
         from lakesoul_tpu.meta.client import partition_desc_to_dict
 
         info = self.catalog.client.store.get_table_info_by_id(event.table_id)

@@ -20,13 +20,94 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator
 
 import numpy as np
 import pyarrow as pa
 
+from lakesoul_tpu.obs import registry
+
 
 _SENTINEL = object()
+
+
+class LoaderStats:
+    """Thread-safe loader-throughput telemetry (the Deep Lake fetch/decode/
+    collate visibility role): rows/sec, batches/sec, producer-queue depth,
+    consumer stall time, per-epoch totals.
+
+    ``snapshot()`` is what training loops read between steps; the same
+    counters feed the process registry (``lakesoul_loader_*``), so a
+    gateway's ``/metrics`` shows loader throughput next to everything else.
+    Elapsed time counts only time spent inside epochs — an iterator parked
+    between epochs does not dilute rows/sec."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = 0
+        self.batches = 0
+        self.epochs = 0
+        self.stall_s = 0.0
+        self.queue_depth = 0
+        self.epoch_rows: list[int] = []
+        self._active_s = 0.0
+        self._epoch_start: float | None = None
+        self._cur_epoch_rows = 0
+        # hot path: fetch each registry metric ONCE (the obs contract), not
+        # per delivered batch — delivery then pays only the metric's own lock
+        reg = registry()
+        self._m_rows = reg.counter("lakesoul_loader_rows_total")
+        self._m_batches = reg.counter("lakesoul_loader_batches_total")
+        self._m_stall = reg.counter("lakesoul_loader_stall_seconds_total")
+        self._m_epochs = reg.counter("lakesoul_loader_epochs_total")
+        self._m_depth = reg.gauge("lakesoul_loader_queue_depth")
+
+    def epoch_begin(self) -> None:
+        with self._lock:
+            self._epoch_start = time.perf_counter()
+            self._cur_epoch_rows = 0
+
+    def epoch_end(self, completed: bool) -> None:
+        with self._lock:
+            if self._epoch_start is not None:
+                self._active_s += time.perf_counter() - self._epoch_start
+                self._epoch_start = None
+            if completed:
+                self.epochs += 1
+                self.epoch_rows.append(self._cur_epoch_rows)
+                del self.epoch_rows[:-64]  # bound the history
+        if completed:
+            self._m_epochs.inc()
+
+    def delivered(self, rows: int, stall_s: float, queue_depth: int) -> None:
+        with self._lock:
+            self.rows += rows
+            self.batches += 1
+            self._cur_epoch_rows += rows
+            self.stall_s += stall_s
+            self.queue_depth = queue_depth
+        self._m_rows.inc(rows)
+        self._m_batches.inc()
+        self._m_stall.inc(stall_s)
+        self._m_depth.set(queue_depth)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = self._active_s
+            if self._epoch_start is not None:
+                elapsed += time.perf_counter() - self._epoch_start
+            return {
+                "rows": self.rows,
+                "batches": self.batches,
+                "epochs": self.epochs,
+                "epoch_rows": list(self.epoch_rows),
+                "elapsed_s": elapsed,
+                "rows_per_sec": (self.rows / elapsed) if elapsed > 0 else 0.0,
+                "batches_per_sec": (self.batches / elapsed) if elapsed > 0 else 0.0,
+                "stall_s": self.stall_s,
+                "queue_depth": self.queue_depth,
+            }
 
 
 class LoaderCheckpoint:
@@ -171,6 +252,7 @@ class JaxBatchIterator:
             raise ConfigError("cache='device' requires device_put=True")
         self._cache_mode = cache
         self._device_cached: list | None = None
+        self._stats = LoaderStats()
         self._scan = scan
         self._collate = collate_fn or _default_collate
         self._transform = transform
@@ -197,6 +279,12 @@ class JaxBatchIterator:
         import hashlib
 
         return hashlib.md5(repr(self._scan._cache_key()).encode()).hexdigest()
+
+    def stats(self) -> dict:
+        """Loader telemetry snapshot: rows/batches (+ per-sec over in-epoch
+        wall time), epochs, per-epoch row totals, consumer stall seconds,
+        and current producer-queue depth.  Cheap enough to read every step."""
+        return self._stats.snapshot()
 
     # ------------------------------------------------------------- pipeline
     def _producer(self, q: queue.Queue, stop: threading.Event) -> None:
@@ -250,8 +338,15 @@ class JaxBatchIterator:
     def __iter__(self):
         if self._device_cached is not None:
             # steady state: replay the HBM-resident epoch, no host pipeline
-            for b in self._device_cached:
-                yield self._fresh_containers(b)
+            self._stats.epoch_begin()
+            replayed = False
+            try:
+                for rows, b in self._device_cached:
+                    self._stats.delivered(rows, 0.0, 0)
+                    yield self._fresh_containers(b)
+                replayed = True
+            finally:
+                self._stats.epoch_end(replayed)
             return
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         stop = threading.Event()
@@ -259,16 +354,26 @@ class JaxBatchIterator:
             target=self._producer, args=(q, stop),
             daemon=True, name="lakesoul-loader-producer",
         )
+        self._stats.epoch_begin()
+        produced_all = False  # producer reached the sentinel
+        delivered_all = False  # ...AND every batch reached the consumer
         thread.start()
 
         def host_iter():
+            nonlocal produced_all
             try:
                 while True:
+                    waited = time.perf_counter()
                     item = q.get()
+                    stall = time.perf_counter() - waited
                     if item is _SENTINEL:
+                        produced_all = True
                         return
                     if isinstance(item, BaseException):
                         raise item
+                    # telemetry at the host hand-off: this is the loader's
+                    # produced throughput and how long the consumer starved
+                    self._stats.delivered(item[0], stall, q.qsize())
                     yield item
             finally:
                 stop.set()
@@ -286,39 +391,46 @@ class JaxBatchIterator:
             if self._checkpoint is not None:
                 self._checkpoint.rows_delivered += rows
 
-        if not self._device_put:
+        try:
+            if not self._device_put:
+                for rows, host_batch in host_iter():
+                    delivered(rows)  # BEFORE yield: a post-step save includes it
+                    yield host_batch
+                delivered_all = produced_all
+                return
+
+            import jax
+
+            put = (
+                (lambda b: jax.device_put(b, self._sharding))
+                if self._sharding is not None
+                else jax.device_put
+            )
+            # double buffering: keep device_prefetch transfers in flight so the
+            # H2D copy of batch k+1 overlaps the step on batch k
+            fill: list | None = [] if self._cache_mode == "device" else None
+            buf: list = []
             for rows, host_batch in host_iter():
-                delivered(rows)  # BEFORE yield: a post-step save includes it
-                yield host_batch
-            return
-
-        import jax
-
-        put = (
-            (lambda b: jax.device_put(b, self._sharding))
-            if self._sharding is not None
-            else jax.device_put
-        )
-        # double buffering: keep device_prefetch transfers in flight so the
-        # H2D copy of batch k+1 overlaps the step on batch k
-        fill: list | None = [] if self._cache_mode == "device" else None
-        buf: list = []
-        for rows, host_batch in host_iter():
-            buf.append((rows, put(host_batch)))
-            if len(buf) > self._device_prefetch:
-                r, b = buf.pop(0)
+                buf.append((rows, put(host_batch)))
+                if len(buf) > self._device_prefetch:
+                    r, b = buf.pop(0)
+                    delivered(r)
+                    if fill is not None:
+                        fill.append((r, b))
+                        b = self._fresh_containers(b)  # cache keeps the pristine one
+                    yield b
+            for r, b in buf:
                 delivered(r)
                 if fill is not None:
-                    fill.append(b)
-                    b = self._fresh_containers(b)  # cache keeps the pristine one
+                    fill.append((r, b))
+                    b = self._fresh_containers(b)
                 yield b
-        for r, b in buf:
-            delivered(r)
+            # a consumer break during the tail flush raises GeneratorExit
+            # above and never reaches here: the epoch is NOT complete
+            delivered_all = produced_all
             if fill is not None:
-                fill.append(b)
-                b = self._fresh_containers(b)
-            yield b
-        if fill is not None:
-            # only a COMPLETE epoch becomes the resident cache: an abandoned
-            # iteration (consumer break → GeneratorExit) never reaches here
-            self._device_cached = fill
+                # only a COMPLETE epoch becomes the resident cache: an abandoned
+                # iteration (consumer break → GeneratorExit) never reaches here
+                self._device_cached = fill
+        finally:
+            self._stats.epoch_end(delivered_all)

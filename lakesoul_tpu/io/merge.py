@@ -120,6 +120,33 @@ def merge_sorted_tables(
     wins for UseLast semantics.  Input tables need not be pre-sorted — the
     merge does one stable multi-key sort (ties preserve input order, which
     encodes file version order)."""
+    import time
+
+    from lakesoul_tpu.obs import registry
+
+    started = time.perf_counter()
+    out = _merge_sorted_tables(
+        tables,
+        primary_keys,
+        merge_operators=merge_operators,
+        target_schema=target_schema,
+        defaults=defaults,
+    )
+    registry().histogram("lakesoul_io_merge_seconds").observe(
+        time.perf_counter() - started
+    )
+    registry().counter("lakesoul_io_merge_rows_total").inc(len(out))
+    return out
+
+
+def _merge_sorted_tables(
+    tables: list[pa.Table],
+    primary_keys: list[str],
+    *,
+    merge_operators: dict[str, str] | None = None,
+    target_schema: pa.Schema | None = None,
+    defaults: dict | None = None,
+) -> pa.Table:
     merge_operators = merge_operators or {}
     for colname, op in merge_operators.items():
         if op not in MERGE_OPERATORS:

@@ -21,6 +21,7 @@ import hashlib
 import logging
 import os
 import threading
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
@@ -30,6 +31,59 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_PAGE_BYTES = 4 << 20
 DEFAULT_MAX_BYTES = 10 << 30
+
+# every live cache instance, aggregated into the shared obs registry as
+# lakesoul_cache_* series (one process = one cache fleet; per-dir splits stay
+# available via DiskPageCache.snapshot())
+_INSTANCES: "weakref.WeakSet[DiskPageCache]" = weakref.WeakSet()
+
+_CACHE_SERIES = (
+    ("lakesoul_cache_hits_total", "counter", "hits"),
+    ("lakesoul_cache_misses_total", "counter", "misses"),
+    ("lakesoul_cache_hit_bytes_total", "counter", "hit_bytes"),
+    ("lakesoul_cache_miss_bytes_total", "counter", "miss_bytes"),
+    ("lakesoul_cache_evictions_total", "counter", "evictions"),
+    ("lakesoul_cache_pages", "gauge", "pages"),
+    ("lakesoul_cache_bytes", "gauge", "bytes"),
+    ("lakesoul_cache_max_bytes", "gauge", "max_bytes"),
+)
+
+_COUNTER_FIELDS = tuple(f for _, kind, f in _CACHE_SERIES if kind == "counter")
+
+# lifetime counters of GC'd caches: the exposed *_total series must stay
+# monotonic across cache churn (gauges correctly drop with the instance)
+_RETIRED: dict[str, int] = {}
+_RETIRED_LOCK = threading.Lock()
+
+
+def _retire_cache(stats: "CacheStats") -> None:
+    snap = stats.snapshot()
+    with _RETIRED_LOCK:
+        for k in _COUNTER_FIELDS:
+            _RETIRED[k] = _RETIRED.get(k, 0) + snap.get(k, 0)
+
+
+def registry_cache_stats() -> dict:
+    """Aggregate page-cache counters across every cache in the process
+    (live + retired), in the same shape as ``DiskPageCache.snapshot()`` —
+    the registry-backed source for console ``cache-stats`` and
+    ``/metrics``."""
+    agg = dict.fromkeys((field for _, _, field in _CACHE_SERIES), 0)
+    with _RETIRED_LOCK:
+        for k in _COUNTER_FIELDS:
+            agg[k] += _RETIRED.get(k, 0)
+    for cache in list(_INSTANCES):
+        snap = cache.snapshot()
+        for k in agg:
+            agg[k] += snap.get(k, 0)
+    total = agg["hits"] + agg["misses"]
+    agg["hit_rate"] = (agg["hits"] / total) if total else 0.0
+    return agg
+
+
+def _collect_caches() -> list:
+    agg = registry_cache_stats()
+    return [(name, kind, agg[field], {}) for name, kind, field in _CACHE_SERIES]
 
 
 @dataclass
@@ -101,6 +155,13 @@ class DiskPageCache:
         os.makedirs(self.cache_dir, exist_ok=True)
         self.page_bytes = self._pin_page_bytes(int(page_bytes))
         self._rebuild_index()
+        from lakesoul_tpu.obs import registry
+
+        _INSTANCES.add(self)
+        # finalizer holds only the stats object, not the cache: final
+        # counter totals survive this instance's GC
+        weakref.finalize(self, _retire_cache, self.stats)
+        registry().register_collector(_collect_caches)  # idempotent
 
     def _pin_page_bytes(self, requested: int) -> int:
         """First opener writes the marker; later openers must use the on-disk

@@ -32,6 +32,16 @@ from lakesoul_tpu.io.config import DEFAULT_MEMORY_BUDGET
 from lakesoul_tpu.io.filters import Filter, filter_column_names, zone_conjuncts
 from lakesoul_tpu.io.formats import format_for
 from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
+from lakesoul_tpu.obs import registry
+
+
+def _unit_observe(mode: str, rows: int, started: float) -> None:
+    """Scan-unit telemetry: per-unit wall time and produced rows, split by
+    execution mode (materialize vs bounded-memory stream)."""
+    registry().histogram("lakesoul_io_scan_unit_seconds", mode=mode).observe(
+        time.perf_counter() - started
+    )
+    registry().counter("lakesoul_io_scan_rows_total", mode=mode).inc(rows)
 
 
 def _read_one_file(
@@ -230,6 +240,7 @@ def read_scan_unit(
         post_filter=plan.post_filter,
         columns=columns,
     )
+    _unit_observe("materialize", len(out), started)
     if logger.isEnabledFor(logging.DEBUG):
         logger.debug(
             "scan unit materialized: files=%d rows=%d merge=%s in %.1fms",
@@ -342,6 +353,8 @@ def iter_scan_unit_batches(
         # merge operators are PK-group reductions; without PKs they are a
         # no-op and files simply concatenate
         rows = _stream_batch_rows(plan.file_schema, 1, memory_budget_bytes)
+        started = time.perf_counter()
+        out_rows = 0
         for path in files:
             fmt = format_for(path)
             for batch in fmt.iter_batches(
@@ -357,7 +370,9 @@ def iter_scan_unit_batches(
                     t = uniform_table(t, plan.file_schema, defaults)
                 t = post(t)
                 if len(t):
+                    out_rows += len(t)
                     yield from t.to_batches(max_chunksize=batch_size)
+        _unit_observe("stream", out_rows, started)
         return
 
     from lakesoul_tpu.io.streaming_merge import iter_merged_windows
@@ -382,6 +397,7 @@ def iter_scan_unit_batches(
         if len(t):
             out_rows += len(t)
             yield from t.to_batches(max_chunksize=batch_size)
+    _unit_observe("stream", out_rows, started)
     if logger.isEnabledFor(logging.DEBUG):
         logger.debug(
             "scan unit streamed: files=%d windows=%d rows=%d window_rows=%d in %.1fms",

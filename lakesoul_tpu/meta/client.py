@@ -244,11 +244,20 @@ class MetaDataClient:
         index on the canonical form.  ``commit_data_files`` does this for you."""
         if meta_info.table_info is None:
             raise MetadataError("table info missing")
+        from lakesoul_tpu.obs import registry, span
+
         last_err: Exception | None = None
         started = time.perf_counter()
         for attempt in range(MAX_COMMIT_RETRIES):
             try:
-                result = self._commit_data_once(meta_info, commit_op)
+                with span("meta.commit", op=commit_op.value):
+                    result = self._commit_data_once(meta_info, commit_op)
+                registry().histogram(
+                    "lakesoul_meta_commit_seconds", op=commit_op.value
+                ).observe(time.perf_counter() - started)
+                registry().counter(
+                    "lakesoul_meta_commits_total", op=commit_op.value
+                ).inc()
                 if logger.isEnabledFor(logging.DEBUG):
                     logger.debug(
                         "commit %s table=%s partitions=%d attempt=%d in %.1fms",
@@ -261,6 +270,7 @@ class MetaDataClient:
                 return result
             except CommitConflictError as e:
                 last_err = e
+                registry().counter("lakesoul_meta_commit_conflicts_total").inc()
                 if commit_op in (CommitOp.COMPACTION, CommitOp.UPDATE):
                     # the snapshot this job produced was computed from a stale
                     # read version; stacking it would lose concurrent writes

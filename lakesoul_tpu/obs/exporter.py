@@ -1,0 +1,44 @@
+"""THE Prometheus HTTP endpoint (parity with the reference server's
+PrometheusBuilder, bin/flight_sql_server.rs:21-22): one ``/metrics`` serving
+everything the process recorded — gateway streams, page cache, SQL stage
+latencies, meta commits, compaction, loader throughput — from one registry.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from lakesoul_tpu.obs.metrics import registry as _default_registry
+
+__all__ = ["serve_prometheus"]
+
+
+def serve_prometheus(source=None, port: int = 0, host: str = "0.0.0.0"):
+    """Serve ``GET /metrics`` in a daemon thread; returns the HTTPServer
+    (``.shutdown()`` to stop, ``.server_address[1]`` for the bound port).
+
+    ``source`` is anything with ``prometheus_text()``; default is the
+    process-wide registry, which is what servers should expose — a
+    per-component object narrows the endpoint to that component."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    metrics = source if source is not None else _default_registry()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            body = metrics.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv

@@ -1,0 +1,385 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The standing instrumentation contract for the repo (reference: the
+PrometheusBuilder exporter wired through bin/flight_sql_server.rs:21-70 and
+the per-layer stats structs — StreamWriteMetrics, cache/stats.rs).  Every
+layer records into ONE registry so a single ``/metrics`` endpoint (or
+``registry().snapshot()``) shows the whole data path: gateway streams, page
+cache, SQL stage latencies, merge/scan timings, meta commits, compaction
+jobs, and loader throughput.
+
+Naming scheme: ``lakesoul_<layer>_<name>`` with ``_total`` for counters and
+``_seconds`` for duration histograms; low-cardinality labels only (stage,
+op, mode — never table names or paths).
+
+All metric types are thread-safe; getters are memoized per (name, labels)
+so hot paths pay one dict lookup + one lock per update.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import weakref
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "StreamMetrics",
+    "DEFAULT_TIME_BUCKETS",
+]
+
+# seconds buckets spanning sub-ms kernel work to minute-long compaction jobs
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter."""
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: tuple[tuple[str, str], ...] = ()):
+        self.name = name
+        self.labels = labels
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int | float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def expose(self) -> list[tuple[str, float]]:
+        return [(self.name + _fmt_labels(self.labels), self.value)]
+
+
+class Gauge:
+    """Set/inc/dec point-in-time value; optionally backed by a callable."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "_value", "_lock", "_fn")
+
+    def __init__(self, name: str, labels: tuple[tuple[str, str], ...] = ()):
+        self.name = name
+        self.labels = labels
+        self._value = 0
+        self._lock = threading.Lock()
+        self._fn = None
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1) -> None:
+        with self._lock:
+            self._value -= n
+
+    def set_function(self, fn) -> None:
+        """Sample ``fn()`` at exposition time instead of a stored value."""
+        self._fn = fn
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # a broken sampler must never break exposition
+                return 0
+        with self._lock:
+            return self._value
+
+    def expose(self) -> list[tuple[str, float]]:
+        return [(self.name + _fmt_labels(self.labels), self.value)]
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus cumulative-``le`` semantics:
+    bucket i counts observations ``<= bounds[i]``, plus the implicit +Inf."""
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        labels: tuple[tuple[str, str], ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_TIME_BUCKETS,
+    ):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def value(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum = 0
+        buckets = {}
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            buckets[bound] = cum
+        return {"buckets": buckets, "count": total, "sum": s}
+
+    def expose(self) -> list[tuple[str, float]]:
+        snap = self.value
+        out = []
+        for bound, cum in snap["buckets"].items():
+            lab = self.labels + (("le", repr(bound)),)
+            out.append((f"{self.name}_bucket" + _fmt_labels(lab), cum))
+        lab = self.labels + (("le", "+Inf"),)
+        out.append((f"{self.name}_bucket" + _fmt_labels(lab), snap["count"]))
+        out.append((f"{self.name}_sum" + _fmt_labels(self.labels), snap["sum"]))
+        out.append((f"{self.name}_count" + _fmt_labels(self.labels), snap["count"]))
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe registry of named metrics plus pluggable collectors.
+
+    ``counter/gauge/histogram`` memoize on (name, sorted labels), so call
+    sites simply re-ask for the metric.  A name is permanently bound to its
+    first kind — re-registering under another kind is a programming error
+    and raises.  ``register_collector`` accepts a zero-arg callable
+    returning ``[(name, kind, value, labels_dict), ...]`` for stats owned
+    elsewhere (page-cache instances, per-server stream metrics) that are
+    sampled at exposition time.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple[str, tuple], Counter | Gauge | Histogram] = {}
+        self._kinds: dict[str, str] = {}
+        self._collectors: list = []
+
+    # ------------------------------------------------------------- factories
+    def _get(self, cls, name: str, labels: dict, **kw):
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                bound = self._kinds.setdefault(name, cls.kind)
+                if bound != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {bound}, not {cls.kind}"
+                    )
+                m = self._metrics[key] = cls(name, key[1], **kw)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, not {cls.kind}"
+                )
+            return m
+
+    # positional-only metric names: label keys like name=/buckets= must not
+    # collide with the parameters
+    def counter(self, name: str, /, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, /, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(
+        self, name: str, /, buckets: tuple[float, ...] | None = None, **labels
+    ) -> Histogram:
+        if buckets is None:
+            return self._get(Histogram, name, labels)
+        m = self._get(Histogram, name, labels, buckets=buckets)
+        want = tuple(sorted(float(b) for b in buckets))
+        if m.bounds != want:
+            # memoization would silently hand back the first caller's bounds
+            # and observations would land in wrong buckets — that's a
+            # programming error, same as a kind mismatch
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets"
+                f" {m.bounds}, not {want}"
+            )
+        return m
+
+    def register_collector(self, fn) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    # ------------------------------------------------------------ exposition
+    def _collected(self) -> list[tuple[str, str, float, dict]]:
+        with self._lock:
+            fns = list(self._collectors)
+        out = []
+        for fn in fns:
+            try:
+                out.extend(fn())
+            except Exception:  # one broken collector must not hide the rest
+                continue
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON-friendly view: series name (with labels) → number, or for
+        histograms → {buckets, count, sum}."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out = {}
+        for (name, labels), m in metrics:
+            out[name + _fmt_labels(labels)] = m.value
+        for name, _kind, value, labels in self._collected():
+            key = name + _fmt_labels(tuple(sorted(labels.items())))
+            out[key] = out.get(key, 0) + value
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition of every registered metric and every
+        collector sample, one ``# TYPE`` line per metric name."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: list[str] = []
+        typed: set[str] = set()
+        for (name, _labels), m in metrics:
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} {m.kind}")
+            for series, value in m.expose():
+                lines.append(f"{series} {value}")
+        collected: dict[str, float] = {}
+        kinds: dict[str, str] = {}
+        order: list[str] = []
+        for name, kind, value, labels in self._collected():
+            key = name + _fmt_labels(tuple(sorted(labels.items())))
+            if key not in collected:
+                order.append(key)
+            collected[key] = collected.get(key, 0) + value
+            kinds[key] = (name, kind)
+        for key in order:
+            name, kind = kinds[key]
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{key} {collected[key]}")
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """THE process-wide registry every layer records into."""
+    return _REGISTRY
+
+
+# --------------------------------------------------------------------- streams
+# Gateway stream metrics (parity with StreamWriteMetrics,
+# flight_sql_service.rs:90).  One instance per server; every live instance is
+# aggregated into the shared registry's lakesoul_flight_* series, while the
+# per-server `metrics` / `metrics_prometheus` Flight actions keep their
+# original byte format.
+
+_STREAM_INSTANCES: "weakref.WeakSet[StreamMetrics]" = weakref.WeakSet()
+
+# lifetime counts of GC'd instances: counters must stay MONOTONIC across
+# server churn (a decrease reads as a counter reset to Prometheus rate());
+# gauges (active_*) correctly drop with the instance
+_STREAM_RETIRED: dict[str, int] = {}
+_STREAM_RETIRED_LOCK = threading.Lock()
+
+
+def _retire_stream(fields: dict) -> None:
+    with _STREAM_RETIRED_LOCK:
+        for k in StreamMetrics._FIELDS:
+            if not k.startswith("active"):
+                _STREAM_RETIRED[k] = _STREAM_RETIRED.get(k, 0) + fields.get(k, 0)
+
+
+@dataclass(eq=False)
+class StreamMetrics:
+    active_get_streams: int = 0
+    active_put_streams: int = 0
+    total_get_streams: int = 0
+    total_put_streams: int = 0
+    rows_out: int = 0
+    rows_in: int = 0
+    bytes_in: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def __post_init__(self):
+        _STREAM_INSTANCES.add(self)
+        # the finalizer holds the instance's __dict__ (ints live there), not
+        # the instance — no resurrection, but the final totals survive GC
+        weakref.finalize(self, _retire_stream, self.__dict__)
+
+    def add(self, **kw) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    _FIELDS = (
+        "active_get_streams", "active_put_streams", "total_get_streams",
+        "total_put_streams", "rows_out", "rows_in", "bytes_in",
+    )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: getattr(self, k) for k in self._FIELDS}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (parity with the reference's
+        PrometheusBuilder exporter, bin/flight_sql_server.rs:21-70)."""
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap.items():
+            kind = "gauge" if k.startswith("active") else "counter"
+            lines.append(f"# TYPE lakesoul_flight_{k} {kind}")
+            lines.append(f"lakesoul_flight_{k} {v}")
+        return "\n".join(lines) + "\n"
+
+
+def _collect_streams() -> list[tuple[str, str, float, dict]]:
+    with _STREAM_RETIRED_LOCK:
+        agg = {k: _STREAM_RETIRED.get(k, 0) for k in StreamMetrics._FIELDS}
+    for inst in list(_STREAM_INSTANCES):
+        snap = inst.snapshot()
+        for k in agg:
+            agg[k] += snap[k]
+    return [
+        (
+            f"lakesoul_flight_{k}",
+            "gauge" if k.startswith("active") else "counter",
+            v,
+            {},
+        )
+        for k, v in agg.items()
+    ]
+
+
+_REGISTRY.register_collector(_collect_streams)

@@ -63,7 +63,8 @@ class Console:
             "  versions <table>             partition version chains\n"
             "  assets                       per-table data-asset statistics\n"
             "  clean                        run the cleaner (TTLs, discard list)\n"
-            "  cache-stats                  page cache counters\n"
+            "  cache-stats                  page cache counters (via the obs registry)\n"
+            "  obs-stats [prefix]           full metrics-registry snapshot\n"
             "  user-add <name> <pw> [group] register a gateway/proxy user\n"
             "  drop <table>                 drop a table\n"
             "  quit"
@@ -147,10 +148,36 @@ class Console:
         return f"registered user {args[0]} (group {group})"
 
     def cmd_cache_stats(self, args) -> str:
+        # instantiating the configured cache (if any) registers it; the
+        # numbers then come from the registry-backed aggregate, so every
+        # cache the process opened is covered, not just the configured dir
         from lakesoul_tpu.io.object_store import cache_stats
 
-        stats = cache_stats(self.catalog.storage_options)
+        cache_stats(self.catalog.storage_options)
+        from lakesoul_tpu.io.page_cache import registry_cache_stats
+
+        stats = registry_cache_stats()
         return " ".join(f"{k}={v}" for k, v in stats.items())
+
+    def cmd_obs_stats(self, args) -> str:
+        """Dump the process-wide metrics registry (optionally filtered by a
+        series-name prefix, e.g. ``obs-stats lakesoul_cache``)."""
+        from lakesoul_tpu.obs import registry
+
+        prefix = args[0] if args else ""
+        lines = []
+        for name, value in sorted(registry().snapshot().items()):
+            if not name.startswith(prefix):
+                continue
+            if isinstance(value, dict):  # histogram → compact summary
+                mean = (value["sum"] / value["count"]) if value["count"] else 0.0
+                lines.append(
+                    f"{name} count={value['count']} sum={value['sum']:.6f}"
+                    f" mean={mean:.6f}"
+                )
+            else:
+                lines.append(f"{name} {value}")
+        return "\n".join(lines) or "(no metrics recorded)"
 
     def cmd_drop(self, args) -> str:
         self.catalog.drop_table(args[0])
@@ -177,7 +204,9 @@ def main(argv=None) -> int:
     parser.add_argument("-c", "--command", help="run one command and exit")
     args = parser.parse_args(argv)
     from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.obs import configure_logging
 
+    configure_logging()  # LAKESOUL_LOG_FORMAT=json selects structured logs
     console = Console(LakeSoulCatalog(args.warehouse))
     if args.command:
         print(console.execute(args.command))

@@ -16,14 +16,16 @@ Tickets and descriptors are JSON:
                  {"checkpoint_id": ...} for idempotent streaming commits.
 
 Metrics parity with StreamWriteMetrics (flight_sql_service.rs:90): active and
-total streams, rows and bytes in/out, exposed via the ``metrics`` action."""
+total streams, rows and bytes in/out, exposed via the ``metrics`` action and
+aggregated into the shared obs registry.  A client-supplied ``x-trace-id``
+header pins server spans/logs to the caller's trace (and echoes back in the
+response headers)."""
 
 from __future__ import annotations
 
 import base64
 import json
 import threading
-from dataclasses import dataclass, field
 
 import numpy as np
 import pyarrow as pa
@@ -31,45 +33,27 @@ import pyarrow.flight as flight
 
 from lakesoul_tpu.errors import LakeSoulError, RBACError
 from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.obs import StreamMetrics, sanitize_trace_id, span
 from lakesoul_tpu.service.jwt import Claims, JwtServer, UserRegistry
 from lakesoul_tpu.service.rbac import RbacVerifier
 
+TRACE_HEADER = "x-trace-id"
 
-@dataclass
-class StreamMetrics:
-    active_get_streams: int = 0
-    active_put_streams: int = 0
-    total_get_streams: int = 0
-    total_put_streams: int = 0
-    rows_out: int = 0
-    rows_in: int = 0
-    bytes_in: int = 0
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
-    def add(self, **kw) -> None:
-        with self._lock:
-            for k, v in kw.items():
-                setattr(self, k, getattr(self, k) + v)
+class _TraceMiddlewareFactory(flight.ServerMiddlewareFactory):
+    def start_call(self, info, headers):
+        raw = headers.get(TRACE_HEADER) or headers.get(TRACE_HEADER.title())
+        return _TraceMiddleware(sanitize_trace_id(raw[0] if raw else None))
 
-    _FIELDS = (
-        "active_get_streams", "active_put_streams", "total_get_streams",
-        "total_put_streams", "rows_out", "rows_in", "bytes_in",
-    )
 
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {k: getattr(self, k) for k in self._FIELDS}
+class _TraceMiddleware(flight.ServerMiddleware):
+    def __init__(self, trace_id: str | None):
+        self.trace_id = trace_id
 
-    def prometheus_text(self) -> str:
-        """Prometheus exposition format (parity with the reference's
-        PrometheusBuilder exporter, bin/flight_sql_server.rs:21-70)."""
-        snap = self.snapshot()
-        lines = []
-        for k, v in snap.items():
-            kind = "gauge" if k.startswith("active") else "counter"
-            lines.append(f"# TYPE lakesoul_flight_{k} {kind}")
-            lines.append(f"lakesoul_flight_{k} {v}")
-        return "\n".join(lines) + "\n"
+    def sending_headers(self):
+        if self.trace_id:
+            return {TRACE_HEADER: self.trace_id}
+        return {}
 
 
 class _AuthMiddlewareFactory(flight.ServerMiddlewareFactory):
@@ -158,9 +142,19 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         super().__init__(
             location,
             middleware={
-                "auth": _AuthMiddlewareFactory(self.jwt_server, self.user_registry)
+                "auth": _AuthMiddlewareFactory(self.jwt_server, self.user_registry),
+                "trace": _TraceMiddlewareFactory(),
             },
         )
+
+    # ----------------------------------------------------------------- trace
+    def _span(self, context, name: str, **attrs):
+        """A server span pinned to the caller's x-trace-id when supplied."""
+        trace_id = None
+        mw = context.get_middleware("trace")
+        if mw is not None:
+            trace_id = mw.trace_id
+        return span(name, trace_id=trace_id, **attrs)
 
     # ------------------------------------------------------------------ auth
     def _identity(self, context) -> tuple[str, str]:
@@ -207,6 +201,10 @@ class LakeSoulFlightServer(flight.FlightServerBase):
 
     # ----------------------------------------------------------------- DoGet
     def do_get(self, context, ticket):
+        with self._span(context, "flight.do_get") as sp:
+            return self._do_get_json(context, ticket, sp.trace_id)
+
+    def _do_get_json(self, context, ticket, trace_id):
         req = json.loads(ticket.ticket.decode())
         ns = req.get("namespace", "default")
         name = req["table"]
@@ -230,10 +228,17 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         metrics.add(active_get_streams=1, total_get_streams=1)
 
         def gen():
+            # the stream outlives the do_get call: its own DETACHED span
+            # (same trace) measures the full delivery, not just plan time —
+            # detached because enter/exit run in different serving contexts
             try:
-                for batch in scan.to_batches():
-                    metrics.add(rows_out=len(batch))
-                    yield batch
+                with span(
+                    "flight.stream_get", trace_id=trace_id, detached=True,
+                    table=name,
+                ):
+                    for batch in scan.to_batches():
+                        metrics.add(rows_out=len(batch))
+                        yield batch
             finally:
                 metrics.add(active_get_streams=-1)
 
@@ -245,6 +250,10 @@ class LakeSoulFlightServer(flight.FlightServerBase):
 
     # ----------------------------------------------------------------- DoPut
     def do_put(self, context, descriptor, reader, writer):
+        with self._span(context, "flight.do_put"):
+            return self._do_put_json(context, descriptor, reader, writer)
+
+    def _do_put_json(self, context, descriptor, reader, writer):
         ns, name = self._parse_descriptor(descriptor)
         self._check(context, ns, name)
         table = self.catalog.table(name, ns)
@@ -289,6 +298,10 @@ class LakeSoulFlightServer(flight.FlightServerBase):
 
     # --------------------------------------------------------------- actions
     def do_action(self, context, action):
+        with self._span(context, "flight.do_action", action=action.type):
+            return self._do_action_json(context, action)
+
+    def _do_action_json(self, context, action):
         body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
         if action.type == "create_table":
             schema = pa.ipc.read_schema(pa.BufferReader(bytes.fromhex(body["schema_ipc_hex"])))
@@ -419,28 +432,35 @@ class LakeSoulFlightClient:
         *,
         token: str | None = None,
         basic_auth: tuple[str, str] | None = None,
+        trace_id: str | None = None,
     ):
         self._client = flight.FlightClient(location)
+        self._trace_id = trace_id
         self._options = None
         if token:
-            self._options = flight.FlightCallOptions(
-                headers=[(b"authorization", f"Bearer {token}".encode())]
-            )
+            self._set_auth_header(b"authorization", f"Bearer {token}".encode())
         elif basic_auth is not None:
             user, password = basic_auth
             cred = base64.b64encode(f"{user}:{password}".encode()).decode()
-            self._options = flight.FlightCallOptions(
-                headers=[(b"authorization", f"Basic {cred}".encode())]
-            )
+            self._set_auth_header(b"authorization", f"Basic {cred}".encode())
+        elif trace_id is not None:
+            self._set_auth_header(None, None)
+
+    def _set_auth_header(self, name: bytes | None, value: bytes | None) -> None:
+        headers = []
+        if name is not None:
+            headers.append((name, value))
+        if self._trace_id is not None:
+            # server spans/logs carry this id (x-trace-id propagation)
+            headers.append((TRACE_HEADER.encode(), self._trace_id.encode()))
+        self._options = flight.FlightCallOptions(headers=headers)
 
     def login(self, *, ttl_seconds: int = 3600) -> str:
         """Exchange the current credentials for a bearer token and switch
         this client to it (the reference's token-service handshake)."""
         raw = self.action("login", {"ttl_seconds": ttl_seconds})[0]
         token = json.loads(raw.decode())["token"]
-        self._options = flight.FlightCallOptions(
-            headers=[(b"authorization", f"Bearer {token}".encode())]
-        )
+        self._set_auth_header(b"authorization", f"Bearer {token}".encode())
         return token
 
     def scan(self, table: str, **req) -> pa.Table:

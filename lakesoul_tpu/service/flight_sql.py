@@ -633,9 +633,10 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             name, msg = _unpack(descriptor.command)
         if name is None:
             return super().get_flight_info(context, descriptor)
-        return self._result_info(
-            descriptor, self._descriptor_result(context, name, msg)
-        )
+        with self._span(context, "flightsql.get_flight_info", command=name):
+            return self._result_info(
+                descriptor, self._descriptor_result(context, name, msg)
+            )
 
     def get_schema(self, context, descriptor):
         name, msg = (None, None)
@@ -653,17 +654,18 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         name, msg = _unpack(ticket.ticket)
         if name is None:
             return super().do_get(context, ticket)
-        if name == "TicketStatementQuery":
-            result = self._take_result(msg.statement_handle)
-        elif name == "CommandStatementQuery":
-            # liberal servers accept the command directly as a ticket
-            result = self._execute_sql(context, msg.query)
-        else:
-            result = self._metadata_result(name, msg)
-        self.metrics.add(
-            total_get_streams=1, rows_out=result.num_rows
-        )
-        return flight.RecordBatchStream(result)
+        with self._span(context, "flightsql.do_get", command=name):
+            if name == "TicketStatementQuery":
+                result = self._take_result(msg.statement_handle)
+            elif name == "CommandStatementQuery":
+                # liberal servers accept the command directly as a ticket
+                result = self._execute_sql(context, msg.query)
+            else:
+                result = self._metadata_result(name, msg)
+            self.metrics.add(
+                total_get_streams=1, rows_out=result.num_rows
+            )
+            return flight.RecordBatchStream(result)
 
     def do_put(self, context, descriptor, reader, writer):
         name, msg = (None, None)
@@ -671,6 +673,10 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             name, msg = _unpack(descriptor.command)
         if name is None:
             return super().do_put(context, descriptor, reader, writer)
+        with self._span(context, "flightsql.do_put", command=name):
+            return self._do_put_sql(context, name, msg, reader, writer)
+
+    def _do_put_sql(self, context, name, msg, reader, writer):
         if name == "CommandStatementUpdate":
             n = self._run_update(context, msg.query)
             self._write_update_result(writer, n)
@@ -1112,30 +1118,11 @@ class FlightSqlClient:
 
 
 def _serve_prometheus(metrics, port: int, host: str = "0.0.0.0"):
-    """Prometheus exposition endpoint (parity with the reference server's
-    PrometheusBuilder, bin/flight_sql_server.rs:21-22): GET /metrics."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    """Prometheus exposition endpoint — THE single implementation lives in
+    obs/exporter.py; this alias keeps the historical entry point."""
+    from lakesoul_tpu.obs import serve_prometheus
 
-    class Handler(BaseHTTPRequestHandler):
-        def log_message(self, *a):
-            pass
-
-        def do_GET(self):
-            if self.path.rstrip("/") not in ("", "/metrics"):
-                self.send_error(404)
-                return
-            body = metrics.prometheus_text().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-    srv = ThreadingHTTPServer((host, port), Handler)
-    import threading
-
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    return srv
+    return serve_prometheus(metrics, port, host)
 
 
 def main(argv=None) -> int:
@@ -1164,7 +1151,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.obs import configure_logging, registry
 
+    configure_logging()  # LAKESOUL_LOG_FORMAT=json selects structured logs
     catalog = LakeSoulCatalog(args.warehouse, db_path=args.db_path)
     server = LakeSoulFlightSqlServer(
         catalog, f"grpc://{args.host}:{args.port}", jwt_secret=args.jwt_secret
@@ -1172,8 +1161,9 @@ def main(argv=None) -> int:
     metrics_srv = None
     if args.metrics_port:
         # metrics bind the SAME interface as the gateway: --host 127.0.0.1
-        # must not leave /metrics world-reachable
-        metrics_srv = _serve_prometheus(server.metrics, args.metrics_port, args.host)
+        # must not leave /metrics world-reachable.  The endpoint serves the
+        # WHOLE registry: stream, cache, executor, meta, compaction, loader
+        metrics_srv = _serve_prometheus(registry(), args.metrics_port, args.host)
         print(f"metrics on http://{args.host}:{args.metrics_port}/metrics", flush=True)
     print(
         f"Flight SQL server on grpc://{args.host}:{server.port}"

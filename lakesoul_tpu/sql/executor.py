@@ -7,12 +7,22 @@ compute kernels.  INSERT/CREATE/DROP route through the ACID catalog paths."""
 
 from __future__ import annotations
 
+import time
+
 import pyarrow as pa
 import pyarrow.compute as pc
 
 from lakesoul_tpu.io.filters import Filter
+from lakesoul_tpu.obs import registry, span
 from lakesoul_tpu.sql import parser as ast
 from lakesoul_tpu.sql.parser import SqlError, parse
+
+
+def _stage_observe(stage: str, started: float) -> None:
+    """Per-stage executor latency: lakesoul_sql_stage_seconds{stage=...}."""
+    registry().histogram("lakesoul_sql_stage_seconds", stage=stage).observe(
+        time.perf_counter() - started
+    )
 
 # date-part function → Arrow kernel (parser.EXTRACT_PARTS mirrors the keys)
 _DATE_PARTS = {
@@ -669,7 +679,9 @@ class SqlSession:
         return out
 
     def execute(self, sql: str) -> pa.Table:
+        started = time.perf_counter()
         stmt = parse(sql)
+        _stage_observe("parse", started)
         target = getattr(stmt, "table", None)
         if target in self._externals and isinstance(
             stmt,
@@ -678,9 +690,14 @@ class SqlSession:
         ):
             raise SqlError(f"external table {target!r} is read-only")
         self._ext_memo: dict[str, pa.Table] = {}
+        started = time.perf_counter()
         try:
-            return self._execute_stmt(stmt)
+            # the statement span carries any client-propagated trace id down
+            # into io/meta spans opened underneath
+            with span("sql.execute", statement=type(stmt).__name__):
+                return self._execute_stmt(stmt)
         finally:
+            _stage_observe("execute", started)
             # a fetched external snapshot must not stay pinned past the
             # statement on a long-lived session
             self._ext_memo = None
@@ -1124,9 +1141,14 @@ class SqlSession:
             if stmt.where is not None:
                 residual_nodes = [stmt.where]
         else:
+            started = time.perf_counter()
             scan, residual_nodes = self._plan_base(stmt, has_aggs)
-            table = scan.to_arrow()
+            _stage_observe("plan", started)
+            started = time.perf_counter()
+            table = scan.to_arrow()  # merge-on-read timings land in lakesoul_io_*
+            _stage_observe("scan", started)
 
+        emit_started = time.perf_counter()
         # ---- joins (hash joins on Arrow compute; right side may be derived)
         for j in stmt.joins:
             if j.subquery is not None:
@@ -1242,7 +1264,9 @@ class SqlSession:
             out = out.sort_by(keys)
         if hidden:
             out = out.drop_columns(hidden)
-        return _slice_limit_offset(out, stmt)
+        out = _slice_limit_offset(out, stmt)
+        _stage_observe("emit", emit_started)
+        return out
 
     def _needed_columns(self, stmt: ast.Select, residual_nodes: list) -> set[str]:
         cols: set[str] = set(stmt.group_by)

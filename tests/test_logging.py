@@ -1,7 +1,10 @@
 """Data-plane observability (VERDICT r1 #9): commit retries, scan/flush
 timings, and cache hits are visible in captured logs — the role of the
-reference's `tracing` instrumentation (reader.rs:116,147, pyo3-log)."""
+reference's `tracing` instrumentation (reader.rs:116,147, pyo3-log) — and
+the structured JSON formatter stamps the active span's trace id."""
 
+import io
+import json
 import logging
 
 import fsspec
@@ -80,3 +83,79 @@ class TestCacheLogging:
         hits = [r for r in caplog.records if "hit" in r.getMessage()]
         assert any("4 hit / 0 miss" in r.getMessage() for r in hits)
         fs.rm("/lg", recursive=True)
+
+
+class TestJsonLogFormat:
+    """LAKESOUL_LOG_FORMAT=json: one JSON object per line, trace_id stamped
+    whenever a span is active (obs satellite)."""
+
+    def test_formatter_stamps_trace_id_inside_span(self):
+        from lakesoul_tpu.obs import span
+        from lakesoul_tpu.obs.logging import JsonLogFormatter
+
+        logger = logging.getLogger("lakesoul_tpu.tests.jsonfmt")
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.setFormatter(JsonLogFormatter())
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+        try:
+            with span("json-fmt-test", trace_id="tid-json-1"):
+                logger.info("inside %d", 1)
+            logger.warning("outside")
+        finally:
+            logger.removeHandler(handler)
+            logger.propagate = True
+        lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+        assert lines[0]["msg"] == "inside 1"
+        assert lines[0]["trace_id"] == "tid-json-1"
+        assert lines[0]["level"] == "INFO"
+        assert lines[0]["logger"] == "lakesoul_tpu.tests.jsonfmt"
+        assert "ts" in lines[0]
+        # no active span → no trace_id key at all (not a null)
+        assert lines[1]["level"] == "WARNING"
+        assert "trace_id" not in lines[1]
+
+    def test_exception_serialized(self):
+        from lakesoul_tpu.obs.logging import JsonLogFormatter
+
+        logger = logging.getLogger("lakesoul_tpu.tests.jsonexc")
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.setFormatter(JsonLogFormatter())
+        logger.addHandler(handler)
+        logger.propagate = False
+        try:
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                logger.exception("failed")
+        finally:
+            logger.removeHandler(handler)
+            logger.propagate = True
+        rec = json.loads(buf.getvalue())
+        assert rec["msg"] == "failed"
+        assert "ValueError: boom" in rec["exc"]
+
+    def test_env_var_selects_json(self, monkeypatch):
+        from lakesoul_tpu.obs.logging import JsonLogFormatter, configure_logging
+
+        monkeypatch.setenv("LAKESOUL_LOG_FORMAT", "json")
+        root = logging.getLogger("lakesoul_tpu")
+        handler = configure_logging(stream=io.StringIO())
+        try:
+            assert isinstance(handler.formatter, JsonLogFormatter)
+            # idempotent: reconfiguring replaces, never stacks
+            monkeypatch.setenv("LAKESOUL_LOG_FORMAT", "text")
+            handler2 = configure_logging(stream=io.StringIO())
+            configured = [
+                h for h in root.handlers
+                if getattr(h, "_lakesoul_configured", False)
+            ]
+            assert configured == [handler2]
+            assert not isinstance(handler2.formatter, JsonLogFormatter)
+        finally:
+            for h in list(root.handlers):
+                if getattr(h, "_lakesoul_configured", False):
+                    root.removeHandler(h)

@@ -1,0 +1,325 @@
+"""Unified observability layer (obs/): registry thread-safety, histogram
+bucket edges, span nesting + trace-id propagation over a live Flight round
+trip, loader rows/sec counters, and the single /metrics endpoint."""
+
+import threading
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.obs import (
+    MetricsRegistry,
+    current_trace_id,
+    recent_spans,
+    registry,
+    sanitize_trace_id,
+    span,
+)
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+@pytest.fixture()
+def catalog(tmp_warehouse):
+    return LakeSoulCatalog(str(tmp_warehouse))
+
+
+class TestRegistry:
+    def test_counter_thread_safety(self):
+        reg = MetricsRegistry()
+        c = reg.counter("lakesoul_test_inc_total")
+
+        def work():
+            for _ in range(10_000):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 80_000
+
+    def test_metrics_memoized_per_name_and_labels(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", op="a")
+        assert reg.counter("x_total", op="a") is a
+        assert reg.counter("x_total", op="b") is not a
+        # a name is permanently bound to its first kind
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_histogram_bucket_edges(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_seconds", buckets=(1.0, 5.0, 10.0))
+        for v in (0.5, 1.0, 1.00001, 5.0, 42.0):
+            h.observe(v)
+        snap = h.value
+        # Prometheus cumulative le semantics: bucket counts values <= bound
+        assert snap["buckets"][1.0] == 2  # 0.5, 1.0 (edge value included)
+        assert snap["buckets"][5.0] == 4  # + 1.00001, 5.0
+        assert snap["buckets"][10.0] == 4
+        assert snap["count"] == 5  # +Inf picks up 42.0
+        assert snap["sum"] == pytest.approx(49.50001)
+        text = reg.prometheus_text()
+        assert "# TYPE h_seconds histogram" in text
+        assert 'h_seconds_bucket{le="+Inf"} 5' in text
+        assert "h_seconds_count 5" in text
+
+    def test_histogram_bucket_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.histogram("hb_seconds", buckets=(1.0, 5.0))
+        assert reg.histogram("hb_seconds").bounds == (1.0, 5.0)  # no-arg OK
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("hb_seconds", buckets=(1.0, 10.0))
+
+    def test_stream_counters_survive_instance_gc(self):
+        import gc
+
+        from lakesoul_tpu.obs.metrics import StreamMetrics, _collect_streams
+
+        def total(samples):
+            return {n: v for n, _k, v, _l in samples}["lakesoul_flight_rows_in"]
+
+        before = total(_collect_streams())
+        sm = StreamMetrics()
+        sm.add(rows_in=11)
+        assert total(_collect_streams()) == before + 11
+        del sm
+        gc.collect()
+        # counters stay monotonic across instance churn (gauges drop)
+        assert total(_collect_streams()) == before + 11
+
+    def test_gauge_set_inc_dec_and_function(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g_depth")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value == 6
+        g2 = reg.gauge("g_sampled")
+        g2.set_function(lambda: 7)
+        assert reg.snapshot()["g_sampled"] == 7
+
+    def test_collector_merged_into_exposition(self):
+        reg = MetricsRegistry()
+        reg.register_collector(lambda: [("ext_total", "counter", 3, {"src": "a"})])
+        reg.register_collector(lambda: [("ext_total", "counter", 4, {"src": "a"})])
+        snap = reg.snapshot()
+        assert snap['ext_total{src="a"}'] == 7  # same series sums
+        assert 'ext_total{src="a"} 7' in reg.prometheus_text()
+
+    def test_broken_collector_does_not_break_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("ok_total").inc()
+
+        def broken():
+            raise RuntimeError("sampler died")
+
+        reg.register_collector(broken)
+        assert reg.snapshot()["ok_total"] == 1
+
+
+class TestSpans:
+    def test_nesting_and_trace_inheritance(self):
+        assert current_trace_id() is None
+        with span("obs-parent") as p:
+            assert current_trace_id() == p.trace_id
+            with span("obs-child") as c:
+                assert c.trace_id == p.trace_id
+                assert c.parent_id == p.span_id
+        assert current_trace_id() is None
+        got = recent_spans(name="obs-child", trace_id=p.trace_id)
+        assert got and got[-1]["parent_id"] == p.span_id
+
+    def test_explicit_trace_id_pins_the_trace(self):
+        with span("a", trace_id="tid-outer"):
+            with span("b", trace_id="tid-pinned") as b:
+                assert b.trace_id == "tid-pinned"
+
+    def test_duration_lands_in_registry_histogram(self):
+        with span("obs-timed") as s:
+            pass
+        assert s.duration_s is not None and s.duration_s >= 0.0
+        snap = registry().snapshot()
+        key = 'lakesoul_span_seconds{name="obs-timed"}'
+        assert snap[key]["count"] >= 1
+
+    def test_sanitize_trace_id(self):
+        assert sanitize_trace_id("ok-id_1.2") == "ok-id_1.2"
+        assert sanitize_trace_id(b"abc") == "abc"
+        assert sanitize_trace_id("") is None
+        assert sanitize_trace_id("bad id") is None
+        assert sanitize_trace_id("x" * 65) is None
+
+
+class TestFlightTracePropagation:
+    def test_client_supplied_trace_id_shows_in_server_spans(self, catalog):
+        from lakesoul_tpu.service.flight import (
+            LakeSoulFlightClient,
+            LakeSoulFlightServer,
+        )
+
+        t = catalog.create_table("tr", SCHEMA)
+        t.write_arrow(pa.table({"id": [1, 2], "v": [1.0, 2.0]}))
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0")
+        try:
+            client = LakeSoulFlightClient(
+                f"grpc://127.0.0.1:{server.port}", trace_id="feedbeef-042"
+            )
+            out = client.scan("tr")
+            assert out.num_rows == 2
+            client.action("metrics")
+            names = {s["name"] for s in recent_spans(trace_id="feedbeef-042")}
+            assert "flight.do_get" in names
+            assert "flight.stream_get" in names  # the streamed delivery too
+            assert "flight.do_action" in names
+        finally:
+            server.shutdown()
+
+    def test_flight_sql_query_carries_trace_into_executor(self, catalog):
+        import pyarrow.flight as flight
+
+        from lakesoul_tpu.service.flight_sql import (
+            LakeSoulFlightSqlServer,
+            _pack,
+            pb,
+        )
+
+        t = catalog.create_table("trsql", SCHEMA)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        server = LakeSoulFlightSqlServer(catalog, "grpc://127.0.0.1:0")
+        try:
+            opts = flight.FlightCallOptions(
+                headers=[(b"x-trace-id", b"sqltrace-7")]
+            )
+            client = flight.FlightClient(f"grpc://127.0.0.1:{server.port}")
+            desc = flight.FlightDescriptor.for_command(
+                _pack(pb.CommandStatementQuery(query="SELECT id FROM trsql"))
+            )
+            info = client.get_flight_info(desc, options=opts)
+            client.do_get(info.endpoints[0].ticket, options=opts).read_all()
+            names = {s["name"] for s in recent_spans(trace_id="sqltrace-7")}
+            assert "flightsql.get_flight_info" in names
+            assert "sql.execute" in names  # nested under the gateway span
+        finally:
+            server.shutdown()
+
+
+class TestLoaderTelemetry:
+    def test_rows_per_sec_queue_depth_and_epoch_totals(self, catalog):
+        t = catalog.create_table("ld", SCHEMA)
+        n = 100
+        t.write_arrow(
+            pa.table({"id": list(range(n)), "v": [float(i) for i in range(n)]})
+        )
+        before = registry().snapshot().get("lakesoul_loader_rows_total", 0)
+        it = t.scan().batch_size(16).to_jax_iter(
+            device_put=False, drop_remainder=False
+        )
+        rows = sum(len(b["id"]) for b in it)
+        assert rows == n
+        stats = it.stats()
+        assert stats["rows"] == n
+        assert stats["batches"] == 7  # 6 × 16 + tail
+        assert stats["epochs"] == 1
+        assert stats["epoch_rows"] == [n]
+        assert stats["rows_per_sec"] > 0
+        assert stats["batches_per_sec"] > 0
+        assert stats["stall_s"] >= 0.0
+        assert "queue_depth" in stats
+        after = registry().snapshot()["lakesoul_loader_rows_total"]
+        assert after - before == n
+
+    def test_second_epoch_accumulates(self, catalog):
+        t = catalog.create_table("ld2", SCHEMA)
+        t.write_arrow(pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0]}))
+        it = t.scan().batch_size(2).to_jax_iter(
+            device_put=False, drop_remainder=False
+        )
+        for _ in it:
+            pass
+        for _ in it:
+            pass
+        stats = it.stats()
+        assert stats["epochs"] == 2
+        assert stats["rows"] == 6
+        assert stats["epoch_rows"] == [3, 3]
+
+    def test_abandoned_epoch_is_not_counted_complete(self, catalog):
+        t = catalog.create_table("ld3", SCHEMA)
+        t.write_arrow(pa.table({"id": list(range(50)), "v": [0.0] * 50}))
+        it = t.scan().batch_size(4).to_jax_iter(
+            device_put=False, drop_remainder=False
+        )
+        for _ in it:
+            break  # consumer abandons mid-epoch
+        stats = it.stats()
+        assert stats["epochs"] == 0
+        assert stats["rows"] >= 4
+
+
+class TestUnifiedMetricsEndpoint:
+    def test_one_endpoint_serves_every_layer(self, catalog, tmp_path):
+        """Acceptance: /metrics on a gateway process shows stream, cache,
+        executor-latency, and loader series from ONE registry."""
+        import fsspec
+
+        from lakesoul_tpu.io.page_cache import DiskPageCache
+        from lakesoul_tpu.obs import serve_prometheus
+        from lakesoul_tpu.service.flight import LakeSoulFlightClient
+        from lakesoul_tpu.service.flight_sql import LakeSoulFlightSqlServer
+
+        t = catalog.create_table("obs_all", SCHEMA)
+        t.write_arrow(pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0]}))
+
+        # page cache traffic
+        fs = fsspec.filesystem("memory")
+        fs.pipe_file("/obs/blob", b"z" * 2048)
+        cache = DiskPageCache(str(tmp_path / "c"), page_bytes=512)
+        cache.read_range(fs, "/obs/blob", 0, 2048)
+        cache.read_range(fs, "/obs/blob", 0, 2048)
+
+        # loader traffic
+        for _ in t.scan().batch_size(2).to_jax_iter(
+            device_put=False, drop_remainder=False
+        ):
+            pass
+
+        server = LakeSoulFlightSqlServer(catalog, "grpc://127.0.0.1:0")
+        srv = serve_prometheus(port=0, host="127.0.0.1")
+        try:
+            # gateway + executor traffic
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            client.scan("obs_all")
+            client.action("sql", {"statement": "SELECT id FROM obs_all"})
+
+            port = srv.server_address[1]
+            text = (
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics")
+                .read()
+                .decode()
+            )
+            assert "lakesoul_flight_total_get_streams" in text  # streams
+            assert "lakesoul_cache_hits_total" in text  # page cache
+            assert "lakesoul_sql_stage_seconds_bucket" in text  # executor
+            assert "lakesoul_loader_rows_total" in text  # loader
+            assert "lakesoul_io_scan_unit_seconds_bucket" in text  # io
+            assert "lakesoul_meta_commits_total" in text  # meta commits
+        finally:
+            srv.shutdown()
+            server.shutdown()
+            fs.rm("/obs", recursive=True)
+
+    def test_obs_stats_console_command(self, catalog):
+        from lakesoul_tpu.service.console import Console
+
+        console = Console(catalog)
+        t = catalog.create_table("obs_c", SCHEMA)
+        t.write_arrow(pa.table({"id": [1], "v": [1.0]}))
+        out = console.execute("obs-stats lakesoul_meta")
+        assert "lakesoul_meta_commits_total" in out
+        cache_out = console.execute("cache-stats")
+        assert "hits=" in cache_out and "hit_rate=" in cache_out
