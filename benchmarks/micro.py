@@ -9,6 +9,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py cache      # page-cache hit/miss throughput
     python benchmarks/micro.py spill      # writer auto-flush (spill) + re-merge
     python benchmarks/micro.py meta       # plan 1 partition out of 100k (ms)
+    python benchmarks/micro.py pipeline   # serial vs runtime-pipelined scan
     python benchmarks/micro.py all
 """
 
@@ -264,6 +265,89 @@ def bench_meta_prune(n_partitions: int = 100_000) -> None:
         )
 
 
+def bench_pipeline_scan(
+    n_rows: int = 800_000, n_files: int = 8, latency_s: float = 0.04
+) -> None:
+    """Serial vs runtime-pipelined scan of one multi-file (multi-row-group)
+    table on a latency-injected object store — the overlap win the
+    lakesoul_tpu/runtime/ subsystem exists for: with one worker every file
+    GET serializes; with the pool, fetch+decode of all files overlap (and
+    MOR-free postprocess overlaps decode).  The batch streams must be
+    BYTE-IDENTICAL between modes (the pipeline's ordered-merge guarantee);
+    this leg asserts it."""
+    import fsspec
+    from fsspec.implementations.memory import MemoryFileSystem
+
+    class SlowScanFS(MemoryFileSystem):
+        protocol = "slowscan"
+        latency = latency_s
+
+        def _open(self, *a, **k):
+            time.sleep(SlowScanFS.latency)  # per-object GET latency
+            return super()._open(*a, **k)
+
+        def cat_file(self, *a, **k):
+            time.sleep(SlowScanFS.latency)
+            return super().cat_file(*a, **k)
+
+    if "slowscan" not in fsspec.registry:
+        fsspec.register_implementation("slowscan", SlowScanFS, clobber=True)
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.runtime import shutdown_pool
+
+    def set_pool(n: int) -> None:
+        shutdown_pool()
+        os.environ["LAKESOUL_RUNTIME_THREADS"] = str(n)
+
+    prev_threads = os.environ.get("LAKESOUL_RUNTIME_THREADS")
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        catalog = LakeSoulCatalog(
+            "slowscan://pipe-bench/wh", db_path=os.path.join(d, "meta.db")
+        )
+        schema = pa.schema(
+            [("id", pa.int64()), ("f0", pa.float32()), ("f1", pa.float32())]
+        )
+        t = catalog.create_table("scanme", schema)
+        per = n_rows // n_files
+        for i in range(n_files):
+            t.write_arrow(pa.table({
+                "id": np.arange(i * per, (i + 1) * per),
+                "f0": rng.normal(size=per).astype(np.float32),
+                "f1": rng.normal(size=per).astype(np.float32),
+            }, schema=schema))
+        try:
+            set_pool(1)
+            start = time.perf_counter()
+            serial = list(t.scan().batch_size(65_536).to_batches())
+            serial_dt = time.perf_counter() - start
+
+            set_pool(8)
+            start = time.perf_counter()
+            piped = list(t.scan().batch_size(65_536).to_batches(num_threads=8))
+            piped_dt = time.perf_counter() - start
+        finally:
+            shutdown_pool()
+            if prev_threads is None:
+                os.environ.pop("LAKESOUL_RUNTIME_THREADS", None)
+            else:
+                os.environ["LAKESOUL_RUNTIME_THREADS"] = prev_threads
+
+        # determinism contract: byte-identical batch order across modes
+        assert len(serial) == len(piped), (len(serial), len(piped))
+        for a, b in zip(serial, piped):
+            assert a.equals(b)
+        rows = sum(len(b) for b in serial)
+        assert rows == n_rows
+        _emit(
+            "pipeline_scan", n_rows / piped_dt, "rows/s",
+            serial_rows_per_s=round(n_rows / serial_dt, 1),
+            speedup=round(serial_dt / piped_dt, 2),
+            files=n_files, fetch_latency_ms=latency_s * 1e3,
+        )
+
+
 LEGS = {
     "merge": bench_merge,
     "formats": bench_formats,
@@ -271,6 +355,7 @@ LEGS = {
     "cache": bench_cache,
     "spill": bench_spill,
     "meta": bench_meta_prune,
+    "pipeline": bench_pipeline_scan,
 }
 
 

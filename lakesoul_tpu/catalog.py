@@ -11,7 +11,6 @@ adapters).
 from __future__ import annotations
 
 import copy
-import threading
 from typing import Any, Iterable, Iterator
 
 import numpy as np
@@ -28,6 +27,7 @@ from lakesoul_tpu.meta import (
     MetaDataClient,
     ScanPlanPartition,
 )
+from lakesoul_tpu.runtime import pipeline as rt_pipeline
 from lakesoul_tpu.meta.entity import (
     CDC_DEFAULT_COLUMN,
     PROP_CDC_CHANGE_COLUMN,
@@ -935,27 +935,45 @@ class LakeSoulScan:
             base = pa.schema([base.field(c) for c in self._columns])
         return base.empty_table()
 
-    def to_arrow(self) -> pa.Table:
+    def to_arrow(self, *, parallel: bool | None = None) -> pa.Table:
+        """Materialize the scan.  ``parallel=None`` (auto) decodes scan
+        units concurrently on the shared runtime pool when there is more
+        than one; unit order is preserved, so the result is byte-identical
+        to ``parallel=False``."""
         if self._limit is not None:
             batches = list(self.to_batches())
             if batches:
                 return pa.Table.from_batches(batches)
             return self._projected_empty_table()
         if self._vector_search is not None:
-            return self._resolve_vector_search().to_arrow()
+            return self._resolve_vector_search().to_arrow(parallel=parallel)
         if self._cache:
             key = self._cache_key()
             hit = self._table.catalog._scan_cache_get(key)
             if hit is not None:
                 return hit
-            result = self._replace(_cache=False).to_arrow()
+            result = self._replace(_cache=False).to_arrow(parallel=parallel)
             self._table.catalog._scan_cache_put(key, result)
             return result
-        tables = []
-        for unit in self.scan_plan():
-            t = read_scan_unit(unit.data_files, unit.primary_keys, **self._unit_kwargs(unit))
-            if len(t):
-                tables.append(t)
+        units = self.scan_plan()
+
+        def _read_unit(unit: ScanPlanPartition) -> pa.Table:
+            return read_scan_unit(
+                unit.data_files, unit.primary_keys, **self._unit_kwargs(unit)
+            )
+
+        if parallel is None:
+            parallel = len(units) > 1
+        if parallel and len(units) > 1:
+            # ordered parallel fan-out over scan units (MOR merge of unit k
+            # overlaps fetch+decode of units k+1..): deterministic unit
+            # order in, deterministic table out
+            decoded = rt_pipeline("scan").source(units).map_parallel(
+                _read_unit, name="unit"
+            ).run()
+            tables = [t for t in decoded if len(t)]
+        else:
+            tables = [t for t in map(_read_unit, units) if len(t)]
         if not tables:
             return self._projected_empty_table()
         return pa.concat_tables(tables, promote_options="default").combine_chunks()
@@ -1069,14 +1087,13 @@ class LakeSoulScan:
                     **self._unit_kwargs(unit),
                 )
             return
-        import queue as _queue
-        from concurrent.futures import ThreadPoolExecutor
-
         # work items: merge units stay whole (the merge needs all streams of
         # a bucket), plain units split per file; every item STREAMS its
-        # batches into a small bounded queue, so the in-flight window holds
-        # a few batches per unit — never a materialized unit.  The byte
-        # budget splits across the concurrent units.
+        # batches through the runtime pipeline's bounded per-slot queues, so
+        # the in-flight window holds a few batches per unit — never a
+        # materialized unit.  The byte budget splits across the concurrent
+        # units.  Slot order = item order, so the batch stream is
+        # byte-identical to the serial path.
         items: list[tuple[ScanPlanPartition, list[str], list[int] | None]] = []
         cfg = self._table.io_config()
         for u in units:
@@ -1089,63 +1106,31 @@ class LakeSoulScan:
             else:
                 items.extend((u, [f], None) for f in u.data_files)
 
-        window = num_threads + 1
-        unit_budget = max(8 << 20, cfg.memory_budget_bytes // window)
-        _DONE = object()
+        unit_budget = max(8 << 20, cfg.memory_budget_bytes // (num_threads + 1))
 
-        def put(q: _queue.Queue, stop: threading.Event, item) -> bool:
-            # every put must honor stop: an abandoned generator would leave a
-            # producer blocked forever on a full queue (pool threads are
-            # non-daemon — the interpreter would hang at exit)
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
-
-        def stream(item, q: _queue.Queue, stop: threading.Event):
+        def stream_item(item):
             unit, files, sizes = item
-            try:
-                for batch in iter_scan_unit_batches(
-                    files,
-                    unit.primary_keys,
-                    batch_size=self._batch_size,
-                    memory_budget_bytes=unit_budget,
-                    file_sizes=sizes,
-                    **self._unit_kwargs(unit),
-                ):
-                    if not put(q, stop, batch):
-                        return
-                put(q, stop, _DONE)
-            except BaseException as e:  # surface errors to the consumer
-                put(q, stop, e)
+            return iter_scan_unit_batches(
+                files,
+                unit.primary_keys,
+                batch_size=self._batch_size,
+                memory_budget_bytes=unit_budget,
+                file_sizes=sizes,
+                **self._unit_kwargs(unit),
+            )
 
-        stop = threading.Event()
-        queues: list[_queue.Queue] = [_queue.Queue(maxsize=4) for _ in items]
-        ex = ThreadPoolExecutor(max_workers=num_threads)
+        it = (
+            rt_pipeline("scan")
+            .source(items)
+            .flat_map_parallel(
+                stream_item, workers=num_threads, buffer=4, name="unit_stream"
+            )
+            .run()
+        )
         try:
-            for it, q in zip(items[:window], queues[:window]):
-                ex.submit(stream, it, q, stop)
-            next_item = window
-            for i in range(len(items)):
-                q = queues[i]
-                while True:
-                    got = q.get()
-                    if got is _DONE:
-                        break
-                    if isinstance(got, BaseException):
-                        raise got
-                    yield got
-                queues[i] = None  # release
-                if next_item < len(items):
-                    ex.submit(stream, items[next_item], queues[next_item], stop)
-                    next_item += 1
+            yield from it
         finally:
-            # abandoned generator: unblock and stop producers
-            stop.set()
-            ex.shutdown(wait=False, cancel_futures=True)
+            it.close()  # abandoned generator: stop producers promptly
 
     def count_rows(self) -> int:
         """Row count; metadata-only when no decode is needed (reference:

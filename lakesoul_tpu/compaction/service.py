@@ -8,21 +8,23 @@ partition's version gap since the last CompactionCommit reaches the threshold
 the compaction through the normal write path.
 
 Here the metadata store fires the same event synchronously
-(SqliteMetadataStore._fire_compaction_triggers); the service consumes them on
-a bounded queue with N worker threads, deduplicates in-flight partitions, and
-also supports size-tiered scheduled sweeps (the reference's "new compaction"
-path with file-number/size limits)."""
+(SqliteMetadataStore._fire_compaction_triggers); the service runs jobs on
+the shared execution runtime's worker pool (lakesoul_tpu/runtime/pool.py —
+no dedicated threads), bounded to ``workers`` concurrent jobs over a
+bounded pending queue, deduplicates in-flight partitions, and also supports
+size-tiered scheduled sweeps (the reference's "new compaction" path with
+file-number/size limits)."""
 
 from __future__ import annotations
 
 import logging
-import queue
 import threading
 from dataclasses import dataclass, field
 
 from lakesoul_tpu.errors import CommitConflictError
 from lakesoul_tpu.meta.store import CompactionEvent
 from lakesoul_tpu.obs import registry, span
+from lakesoul_tpu.runtime import get_pool
 
 logger = logging.getLogger(__name__)
 
@@ -45,7 +47,8 @@ class CompactionStats:
 
 
 class CompactionService:
-    """Consume compaction events for one catalog and compact on worker threads.
+    """Consume compaction events for one catalog and compact them as jobs on
+    the shared runtime pool (at most ``workers`` concurrently).
 
     Usage::
 
@@ -66,74 +69,106 @@ class CompactionService:
         self.catalog = catalog
         self.workers = workers
         self.min_file_num = min_file_num
+        self.queue_size = queue_size
         self.stats = CompactionStats()
-        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._lock = threading.Lock()
+        self._pending: list[CompactionEvent] = []
+        self._running = 0
         self._in_flight: set[tuple[str, str]] = set()
-        self._in_flight_lock = threading.Lock()
-        self._threads: list[threading.Thread] = []
+        self._idle = threading.Condition(self._lock)
         self._stop = threading.Event()
+        # updated with inc/dec DELTAS, never set(): several services in one
+        # process (one per catalog) then aggregate instead of clobbering
+        # each other's snapshots
+        reg = registry()
+        self._g_pending = reg.gauge("lakesoul_compaction_pending")
+        self._g_running = reg.gauge("lakesoul_compaction_running")
 
     # --------------------------------------------------------------- control
     def start(self) -> None:
+        self._stop.clear()
         self.catalog.client.store.add_compaction_listener(self._on_event)
-        for i in range(self.workers):
-            t = threading.Thread(target=self._worker, name=f"compaction-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
+        """Unsubscribe, drop queued events, wait (bounded) for running jobs."""
         self._stop.set()
         try:
             self.catalog.client.store.remove_compaction_listener(self._on_event)
         except ValueError:
             pass
-        for t in self._threads:
-            t.join(timeout=5)
-        self._threads.clear()
-
-    def drain(self, timeout: float = 30.0) -> None:
-        """Block until the event queue is empty and workers are idle."""
         import time
 
         deadline = time.time() + timeout
-        while time.time() < deadline:
-            with self._in_flight_lock:
-                busy = bool(self._in_flight)
-            if self._queue.empty() and not busy:
-                return
-            time.sleep(0.02)
+        with self._idle:
+            for ev in self._pending:
+                self._in_flight.discard((ev.table_id, ev.partition_desc))
+            self._g_pending.dec(len(self._pending))
+            self._pending.clear()
+            while self._running:
+                left = deadline - time.time()
+                if left <= 0:
+                    break
+                self._idle.wait(timeout=left)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until no events are pending and no job is running."""
+        import time
+
+        deadline = time.time() + timeout
+        with self._idle:
+            while self._pending or self._running:
+                left = deadline - time.time()
+                if left <= 0:
+                    return
+                self._idle.wait(timeout=min(left, 0.1))
 
     # ---------------------------------------------------------------- events
     def _on_event(self, event: CompactionEvent) -> None:
         self.stats.bump("triggered")
         key = (event.table_id, event.partition_desc)
-        with self._in_flight_lock:
+        with self._lock:
+            if self._stop.is_set():
+                return
             if key in self._in_flight:
                 return  # already queued/running for this partition
+            if len(self._pending) >= self.queue_size:
+                logger.warning("compaction queue full; dropping event for %s", key)
+                return
             self._in_flight.add(key)
-        try:
-            self._queue.put_nowait(event)
-        except queue.Full:
-            with self._in_flight_lock:
-                self._in_flight.discard(key)
-            logger.warning("compaction queue full; dropping event for %s", key)
+            self._pending.append(event)
+            self._g_pending.inc()
+        self._pump()
 
-    def _worker(self) -> None:
-        while not self._stop.is_set():
-            try:
-                event = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            key = (event.table_id, event.partition_desc)
-            try:
+    def _pump(self) -> None:
+        """Submit pending events to the pool up to the ``workers`` bound."""
+        while True:
+            with self._lock:
+                if self._stop.is_set() or self._running >= self.workers or not self._pending:
+                    return
+                event = self._pending.pop(0)
+                self._running += 1
+                self._g_pending.dec()
+                self._g_running.inc()
+            get_pool().submit(self._job, event)
+
+    def _job(self, event: CompactionEvent) -> None:
+        key = (event.table_id, event.partition_desc)
+        try:
+            # a job that was queued behind other pool work may only get a
+            # worker AFTER stop() — it must not compact against a catalog
+            # the caller already tore down
+            if not self._stop.is_set():
                 self._compact_one(event)
-            except Exception:
-                self.stats.bump("errors")
-                logger.exception("compaction failed for %s", key)
-            finally:
-                with self._in_flight_lock:
-                    self._in_flight.discard(key)
-                self._queue.task_done()
+        except Exception:
+            self.stats.bump("errors")
+            logger.exception("compaction failed for %s", key)
+        finally:
+            with self._idle:
+                self._in_flight.discard(key)
+                self._running -= 1
+                self._g_running.dec()
+                self._idle.notify_all()
+            self._pump()
 
     def _compact_one(self, event: CompactionEvent) -> None:
         sp = span("compaction.job", partition=event.partition_desc)

@@ -6,9 +6,18 @@ converted zero-copy to numpy, and moved to device with **double-buffered
 ``jax.device_put``** so host decode/merge overlaps the device step — the
 role CUDA pinned-memory staging plays for the reference's GPU loaders.
 
-Pipeline:  scan units → [background thread: read + merge + collate]
-           → bounded queue → [foreground: device_put k batches ahead]
-           → training loop
+Pipeline:  scan units → [runtime pipeline: read + merge → collate →
+           prefetch(bounded queue)] → [foreground: device_put k batches
+           ahead] → training loop
+
+The host side runs on the shared execution runtime
+(:mod:`lakesoul_tpu.runtime`): a ``collate`` map stage feeding a bounded
+``prefetch`` pump replaces the hand-rolled producer thread, so the loader
+inherits the pipeline contract — backpressure, cooperative cancellation
+(an abandoned training loop stops the decode promptly), propagated
+exceptions with the scan's trace id, deadlines, and
+``LAKESOUL_FAULTS`` fault injection — and its queue depth / stage
+latencies land in the ``lakesoul_runtime_*`` obs series.
 
 Sharding: ``LakeSoulScan.shard()/auto_shard()`` splits scan units across
 processes (data parallelism over the pod); within a process, batches can be
@@ -18,7 +27,6 @@ so a ``pjit`` step consumes them without resharding.
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from typing import Any, Callable, Iterator
@@ -27,9 +35,7 @@ import numpy as np
 import pyarrow as pa
 
 from lakesoul_tpu.obs import registry
-
-
-_SENTINEL = object()
+from lakesoul_tpu.runtime import pipeline as rt_pipeline
 
 
 class LoaderStats:
@@ -54,6 +60,7 @@ class LoaderStats:
         self._active_s = 0.0
         self._epoch_start: float | None = None
         self._cur_epoch_rows = 0
+        self._reported_depth = 0  # this loader's share of the depth gauge
         # hot path: fetch each registry metric ONCE (the obs contract), not
         # per delivered batch — delivery then pays only the metric's own lock
         reg = registry()
@@ -77,6 +84,12 @@ class LoaderStats:
                 self.epochs += 1
                 self.epoch_rows.append(self._cur_epoch_rows)
                 del self.epoch_rows[:-64]  # bound the history
+            # settle this loader's contribution to the shared depth gauge:
+            # a parked/finished loader must not pin a stale depth
+            settle = self._reported_depth
+            self._reported_depth = 0
+        if settle:
+            self._m_depth.dec(settle)
         if completed:
             self._m_epochs.inc()
 
@@ -87,10 +100,16 @@ class LoaderStats:
             self._cur_epoch_rows += rows
             self.stall_s += stall_s
             self.queue_depth = queue_depth
+            # DELTA update on the shared gauge: concurrent loaders (train +
+            # eval) then aggregate on /metrics instead of clobbering each
+            # other's last write
+            delta = queue_depth - self._reported_depth
+            self._reported_depth = queue_depth
         self._m_rows.inc(rows)
         self._m_batches.inc()
         self._m_stall.inc(stall_s)
-        self._m_depth.set(queue_depth)
+        if delta:
+            self._m_depth.inc(delta)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -287,39 +306,33 @@ class JaxBatchIterator:
         return self._stats.snapshot()
 
     # ------------------------------------------------------------- pipeline
-    def _producer(self, q: queue.Queue, stop: threading.Event) -> None:
-        def put(item) -> bool:
-            # never park forever on a full queue: an abandoned consumer (early
-            # break from the training loop) sets `stop` and we must exit
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+    def _epoch_windows(self) -> Iterator[pa.Table]:
+        """Fixed-size row windows over one epoch's scan (the pipeline
+        source).  Resume: the scan's unit order is deterministic, so the
+        checkpoint's delivered-row count is a complete position; the scan
+        skips whole units via metadata row counts without decoding them and
+        decode-discards only the residual prefix of one unit."""
+        skip = self._checkpoint.rows_delivered if self._checkpoint else 0
+        rb = _Rebatcher(self._scan._batch_size)
+        for arrow_batch in self._scan.to_batches(
+            num_threads=self._io_threads, skip_rows=skip
+        ):
+            yield from rb.push(arrow_batch)
+        if not self._drop_remainder:
+            tail = rb.tail()
+            if tail is not None:
+                yield tail
 
-        try:
-            # resume: the scan's unit order is deterministic, so the
-            # checkpoint's delivered-row count is a complete position; the
-            # scan skips whole units via metadata row counts without decoding
-            # them and decode-discards only the residual prefix of one unit
-            skip = self._checkpoint.rows_delivered if self._checkpoint else 0
-            rb = _Rebatcher(self._scan._batch_size)
-            for arrow_batch in self._scan.to_batches(
-                num_threads=self._io_threads, skip_rows=skip
-            ):
-                for window in rb.push(arrow_batch):
-                    if not put((len(window), self._host_batch(window))):
-                        return
-            if not self._drop_remainder:
-                tail = rb.tail()
-                if tail is not None:
-                    if not put((len(tail), self._host_batch(tail))):
-                        return
-            put(_SENTINEL)
-        except BaseException as e:  # surface errors to the consumer
-            put(e)
+    def _host_pipeline(self):
+        """One epoch's host pipeline on the shared runtime: scan windows →
+        collate/transform → bounded prefetch pump."""
+        return (
+            rt_pipeline("loader")
+            .source(self._epoch_windows())
+            .map(lambda w: (len(w), self._host_batch(w)), name="collate")
+            .prefetch(self._prefetch, name="prefetch")
+            .run()
+        )
 
     def _host_batch(self, window: pa.Table):
         batch = self._collate(window)
@@ -348,42 +361,34 @@ class JaxBatchIterator:
             finally:
                 self._stats.epoch_end(replayed)
             return
-        q: queue.Queue = queue.Queue(maxsize=self._prefetch)
-        stop = threading.Event()
-        thread = threading.Thread(
-            target=self._producer, args=(q, stop),
-            daemon=True, name="lakesoul-loader-producer",
-        )
+        pipe = self._host_pipeline()
         self._stats.epoch_begin()
-        produced_all = False  # producer reached the sentinel
+        produced_all = False  # the pipeline ran to exhaustion
         delivered_all = False  # ...AND every batch reached the consumer
-        thread.start()
 
         def host_iter():
             nonlocal produced_all
             try:
                 while True:
                     waited = time.perf_counter()
-                    item = q.get()
-                    stall = time.perf_counter() - waited
-                    if item is _SENTINEL:
+                    try:
+                        item = next(pipe)
+                    except StopIteration:
                         produced_all = True
                         return
-                    if isinstance(item, BaseException):
-                        raise item
+                    stall = time.perf_counter() - waited
                     # telemetry at the host hand-off: this is the loader's
                     # produced throughput and how long the consumer starved
-                    self._stats.delivered(item[0], stall, q.qsize())
+                    self._stats.delivered(item[0], stall, pipe.queue_depth())
                     yield item
             finally:
-                stop.set()
                 # quiesce, don't just signal: an abandoned producer that
                 # keeps decoding in the background races whatever the caller
                 # does next (a resumed iterator over the same table, a test's
-                # monkeypatch, shutdown).  The put loop notices `stop` within
-                # 0.1 s; the bounded wait only rides out a unit decode that
-                # is already in flight.
-                thread.join(timeout=60.0)
+                # monkeypatch, shutdown).  close() cancels the pipeline and
+                # joins its pump; the bounded wait only rides out a unit
+                # decode that is already in flight.
+                pipe.close()
 
         def delivered(rows: int) -> None:
             # position advances when a batch reaches the CONSUMER: a trainer

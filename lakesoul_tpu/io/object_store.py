@@ -31,9 +31,15 @@ import fsspec
 OPTION_CACHE_DIR = "lakesoul.cache_dir"
 OPTION_CACHE_MAX_BYTES = "lakesoul.cache_max_bytes"
 OPTION_CACHE_PAGE_BYTES = "lakesoul.cache_page_bytes"
+OPTION_CACHE_READAHEAD = "lakesoul.cache_readahead_pages"
 OPTION_CACHE_DISABLED_PROTOCOLS = ("file", "local")
 
-_OWN_OPTIONS = (OPTION_CACHE_DIR, OPTION_CACHE_MAX_BYTES, OPTION_CACHE_PAGE_BYTES)
+_OWN_OPTIONS = (
+    OPTION_CACHE_DIR,
+    OPTION_CACHE_MAX_BYTES,
+    OPTION_CACHE_PAGE_BYTES,
+    OPTION_CACHE_READAHEAD,
+)
 
 # aliased schemes normalize to one canonical scope so either spelling works
 # on either path form (`gs.token` on a gcs:// path and vice versa)
@@ -94,6 +100,7 @@ def filesystem_for(path: str, storage_options: dict | None = None, *, write: boo
             cache_dir,
             own.get(OPTION_CACHE_MAX_BYTES),
             own.get(OPTION_CACHE_PAGE_BYTES),
+            readahead_pages=own.get(OPTION_CACHE_READAHEAD),
         )
         return CachedReadFileSystem(fs, cache), p
     return fs, p

@@ -13,6 +13,13 @@ commit writes new names), so pages never need invalidation.
 Pages default to 4 MiB — object-store GET latency dominates at 16 KiB; the
 reference's page size tunes for local SSD pread, ours for GCS/S3 range
 requests feeding parquet column chunks.
+
+Readahead: ``LAKESOUL_CACHE_READAHEAD_PAGES=N`` (or the ``readahead_pages``
+constructor knob) prefetches the N pages following every ranged read on the
+shared runtime worker pool — sequential parquet column-chunk scans then find
+page k+1 already local when they ask for it.  Prefetches are best-effort
+(failures are swallowed), deduplicated while in flight, and counted in the
+``readahead_pages`` stat instead of hits/misses.
 """
 
 from __future__ import annotations
@@ -21,16 +28,27 @@ import hashlib
 import logging
 import os
 import threading
+import time
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from fsspec.spec import AbstractBufferedFile, AbstractFileSystem
 
+from lakesoul_tpu.obs import registry
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_PAGE_BYTES = 4 << 20
 DEFAULT_MAX_BYTES = 10 << 30
+
+
+def _default_readahead() -> int:
+    raw = os.environ.get("LAKESOUL_CACHE_READAHEAD_PAGES", "").strip()
+    try:
+        return max(0, int(raw)) if raw else 0
+    except ValueError:
+        return 0
 
 # every live cache instance, aggregated into the shared obs registry as
 # lakesoul_cache_* series (one process = one cache fleet; per-dir splits stay
@@ -43,6 +61,7 @@ _CACHE_SERIES = (
     ("lakesoul_cache_hit_bytes_total", "counter", "hit_bytes"),
     ("lakesoul_cache_miss_bytes_total", "counter", "miss_bytes"),
     ("lakesoul_cache_evictions_total", "counter", "evictions"),
+    ("lakesoul_cache_readahead_pages_total", "counter", "readahead_pages"),
     ("lakesoul_cache_pages", "gauge", "pages"),
     ("lakesoul_cache_bytes", "gauge", "bytes"),
     ("lakesoul_cache_max_bytes", "gauge", "max_bytes"),
@@ -95,6 +114,7 @@ class CacheStats:
     hit_bytes: int = 0
     miss_bytes: int = 0
     evictions: int = 0
+    readahead_pages: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_hit(self, nbytes: int) -> None:
@@ -111,6 +131,10 @@ class CacheStats:
         with self._lock:
             self.evictions += n
 
+    def record_readahead(self, n: int = 1) -> None:
+        with self._lock:
+            self.readahead_pages += n
+
     def snapshot(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
@@ -120,6 +144,7 @@ class CacheStats:
                 "hit_bytes": self.hit_bytes,
                 "miss_bytes": self.miss_bytes,
                 "evictions": self.evictions,
+                "readahead_pages": self.readahead_pages,
                 "hit_rate": (self.hits / total) if total else 0.0,
             }
 
@@ -145,12 +170,25 @@ class DiskPageCache:
         *,
         max_bytes: int = DEFAULT_MAX_BYTES,
         page_bytes: int = DEFAULT_PAGE_BYTES,
+        readahead_pages: int | None = None,
     ):
         self.cache_dir = str(cache_dir)
         self.max_bytes = int(max_bytes)
+        self.readahead_pages = (
+            _default_readahead() if readahead_pages is None else max(0, int(readahead_pages))
+        )
         self.stats = CacheStats()
         self._lock = threading.Lock()
         self._index: OrderedDict[tuple[str, int], int] = OrderedDict()
+        self._inflight: set[tuple[str, int]] = set()  # readahead dedup
+        # first page index known to be at/past EOF per object: readahead
+        # clamps to it so a file's tail doesn't trigger a doomed past-EOF
+        # GET on every read.  LRU-bounded — a long-lived server scanning
+        # millions of objects must not grow it forever.
+        self._eof_page: "OrderedDict[str, int]" = OrderedDict()
+        # transient readahead failures back off per object (monotonic
+        # retry-after) instead of permanently disabling the feature
+        self._ra_backoff: dict[str, float] = {}
         self._bytes = 0
         os.makedirs(self.cache_dir, exist_ok=True)
         self.page_bytes = self._pin_page_bytes(int(page_bytes))
@@ -254,9 +292,107 @@ class DiskPageCache:
                 (last - first + 1) - len(missing),
                 len(missing),
             )
+        if self.readahead_pages:
+            self._schedule_readahead(target_fs, path, key, last + 1)
         blob = b"".join(pages[i] for i in range(first, last + 1))
         lo = start - first * pb
         return blob[lo : lo + (end - start)]
+
+    # -------------------------------------------------------------- readahead
+    def _schedule_readahead(self, target_fs, path: str, key: str, first: int) -> None:
+        """Queue the ``readahead_pages`` pages after a read onto the shared
+        runtime pool (best-effort, deduped while in flight) so a sequential
+        scan's next request is already local."""
+        want: list[int] = []
+        with self._lock:
+            if self._ra_backoff.get(key, 0.0) > time.monotonic():
+                return  # recent fetch failure: give this object a breather
+            stop = min(
+                first + self.readahead_pages, self._eof_page.get(key, 1 << 62)
+            )
+            for idx in range(first, stop):
+                k = (key, idx)
+                if k in self._index or k in self._inflight:
+                    # stop at the first already-covered page: `want` must be
+                    # CONSECUTIVE — _readahead_run slices its single
+                    # coalesced GET by position, so a gap would store the
+                    # wrong bytes under later page indexes
+                    break
+                self._inflight.add(k)
+                want.append(idx)
+        if not want:
+            return
+        from lakesoul_tpu.runtime import get_pool
+
+        registry().gauge("lakesoul_cache_readahead_inflight").inc(len(want))
+        try:
+            fut = get_pool().submit(self._readahead_run, target_fs, path, key, want)
+        except RuntimeError:
+            # raced a pool shutdown: the read itself must still succeed
+            # ("a failed prefetch must never surface") and the dedup
+            # entries must be released or these pages never prefetch again
+            with self._lock:
+                self._inflight.difference_update((key, i) for i in want)
+            registry().gauge("lakesoul_cache_readahead_inflight").dec(len(want))
+            return
+
+        def _cleanup_if_cancelled(f) -> None:
+            # a pool shutdown (shutdown_pool between bench legs, tests) can
+            # cancel the task before it runs: its finally never fires, so
+            # the dedup entries and gauge must be released here or these
+            # pages would never prefetch again
+            if f.cancelled():
+                with self._lock:
+                    self._inflight.difference_update((key, i) for i in want)
+                registry().gauge("lakesoul_cache_readahead_inflight").dec(len(want))
+
+        fut.add_done_callback(_cleanup_if_cancelled)
+
+    def _note_eof(self, key: str, idx: int) -> None:
+        with self._lock:
+            self._eof_page[key] = idx
+            self._eof_page.move_to_end(key)
+            while len(self._eof_page) > 4096:
+                self._eof_page.popitem(last=False)
+
+    def _readahead_run(self, target_fs, path: str, key: str, pages: list[int]) -> None:
+        pb = self.page_bytes
+        fetched = 0
+        try:
+            # pages are consecutive by construction: one coalesced GET
+            blob = target_fs.cat_file(
+                path, start=pages[0] * pb, end=(pages[-1] + 1) * pb
+            )
+            for j, idx in enumerate(pages):
+                page = blob[j * pb : (j + 1) * pb]
+                if page:  # a read past EOF yields nothing to store
+                    self._store_page(key, idx, page)
+                    fetched += 1
+                if len(page) < pb:
+                    # short/empty page = EOF reached: remember it so later
+                    # reads near the tail stop scheduling doomed GETs
+                    self._note_eof(key, idx + 1 if page else idx)
+                    break
+            with self._lock:
+                self._ra_backoff.pop(key, None)
+        except Exception:
+            # best-effort: a failed prefetch must never surface.  The
+            # failure may be transient (503, timeout) OR a store that
+            # RAISES on past-EOF ranges — back off this object for a while
+            # instead of retrying on every tail read or permanently
+            # disabling readahead for it (direct reads are unaffected)
+            with self._lock:
+                self._ra_backoff[key] = time.monotonic() + 30.0
+                if len(self._ra_backoff) > 4096:
+                    now = time.monotonic()
+                    for k in [k for k, ts in self._ra_backoff.items() if ts <= now]:
+                        del self._ra_backoff[k]
+        finally:
+            with self._lock:
+                self._inflight.difference_update((key, i) for i in pages)
+            registry().gauge("lakesoul_cache_readahead_inflight").dec(len(pages))
+            if fetched:
+                self.stats.record_readahead(fetched)
 
     def _load_page(self, key: str, idx: int) -> bytes | None:
         with self._lock:
@@ -328,10 +464,15 @@ _CACHES_LOCK = threading.Lock()
 
 
 def get_cache(
-    cache_dir: str, max_bytes: int | None = None, page_bytes: int | None = None
+    cache_dir: str,
+    max_bytes: int | None = None,
+    page_bytes: int | None = None,
+    *,
+    readahead_pages: int | None = None,
 ) -> DiskPageCache:
     """max_bytes/page_bytes apply on first construction; an explicit
-    max_bytes on a later call retunes the bound (None leaves it alone)."""
+    max_bytes or readahead_pages on a later call retunes the knob (None
+    leaves it alone)."""
     key = str(cache_dir)
     with _CACHES_LOCK:
         cache = _CACHES.get(key)
@@ -340,10 +481,14 @@ def get_cache(
                 key,
                 max_bytes=int(max_bytes) if max_bytes is not None else DEFAULT_MAX_BYTES,
                 page_bytes=int(page_bytes) if page_bytes is not None else DEFAULT_PAGE_BYTES,
+                readahead_pages=readahead_pages,
             )
             _CACHES[key] = cache
-        elif max_bytes is not None:
-            cache.max_bytes = int(max_bytes)
+        else:
+            if max_bytes is not None:
+                cache.max_bytes = int(max_bytes)
+            if readahead_pages is not None:
+                cache.readahead_pages = max(0, int(readahead_pages))
         return cache
 
 

@@ -33,6 +33,7 @@ from lakesoul_tpu.io.filters import Filter, filter_column_names, zone_conjuncts
 from lakesoul_tpu.io.formats import format_for
 from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
 from lakesoul_tpu.obs import registry
+from lakesoul_tpu.runtime import pipeline as rt_pipeline
 
 
 def _unit_observe(mode: str, rows: int, started: float) -> None:
@@ -207,8 +208,7 @@ def read_scan_unit(
         columns=columns,
     )
 
-    tables = []
-    for path in files:
+    def _fetch_decode(path: str) -> pa.Table:
         t = _read_one_file(
             path,
             columns=plan.read_columns,
@@ -218,7 +218,21 @@ def read_scan_unit(
         )
         if plan.file_schema is not None:
             t = uniform_table(t, plan.file_schema, defaults)
-        tables.append(t)
+        return t
+
+    if len(files) > 1:
+        # fetch+decode the unit's files in parallel on the runtime pool —
+        # the merge consumes them in FILE order (= version order), so MOR
+        # semantics are byte-identical to the serial loop.  Falls back to
+        # inline execution on a pool worker (nested parallelism).
+        tables = list(
+            rt_pipeline("scan_unit")
+            .source(files)
+            .map_parallel(_fetch_decode, name="decode")
+            .run()
+        )
+    else:
+        tables = [_fetch_decode(p) for p in files]
 
     if primary_keys and len(tables) >= 1:
         merged = merge_sorted_tables(
@@ -355,16 +369,26 @@ def iter_scan_unit_batches(
         rows = _stream_batch_rows(plan.file_schema, 1, memory_budget_bytes)
         started = time.perf_counter()
         out_rows = 0
-        for path in files:
-            fmt = format_for(path)
-            for batch in fmt.iter_batches(
-                path,
-                columns=plan.read_columns,
-                arrow_filter=plan.file_filter,
-                batch_size=rows,
-                storage_options=storage_options,
-                zone_predicates=plan.zone_predicates,
-            ):
+
+        def raw_batches():
+            for path in files:
+                fmt = format_for(path)
+                yield from fmt.iter_batches(
+                    path,
+                    columns=plan.read_columns,
+                    arrow_filter=plan.file_filter,
+                    batch_size=rows,
+                    storage_options=storage_options,
+                    zone_predicates=plan.zone_predicates,
+                )
+
+        # one-batch decode-ahead: batch k+1 fetches/decodes while k
+        # postprocesses and emits (memory bound: ONE extra batch)
+        it = rt_pipeline("scan_stream").source(raw_batches()).prefetch(
+            1, name="decode_ahead"
+        ).run()
+        try:
+            for batch in it:
                 t = pa.Table.from_batches([batch])
                 if plan.file_schema is not None:
                     t = uniform_table(t, plan.file_schema, defaults)
@@ -372,6 +396,8 @@ def iter_scan_unit_batches(
                 if len(t):
                     out_rows += len(t)
                     yield from t.to_batches(max_chunksize=batch_size)
+        finally:
+            it.close()
         _unit_observe("stream", out_rows, started)
         return
 

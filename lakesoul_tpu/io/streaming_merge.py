@@ -26,70 +26,27 @@ read/compaction time, bounded, instead of an ad-hoc spill file format.
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Iterator
 
 import pyarrow as pa
 import pyarrow.compute as pc
 
 from lakesoul_tpu.io.merge import merge_sorted_tables, uniform_table
+from lakesoul_tpu.runtime import pipeline as rt_pipeline
 
 # rows per load step per stream; the byte budget divides down from this
 DEFAULT_STREAM_BATCH_ROWS = 65_536
 MIN_STREAM_BATCH_ROWS = 4_096
 
-_DONE = object()
 
-
-class _PrefetchIterator:
-    """One-slot background prefetch over an iterator: while the merge works
-    on batch k, batch k+1 decodes on a thread (IO/decode overlap the
-    synchronous scanner gives up).  Memory bound: ONE extra batch in
-    flight."""
-
-    def __init__(self, it):
-        self._q: queue.Queue = queue.Queue(maxsize=1)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, args=(it,), daemon=True)
-        self._thread.start()
-
-    def _run(self, it) -> None:
-        try:
-            for item in it:
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                else:
-                    return
-            self._put(_DONE)
-        except BaseException as e:  # surface decode errors to the consumer
-            self._put(e)
-
-    def _put(self, item) -> None:
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return
-            except queue.Full:
-                continue
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        item = self._q.get()
-        if item is _DONE:
-            raise StopIteration
-        if isinstance(item, BaseException):
-            raise item
-        return item
-
-    def close(self) -> None:
-        self._stop.set()
+def _prefetch_iter(it):
+    """One-slot background prefetch over an iterator (runtime pipeline):
+    while the merge works on batch k, batch k+1 decodes on the pump thread —
+    the IO/decode overlap the synchronous scanner gives up.  Memory bound:
+    ONE extra batch in flight.  Eager: the pump primes before the first
+    pull, so a merger's k file streams all decode their first batch
+    concurrently."""
+    return rt_pipeline("mor_stream").source(it).prefetch(1, name="decode_ahead").run()
 
 
 def _key_tuple(table: pa.Table, primary_keys: list[str], row: int) -> tuple:
@@ -146,7 +103,7 @@ class _SortedFileStream:
 
         self._file_schema = file_schema
         self._defaults = defaults
-        self._batches = _PrefetchIterator(
+        self._batches = _prefetch_iter(
             format_for(path).iter_batches(
                 path,
                 columns=columns,

@@ -806,7 +806,9 @@ class SqliteMetadataStore(SqlMetadataStore):
         super().__init__()
         self.db_path = str(db_path)
         self._local = threading.local()
-        self._lock = threading.Lock()
+        # RLock: _txn holds it across a whole write transaction while the
+        # transaction body's own _exec calls re-enter it
+        self._lock = threading.RLock()
         conn = self._conn()
         with conn:
             conn.executescript(_SCHEMA)
@@ -834,6 +836,42 @@ class SqliteMetadataStore(SqlMetadataStore):
                 conn.executescript(_SCHEMA)
             self._local.conn = conn
         return conn
+
+    class _EagerCursor:
+        """Pre-fetched result rows with the cursor surface the DAO layer
+        uses (fetchone/fetchall/iteration)."""
+
+        __slots__ = ("_rows",)
+
+        def __init__(self, rows):
+            self._rows = rows
+
+        def fetchall(self):
+            return self._rows
+
+        def fetchone(self):
+            return self._rows[0] if self._rows else None
+
+        def __iter__(self):
+            return iter(self._rows)
+
+    def _exec(self, conn, sql, params=()):
+        if conn is getattr(self, "_mem_conn", None):
+            # the shared :memory: connection: serialize EVERY statement with
+            # the write-transaction lock and fetch eagerly inside it.  A
+            # lazily-consumed cursor would race another thread's
+            # commit/rollback on the same connection ("Cursor needed to be
+            # reset because of commit/rollback and can no longer be fetched
+            # from"), and a read interleaved with an open write transaction
+            # would see its uncommitted rows.
+            with self._lock:
+                cur = super()._exec(conn, sql, params)
+                try:
+                    rows = cur.fetchall()
+                except sqlite3.ProgrammingError:
+                    rows = []  # statements with no result set
+                return self._EagerCursor(rows)
+        return super()._exec(conn, sql, params)
 
     @contextlib.contextmanager
     def _txn(self):

@@ -1,0 +1,49 @@
+"""Shared pipelined-execution runtime — the substrate under every hot path.
+
+Three pieces (see ARCHITECTURE.md "Runtime"):
+
+- :mod:`lakesoul_tpu.runtime.pool` — ONE process-wide, fork-safe, lazily
+  spawned worker pool (``LAKESOUL_RUNTIME_THREADS``) replacing ad-hoc
+  threading across io/data/sql/compaction.
+- :mod:`lakesoul_tpu.runtime.pipeline` — staged pipelines
+  (``source → map_parallel/flat_map_parallel → prefetch``) with bounded
+  queues, backpressure, deterministic output order, exception propagation,
+  cooperative cancellation, and per-run deadlines.
+- :mod:`lakesoul_tpu.runtime.faults` — ``LAKESOUL_FAULTS=stage:p`` fault
+  injection into any stage for robustness tests.
+
+Scan units decode through it in parallel with MOR merge overlapped
+(io/reader.py, catalog.py), the JAX loader prefetches through it
+(data/jax_iter.py), the page cache reads ahead on it (io/page_cache.py),
+the SQL executor scans tables in parallel on it (sql/executor.py), and the
+compaction service runs its jobs on it (compaction/service.py).
+"""
+
+from lakesoul_tpu.runtime.faults import FaultInjected, FaultSpec
+from lakesoul_tpu.runtime.pipeline import (
+    DeadlineExceeded,
+    Pipeline,
+    PipelineCancelled,
+    PipelineIterator,
+    pipeline,
+)
+from lakesoul_tpu.runtime.pool import (
+    WorkerPool,
+    default_pool_size,
+    get_pool,
+    shutdown_pool,
+)
+
+__all__ = [
+    "DeadlineExceeded",
+    "FaultInjected",
+    "FaultSpec",
+    "Pipeline",
+    "PipelineCancelled",
+    "PipelineIterator",
+    "WorkerPool",
+    "default_pool_size",
+    "get_pool",
+    "pipeline",
+    "shutdown_pool",
+]

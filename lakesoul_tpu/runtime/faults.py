@@ -1,0 +1,150 @@
+"""Fault injection for pipeline stages (robustness-test hook).
+
+``LAKESOUL_FAULTS`` names pipeline stages and what should go wrong in them,
+so tests (and chaos runs) can prove that errors and latency anywhere in a
+staged pipeline surface correctly — propagated exception, trace id in the
+log, backpressure held — without monkeypatching internals:
+
+    LAKESOUL_FAULTS="decode:0.5"                # stage 'decode' raises, p=0.5
+    LAKESOUL_FAULTS="scan_unit.decode:1"        # fully-qualified stage name
+    LAKESOUL_FAULTS="fetch:0.2:delay:0.05"      # 50 ms latency, p=0.2
+    LAKESOUL_FAULTS="fetch:1:delay:0.01,decode:0.1:error"   # several
+
+Spec grammar: ``stage:probability[:kind[:seconds]]`` with kind ``error``
+(default) or ``delay``.  A spec matches a stage when it equals the stage's
+qualified name (``pipeline.stage``) or its bare stage name.  Injection draws
+from a process-wide deterministic RNG seeded by ``LAKESOUL_FAULTS_SEED``
+(default 0), so a failing chaos run reproduces.
+
+Tests install specs programmatically with :func:`install` (no env needed);
+:func:`clear` removes them.  The hot-path cost with no faults configured is
+one module-level boolean check.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from lakesoul_tpu.errors import LakeSoulError
+
+__all__ = ["FaultInjected", "FaultSpec", "install", "clear", "maybe_inject", "active"]
+
+logger = logging.getLogger(__name__)
+
+_ENV = "LAKESOUL_FAULTS"
+_ENV_SEED = "LAKESOUL_FAULTS_SEED"
+
+
+class FaultInjected(LakeSoulError):
+    """Deliberate failure from the fault-injection hook (never raised in
+    production unless ``LAKESOUL_FAULTS`` is set)."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    stage: str          # qualified ("pipeline.stage") or bare stage name
+    probability: float  # 0..1
+    kind: str = "error"  # "error" | "delay"
+    seconds: float = 0.0  # delay duration
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        parts = text.strip().split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"fault spec {text!r} must be stage:probability[:kind[:seconds]]"
+            )
+        stage, prob = parts[0], float(parts[1])
+        if not stage or not 0.0 <= prob <= 1.0:
+            raise ValueError(f"bad fault spec {text!r}")
+        kind = parts[2] if len(parts) > 2 else "error"
+        if kind not in ("error", "delay"):
+            raise ValueError(f"fault kind must be error|delay, got {kind!r}")
+        seconds = float(parts[3]) if len(parts) > 3 else 0.01
+        return cls(stage, prob, kind, seconds)
+
+
+_LOCK = threading.Lock()
+_SPECS: list[FaultSpec] = []
+_ENABLED = False  # hot-path guard: one bool read when no faults configured
+_RNG = random.Random(int(os.environ.get(_ENV_SEED, "0") or "0"))
+_ENV_LOADED = False
+
+
+def _load_env_once() -> None:
+    global _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    with _LOCK:
+        if _ENV_LOADED:
+            return
+        raw = os.environ.get(_ENV, "").strip()
+        if raw:
+            for item in raw.split(","):
+                if item.strip():
+                    _install_locked(FaultSpec.parse(item))
+        _set_env_loaded()
+
+
+def _set_env_loaded() -> None:
+    global _ENV_LOADED
+    _ENV_LOADED = True
+
+
+def _install_locked(spec: FaultSpec) -> None:
+    global _ENABLED
+    _SPECS.append(spec)
+    _ENABLED = True
+
+
+def install(spec: FaultSpec | str) -> FaultSpec:
+    """Add one fault spec (tests).  Accepts a spec object or the env string
+    form ``stage:p[:kind[:seconds]]``."""
+    if isinstance(spec, str):
+        spec = FaultSpec.parse(spec)
+    with _LOCK:
+        _install_locked(spec)
+    return spec
+
+
+def clear() -> None:
+    """Remove every installed spec (including env-loaded ones)."""
+    global _ENABLED
+    with _LOCK:
+        _SPECS.clear()
+        _ENABLED = False
+        _set_env_loaded()  # a cleared config must not resurrect from env
+
+
+def active() -> list[FaultSpec]:
+    _load_env_once()
+    with _LOCK:
+        return list(_SPECS)
+
+
+def maybe_inject(qualname: str) -> None:
+    """Called by pipeline stage wrappers with the stage's qualified name
+    (``pipeline.stage``).  Raises :class:`FaultInjected` or sleeps according
+    to the matching spec, if any fires."""
+    if not _ENABLED and _ENV_LOADED:
+        return
+    _load_env_once()
+    if not _ENABLED:
+        return
+    bare = qualname.rsplit(".", 1)[-1]
+    with _LOCK:
+        specs = [s for s in _SPECS if s.stage in (qualname, bare)]
+        draws = [_RNG.random() for _ in specs]
+    for spec, draw in zip(specs, draws):
+        if draw >= spec.probability:
+            continue
+        if spec.kind == "delay":
+            time.sleep(spec.seconds)
+        else:
+            logger.warning("fault injected into stage %s", qualname)
+            raise FaultInjected(f"injected fault in stage {qualname}")

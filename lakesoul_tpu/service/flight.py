@@ -170,6 +170,33 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         except RBACError as e:
             raise flight.FlightUnauthorizedError(str(e))
 
+    def _check_statement(self, context, namespace: str, stmt) -> None:
+        """Per-statement RBAC: every referenced table, PLUS an explicit
+        warehouse-wide gate for ``CALL clean()`` — its empty
+        ``referenced_tables`` set must not silently skip RBAC, because
+        clean destroys data under EVERY table."""
+        from lakesoul_tpu.sql.parser import Call, referenced_tables
+
+        if isinstance(stmt, Call) and stmt.procedure == "clean":
+            self._check_warehouse_wide(context)
+        for target in sorted(referenced_tables(stmt)):
+            self._check(context, namespace, target)
+
+    def _check_warehouse_wide(self, context) -> None:
+        """Wildcard permission: the caller's domain must grant access to
+        EVERY table in the warehouse (an admin-shaped check — one
+        unreachable table vetoes the warehouse-wide destructive op)."""
+        user, group = self._identity(context)
+        for ns in self.catalog.list_namespaces():
+            for name in self.catalog.list_tables(ns):
+                if not self.rbac.verify_permission_by_table_name(
+                    user, group, ns, name
+                ):
+                    raise flight.FlightUnauthorizedError(
+                        f"CALL clean() is warehouse-wide: user {user} (group"
+                        f" {group}) lacks access to {ns}.{name}"
+                    )
+
     # ----------------------------------------------------------------- lists
     def list_flights(self, context, criteria):
         for ns in self.catalog.list_namespaces():
@@ -397,11 +424,9 @@ class LakeSoulFlightServer(flight.FlightServerBase):
                 raise flight.FlightServerError(str(e))
             # same per-table RBAC as do_get/do_put: EVERY table the statement
             # touches is checked — joins, derived tables, subqueries — not
-            # just the primary FROM (CREATE TABLE targets a new one, skipped)
-            from lakesoul_tpu.sql.parser import referenced_tables
-
-            for target in sorted(referenced_tables(stmt)):
-                self._check(context, ns, target)
+            # just the primary FROM (CREATE TABLE targets a new one, skipped);
+            # CALL clean() needs warehouse-wide (wildcard) access
+            self._check_statement(context, ns, stmt)
             result = SqlSession(self.catalog, ns).execute(stmt_text)
             sink = pa.BufferOutputStream()
             with pa.ipc.new_stream(sink, result.schema) as w:

@@ -367,20 +367,16 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
     # ------------------------------------------------------------- sql exec
     def _execute_sql(self, context, query: str, namespace: str = "default") -> pa.Table:
         from lakesoul_tpu.sql import SqlSession
-        from lakesoul_tpu.sql.parser import (
-            SqlError,
-            parse as parse_sql,
-            referenced_tables,
-        )
+        from lakesoul_tpu.sql.parser import SqlError, parse as parse_sql
 
         try:
             stmt = parse_sql(query)
         except SqlError as e:
             raise flight.FlightServerError(str(e))
         # RBAC covers EVERY table the statement touches — joins, derived
-        # tables, EXISTS/IN/scalar subqueries — not just the primary FROM
-        for target in sorted(referenced_tables(stmt)):
-            self._check(context, namespace, target)
+        # tables, EXISTS/IN/scalar subqueries — not just the primary FROM;
+        # CALL clean() needs warehouse-wide (wildcard) access
+        self._check_statement(context, namespace, stmt)
         try:
             return SqlSession(self.catalog, namespace).execute(query)
         except (LakeSoulError, SqlError) as e:

@@ -155,6 +155,26 @@ class StorageProxy:
                         if min_parts >= 3 else "path must be /<namespace>/<table>",
                     )
                     return False
+                # path traversal: an empty/'.'/'..' segment would let
+                # _object_path escape the RBAC-checked table directory
+                # (cross-table DELETE/overwrite through '..').  Check the
+                # DECODED form too: '%2e%2e' passes the raw check but
+                # _object_key is unquoted before it reaches the signed
+                # upstream, where a normalizing endpoint would resolve it.
+                # A trailing slash is an empty segment and is REJECTED, not
+                # stripped: silently aliasing the distinct S3 key 'obj/'
+                # onto 'obj' would point destructive verbs at the wrong
+                # object
+                for p in parts:
+                    decoded = urllib.parse.unquote(p)
+                    if (
+                        p in ("", ".", "..")
+                        or decoded in ("", ".", "..")
+                        or "/" in decoded
+                        or "\\" in decoded
+                    ):
+                        self.send_error(400, "invalid path segment")
+                        return False
                 ns, table = parts[0], parts[1]
                 table_path = f"{proxy.catalog.warehouse}/{ns}/{table}"
                 if not proxy.rbac.verify_permission_by_table_path(user, group, table_path):
@@ -398,6 +418,24 @@ class StorageProxy:
             def _upload_dir(self, upload_id: str) -> str:
                 return f"{self._table_path}/.uploads/{upload_id}"
 
+            @staticmethod
+            def _upload_id_shape_ok(upload_id: str) -> bool:
+                """The uploadId lands in the staging path, so it gets the
+                same traversal check as path segments: server-minted ids
+                are 32 hex chars; anything else (e.g. ``../../``) must
+                never reach a filesystem op."""
+                return len(upload_id) == 32 and all(
+                    c in "0123456789abcdef" for c in upload_id
+                )
+
+            def _safe_upload_id(self) -> str | None:
+                upload_id = self._query.get("uploadId", "")
+                if self._upload_id_shape_ok(upload_id):
+                    return upload_id
+                # an id this server never minted cannot name a live upload
+                self.send_error(404, "NoSuchUpload")
+                return None
+
             def do_POST(self):
                 if not self._authorize():
                     return
@@ -435,7 +473,15 @@ class StorageProxy:
                 except ValueError:
                     self.send_error(400, "partNumber must be an integer")
                     return
-                upload_id = self._query["uploadId"]
+                if not 1 <= part <= 10000:
+                    # S3's documented range; also keeps the zero-padded
+                    # part-NNNNN naming lexically ordered (a negative or
+                    # ≥100000 part would break part ordering at complete)
+                    self.send_error(400, "partNumber must be between 1 and 10000")
+                    return
+                upload_id = self._safe_upload_id()
+                if upload_id is None:
+                    return
                 # S3 semantics: a part for a never-initiated or aborted
                 # upload is NoSuchUpload — silently recreating the staging
                 # dir would let a late retry resurrect an aborted upload
@@ -469,7 +515,9 @@ class StorageProxy:
                 self.end_headers()
 
             def _do_complete_upload(self) -> None:
-                upload_id = self._query["uploadId"]
+                upload_id = self._safe_upload_id()
+                if upload_id is None:
+                    return
                 # claim "completing" atomically: a duplicate concurrent
                 # complete answers 409 instead of racing the final write; a
                 # FAILED complete flips back to "open" (retryable, S3
@@ -559,15 +607,19 @@ class StorageProxy:
                 )
 
             def _do_abort_upload(self) -> None:
-                # tombstone FIRST (see _mpu_active), delete files second
-                with proxy._mpu_lock:
-                    proxy._mpu_active.pop(self._query["uploadId"], None)
-                staging = self._upload_dir(self._query["uploadId"])
-                fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
-                try:
-                    fs.rm(sp, recursive=True)
-                except FileNotFoundError:
-                    pass
+                upload_id = self._query.get("uploadId", "")
+                if self._upload_id_shape_ok(upload_id):
+                    # tombstone FIRST (see _mpu_active), delete files second
+                    with proxy._mpu_lock:
+                        proxy._mpu_active.pop(upload_id, None)
+                    staging = self._upload_dir(upload_id)
+                    fs, sp = filesystem_for(staging, proxy.catalog.storage_options)
+                    try:
+                        fs.rm(sp, recursive=True)
+                    except FileNotFoundError:
+                        pass
+                # a malformed id cannot name a staging dir: abort stays
+                # idempotent (204) but performs NO filesystem op with it
                 self.send_response(204)
                 self.end_headers()
 

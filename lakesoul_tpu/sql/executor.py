@@ -650,6 +650,28 @@ class SqlSession:
         freely against lakehouse tables; DML against them is rejected."""
         self._externals[name] = source
 
+    def _prefetch_join_scans(self, stmt: "ast.Select") -> dict:
+        """Start scanning plain-table join right sides on the runtime pool
+        (overlapping the base-table scan).  Derived/external right sides
+        stay lazy — they may recurse into this executor.  Returns
+        {join_index: Future}; errors surface where the serial code would
+        have raised (the join's ``.result()``)."""
+        from lakesoul_tpu.runtime import get_pool
+
+        pool = get_pool()
+        futs: dict = {}
+        if pool.in_worker():  # nested query on a pool thread: stay serial
+            return futs
+        for ji, j in enumerate(stmt.joins):
+            if j.subquery is not None or self._external_table(j.table) is not None:
+                continue
+
+            def scan_one(name=j.table):
+                return self.catalog.table(name, self.namespace).to_arrow()
+
+            futs[ji] = pool.submit(scan_one)
+        return futs
+
     def _external_table(self, name: str) -> "pa.Table | None":
         source = self._externals.get(name)
         if source is None:
@@ -1128,6 +1150,7 @@ class SqlSession:
         # ---- source: scan with pushdown, or a derived table
         residual_nodes: list = []
         key_renames: dict[str, str] = {}
+        join_tables: dict = {}
         if stmt.from_subquery is not None:
             if stmt.as_of_ms is not None:
                 raise SqlError("AS OF time travel requires a base table")
@@ -1145,16 +1168,37 @@ class SqlSession:
             scan, residual_nodes = self._plan_base(stmt, has_aggs)
             _stage_observe("plan", started)
             started = time.perf_counter()
-            table = scan.to_arrow()  # merge-on-read timings land in lakesoul_io_*
+            # parallel scan stage on the shared runtime: join right-side
+            # base tables start scanning on the pool WHILE the base table
+            # scans here (each scan's own units also fan out on the pool).
+            # Every future resolves HERE — a failure anywhere cancels the
+            # rest, so no background scan outlives a failed statement
+            join_futs = self._prefetch_join_scans(stmt)
+            try:
+                table = scan.to_arrow()  # MOR timings land in lakesoul_io_*
+                join_tables = {ji: f.result() for ji, f in sorted(join_futs.items())}
+            except BaseException:
+                import concurrent.futures
+
+                for f in join_futs.values():
+                    f.cancel()
+                # cancel() can't stop an already-RUNNING scan: wait it out
+                # (bounded by that scan's own duration) so no background
+                # scan outlives the failed statement and races a retry or
+                # a DROP TABLE issued right after
+                concurrent.futures.wait(list(join_futs.values()))
+                raise
             _stage_observe("scan", started)
 
         emit_started = time.perf_counter()
         # ---- joins (hash joins on Arrow compute; right side may be derived)
-        for j in stmt.joins:
+        for ji, j in enumerate(stmt.joins):
             if j.subquery is not None:
                 right = self._query(j.subquery)
             elif (jext := self._external_table(j.table)) is not None:
                 right = jext
+            elif (pre := join_tables.get(ji)) is not None:
+                right = pre
             else:
                 right = self.catalog.table(j.table, self.namespace).to_arrow()
             rname = j.alias or j.table
@@ -2016,11 +2060,13 @@ class SqlSession:
                     )
                     for a in expr.args
                 ]
-                if len(parts) == 1:
-                    return parts[0]
                 # NULL arguments are SKIPPED (Postgres/DataFusion concat
                 # semantics — the engine this dialect claims parity with;
-                # Spark/MySQL instead null the whole result)
+                # Spark/MySQL instead null the whole result).  That holds
+                # for ONE argument too: concat(NULL) is '' — skipping the
+                # sole NULL leaves the empty string, never NULL
+                if len(parts) == 1:
+                    return pc.fill_null(parts[0], "")
                 return pc.binary_join_element_wise(
                     *parts, "", null_handling="skip"
                 )

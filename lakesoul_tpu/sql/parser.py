@@ -1368,7 +1368,10 @@ def referenced_tables(stmt) -> set[str]:
             return
         if isinstance(node, Call):
             # compact/rollback/build_vector_index address a table by name in
-            # their first argument; clean is warehouse-wide
+            # their first argument; clean is warehouse-wide and so has NO
+            # per-table surface — gateways must gate it explicitly
+            # (LakeSoulFlightServer._check_statement), an empty set here is
+            # NOT a grant
             if node.procedure in ("compact", "rollback", "build_vector_index") \
                     and node.args:
                 out.add(str(node.args[0]))

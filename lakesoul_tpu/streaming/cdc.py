@@ -148,6 +148,13 @@ class CheckpointedWriter:
                             )
                             for d in stale
                         },
+                        # conflict detection on the DELETE wave too: a
+                        # concurrent writer advancing one of these
+                        # partitions between our head read and this commit
+                        # must raise CommitConflictError (and re-run the
+                        # replace against fresh heads) instead of being
+                        # silently wiped by the truncate
+                        read_partition_info=[heads[d] for d in stale],
                         storage_options=opts,
                     ))
                 return committed

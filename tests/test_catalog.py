@@ -546,12 +546,13 @@ class TestLoaderCheckpoint:
         assert ckpt.rows_delivered == 128
 
     def test_close_quiesces_producer_thread(self, catalog):
-        """Closing a loader iterator JOINS the producer thread instead of
-        merely signalling it: an abandoned producer that keeps decoding in
-        the background races whatever runs next (a resumed iterator, a
-        monkeypatch, interpreter shutdown) — the root cause of a flaky
-        full-suite failure where a stale phase-1 producer polluted phase 2's
-        decode spy under CPU contention."""
+        """Closing a loader iterator JOINS the pipeline's prefetch pump
+        instead of merely signalling it: an abandoned producer that keeps
+        decoding in the background races whatever runs next (a resumed
+        iterator, a monkeypatch, interpreter shutdown) — the root cause of
+        a flaky full-suite failure where a stale phase-1 producer polluted
+        phase 2's decode spy under CPU contention.  The pump is the
+        runtime pipeline's ``loader-prefetch`` thread now."""
         import threading
 
         t = self._table(catalog, n=2000)
@@ -559,7 +560,7 @@ class TestLoaderCheckpoint:
         next(it)
         it.close()
         assert not any(
-            th.name == "lakesoul-loader-producer" and th.is_alive()
+            th.name == "loader-prefetch" and th.is_alive()
             for th in threading.enumerate()
         )
 

@@ -250,3 +250,40 @@ class TestVectorSearchAction:
             assert [int(i) for i in ids_local] == out["ids"]
         finally:
             server.shutdown()
+
+
+class TestCallCleanGate:
+    """CALL clean() is warehouse-wide destructive: its empty
+    referenced_tables set must NOT skip RBAC — the gateway requires the
+    caller's domain to reach EVERY table (wildcard/admin shape)."""
+
+    def _server(self, tmp_warehouse, private: bool):
+        catalog = LakeSoulCatalog(str(tmp_warehouse))
+        t = catalog.create_table("pub", SCHEMA)
+        t.write_arrow(pa.table({"id": np.arange(5), "v": np.zeros(5)}))
+        if private:
+            catalog.client.create_table(
+                "priv", f"{tmp_warehouse}/default/priv", SCHEMA, domain="team1"
+            )
+        server = LakeSoulFlightServer(catalog, "grpc://127.0.0.1:0", jwt_secret="k")
+        token = server.jwt_server.create_token(Claims(sub="alice", group="public"))
+        return server, f"grpc://127.0.0.1:{server.port}", token
+
+    def test_clean_denied_without_wildcard_access(self, tmp_warehouse):
+        server, loc, token = self._server(tmp_warehouse, private=True)
+        try:
+            client = LakeSoulFlightClient(loc, token=token)
+            with pytest.raises(flight.FlightError, match="warehouse-wide"):
+                client.action("sql", {"statement": "CALL clean()"})
+            # per-table ops on accessible tables still work
+            assert client.scan("pub").num_rows == 5
+        finally:
+            server.shutdown()
+
+    def test_clean_allowed_with_access_to_every_table(self, tmp_warehouse):
+        server, loc, token = self._server(tmp_warehouse, private=False)
+        try:
+            client = LakeSoulFlightClient(loc, token=token)
+            client.action("sql", {"statement": "CALL clean()"})  # no raise
+        finally:
+            server.shutdown()

@@ -210,3 +210,97 @@ class TestCacheConcurrency:
         assert not any(t.is_alive() for t in threads), "reader deadlocked"
         assert not errors
         assert cache.current_bytes() <= 48 << 10
+
+
+class TestReadahead:
+    def test_readahead_prefetches_next_pages_on_runtime_pool(self, tmp_path):
+        import time
+
+        import fsspec
+
+        mem_fs = fsspec.filesystem("memory")
+        blob = bytes(range(256)) * 2048  # 512 KiB
+        mem_fs.pipe_file("/ra/seq", blob)
+        cache = DiskPageCache(
+            str(tmp_path / "ra"), page_bytes=32 << 10, readahead_pages=2
+        )
+        got = cache.read_range(mem_fs, "/ra/seq", 0, 1000)
+        assert got == blob[:1000]
+        deadline = time.time() + 5
+        while time.time() < deadline and cache.snapshot()["readahead_pages"] < 2:
+            time.sleep(0.02)
+        snap = cache.snapshot()
+        assert snap["readahead_pages"] == 2
+        # the prefetched pages now serve as pure hits (no new miss)
+        got = cache.read_range(mem_fs, "/ra/seq", 32 << 10, (64 << 10) + 10)
+        assert got == blob[32 << 10 : (64 << 10) + 10]
+        snap2 = cache.snapshot()
+        assert snap2["misses"] == snap["misses"]
+        assert snap2["hits"] > snap["hits"]
+
+    def test_readahead_stops_at_eof_instead_of_refetching(self, tmp_path):
+        import time
+
+        class CountingMem:
+            def __init__(self, inner):
+                self.inner = inner
+                self.gets = 0
+
+            def cat_file(self, *a, **k):
+                self.gets += 1
+                return self.inner.cat_file(*a, **k)
+
+        import fsspec
+
+        mem = fsspec.filesystem("memory")
+        mem.pipe_file("/ra/small", b"x" * (40 << 10))  # 1.25 pages of 32K
+        counting = CountingMem(mem)
+        cache = DiskPageCache(
+            str(tmp_path / "eof"), page_bytes=32 << 10, readahead_pages=2
+        )
+        cache.read_range(counting, "/ra/small", 0, 100)
+        time.sleep(0.4)
+        after_first = counting.gets
+        # repeated tail reads must NOT keep re-issuing past-EOF readahead
+        for _ in range(5):
+            cache.read_range(counting, "/ra/small", 0, 100)
+        time.sleep(0.4)
+        assert counting.gets == after_first, (counting.gets, after_first)
+
+    def test_readahead_with_cached_gap_never_corrupts(self, tmp_path):
+        """A page already cached in the readahead window must not shift the
+        coalesced GET's positional slicing: every page served afterwards
+        must hold its own bytes (regression: gapped `want` list stored page
+        k+1's bytes under index k+2)."""
+        import time
+
+        import fsspec
+
+        mem = fsspec.filesystem("memory")
+        pb = 16 << 10
+        blob = b"".join(bytes([i]) * pb for i in range(8))  # page i = byte i
+        mem.pipe_file("/ra/gap", blob)
+        cache = DiskPageCache(
+            str(tmp_path / "gap"), page_bytes=pb, readahead_pages=4
+        )
+        # seed page 2 in the cache first (scattered read)
+        cache.read_range(mem, "/ra/gap", 2 * pb, 2 * pb + 10)
+        time.sleep(0.3)
+        # read page 0: readahead window [1..4] contains the cached page 2
+        cache.read_range(mem, "/ra/gap", 0, 10)
+        time.sleep(0.5)
+        for page in range(8):
+            got = cache.read_range(mem, "/ra/gap", page * pb, page * pb + 100)
+            assert got == bytes([page]) * 100, f"page {page} corrupted"
+
+    def test_readahead_off_by_default_and_env(self, tmp_path, monkeypatch):
+        import fsspec
+
+        from lakesoul_tpu.io import page_cache as pc_mod
+
+        assert DiskPageCache(str(tmp_path / "d0")).readahead_pages == 0
+        monkeypatch.setenv("LAKESOUL_CACHE_READAHEAD_PAGES", "3")
+        assert DiskPageCache(str(tmp_path / "d1")).readahead_pages == 3
+        # storage-option plumbing retunes an existing cache
+        c = pc_mod.get_cache(str(tmp_path / "d1"), readahead_pages="1")
+        assert c.readahead_pages == 1

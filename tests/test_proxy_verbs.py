@@ -339,3 +339,66 @@ class TestListPaging:
         # the token is echoed back fully URL-encoded on the second page
         assert "continuation-token=tok%2B1%2F%3D" in queries[1]
         assert all(q.startswith("list-type=2&prefix=p") for q in queries)
+
+
+class TestPathTraversal:
+    """_authorize must reject ''/./.. segments — raw AND percent-encoded —
+    before _object_path/_object_key are built (cross-table DELETE/overwrite
+    hole), and the multipart staging path gets the same treatment via the
+    uploadId shape check."""
+
+    def _raw(self, proxy, token, method, path, body=None):
+        import http.client
+
+        c = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=10)
+        headers = {"Authorization": f"Bearer {token}"}
+        if body is not None:
+            headers["Content-Length"] = str(len(body))
+        c.request(method, path, body=body, headers=headers)
+        r = c.getresponse()
+        r.read()
+        c.close()
+        return r.status
+
+    def test_dotdot_segments_rejected(self, proxy_env):
+        _, proxy, token, _, _ = proxy_env
+        for path in (
+            "/default/t/../../t2/file",
+            "/default/t/./file",
+            "/default/t//file",
+            "/default/t/%2e%2e/t2/file",      # encoded '..'
+            "/default/t/..%2Ft2%2Ffile",      # encoded '/' smuggled in a segment
+        ):
+            for method in ("DELETE", "PUT", "GET", "HEAD"):
+                body = b"x" if method == "PUT" else None
+                assert self._raw(proxy, token, method, path, body) == 400, (
+                    method, path,
+                )
+
+    def test_legit_encoded_names_still_work(self, proxy_env):
+        _, proxy, token, _, client = proxy_env
+        assert self._raw(proxy, token, "PUT", "/default/t/part%20one.bin", b"hi") == 201
+        assert client.get("default/t/part one.bin") == b"hi"
+
+    def test_traversal_upload_id_never_touches_fs(self, proxy_env):
+        _, proxy, token, _, _ = proxy_env
+        evil = "..%2F..%2Fevil"
+        status = self._raw(
+            proxy, token, "PUT", f"/default/t/x.bin?partNumber=1&uploadId={evil}", b"x"
+        )
+        assert status == 404  # NoSuchUpload, no filesystem op
+        assert self._raw(
+            proxy, token, "POST", f"/default/t/x.bin?uploadId={evil}", b""
+        ) == 404
+
+    def test_part_number_range_enforced(self, proxy_env):
+        _, proxy, token, _, client = proxy_env
+        up = client.initiate_multipart("default/t/ranged.bin")
+        for bad in ("0", "-3", "10001", "99999"):
+            status = self._raw(
+                proxy, token, "PUT",
+                f"/default/t/ranged.bin?partNumber={bad}&uploadId={up}", b"x",
+            )
+            assert status == 400, bad
+        client.upload_part("default/t/ranged.bin", up, 10000, b"ok")  # max legal
+        client.abort_multipart("default/t/ranged.bin", up)

@@ -1,0 +1,465 @@
+"""Runtime subsystem: pool, staged pipelines, fault injection.
+
+Covers the pipeline contract the data path now stands on — deterministic
+ordered merge, bounded backpressure, cancellation/deadlines, exception
+propagation (with the owning trace id in the failure log), and
+LAKESOUL_FAULTS fault injection — plus the integration points: a killed
+mid-pipeline scan stage surfaces to the caller, and the loader survives on
+runtime pipelines with its stats contract intact.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.runtime import (
+    DeadlineExceeded,
+    FaultInjected,
+    default_pool_size,
+    get_pool,
+    pipeline,
+)
+from lakesoul_tpu.runtime import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# --------------------------------------------------------------------- pool
+class TestWorkerPool:
+    def test_singleton_and_sizing(self):
+        p = get_pool()
+        assert p is get_pool()
+        assert p.size == default_pool_size() >= 2
+
+    def test_in_worker_flag(self):
+        p = get_pool()
+        assert not p.in_worker()
+        assert p.submit(p.in_worker).result() is True
+        assert not p.in_worker()
+
+    def test_env_sizing(self, monkeypatch):
+        monkeypatch.setenv("LAKESOUL_RUNTIME_THREADS", "3")
+        assert default_pool_size() == 3
+        monkeypatch.setenv("LAKESOUL_RUNTIME_THREADS", "not-a-number")
+        assert default_pool_size() >= 2
+
+
+# ---------------------------------------------------------------- pipelines
+class TestOrderedMerge:
+    def test_map_parallel_preserves_order_despite_random_latency(self):
+        rng = np.random.default_rng(0)
+        delays = rng.uniform(0, 0.01, size=200).tolist()
+
+        def work(i):
+            time.sleep(delays[i])
+            return i * 3
+
+        out = list(
+            pipeline("t").source(range(200)).map_parallel(work, workers=8).run()
+        )
+        assert out == [i * 3 for i in range(200)]
+
+    def test_flat_map_parallel_preserves_order_and_flattens(self):
+        def explode(i):
+            time.sleep(0.001 * (i % 5))
+            yield from (i, i + 1000)
+
+        out = list(
+            pipeline("t").source(range(50)).flat_map_parallel(explode, workers=4).run()
+        )
+        assert out == [v for i in range(50) for v in (i, i + 1000)]
+
+    def test_pipelined_equals_serial_byte_for_byte(self):
+        """The determinism contract on real work: same outputs whether the
+        stage runs inline (pool of one) or fanned out."""
+
+        def square(x):
+            return x * x
+
+        serial = [square(x) for x in range(100)]
+        for workers in (1, 2, 7):
+            got = list(
+                pipeline("t").source(range(100)).map_parallel(square, workers=workers).run()
+            )
+            assert got == serial
+
+    def test_stages_compose(self):
+        out = list(
+            pipeline("t")
+            .source(range(20))
+            .map(lambda x: x + 1, name="inc")
+            .map_parallel(lambda x: x * 2, workers=3, name="dbl")
+            .prefetch(4)
+            .run()
+        )
+        assert out == [(x + 1) * 2 for x in range(20)]
+
+
+class TestBackpressure:
+    def test_map_parallel_inflight_bound(self):
+        produced = []
+        lock = threading.Lock()
+
+        def source():
+            for i in range(100):
+                with lock:
+                    produced.append(i)
+                yield i
+
+        it = pipeline("t").source(source()).map_parallel(
+            lambda x: x, workers=2
+        ).run()
+        consumed = 0
+        for _ in it:
+            consumed += 1
+            if consumed == 5:
+                break
+        # in-flight window is workers+1 (+1 being handed to the consumer):
+        # an unbounded producer would have drained all 100 source items
+        with lock:
+            pulled = len(produced)
+        assert pulled <= 5 + 2 + 1 + 1, pulled
+        it.close()
+
+    def test_prefetch_queue_bound(self):
+        produced = []
+
+        def source():
+            for i in range(1000):
+                produced.append(i)
+                yield i
+
+        it = pipeline("t").source(source()).prefetch(3).run()
+        next(it)
+        time.sleep(0.3)  # give the pump every chance to overrun
+        assert len(produced) <= 3 + 2, len(produced)
+        it.close()
+
+    def test_flat_map_slot_buffer_bound(self):
+        emitted = []
+
+        def explode(i):
+            for j in range(100):
+                emitted.append((i, j))
+                yield (i, j)
+
+        it = pipeline("t").source(range(2)).flat_map_parallel(
+            explode, workers=1, buffer=4
+        ).run()
+        next(it)
+        time.sleep(0.3)
+        # 2 active slots × (buffer + 1 in flight) + the consumed item
+        assert len(emitted) <= 2 * 5 + 1, len(emitted)
+        it.close()
+
+
+class TestCancellationAndDeadline:
+    def test_close_stops_producers(self):
+        ran = []
+
+        def slow(x):
+            ran.append(x)
+            time.sleep(0.005)
+            return x
+
+        it = pipeline("t").source(range(10_000)).map_parallel(slow, workers=2).run()
+        next(it)
+        it.close()
+        time.sleep(0.2)
+        settled = len(ran)
+        time.sleep(0.2)
+        assert len(ran) == settled  # nothing keeps running after close
+        assert settled < 100
+
+    def test_abandoned_loader_style_break(self):
+        seen = 0
+        it = pipeline("t").source(range(10_000)).map(lambda x: x).prefetch(2).run()
+        for _ in it:
+            seen += 1
+            if seen >= 3:
+                break
+        it.close()
+        assert seen == 3
+
+    def test_deadline_exceeded_raises(self):
+        it = pipeline("t", deadline_s=0.15).source(range(100)).map_parallel(
+            lambda x: time.sleep(0.1) or x, workers=1
+        ).run()
+        with pytest.raises(DeadlineExceeded):
+            list(it)
+
+    def test_deadline_bounds_serial_map_stages_too(self):
+        """deadline_s bounds the WHOLE run — including serial map stages
+        that never touch a queue or future wait."""
+        it = pipeline("t", deadline_s=0.15).source(range(100)).map(
+            lambda x: time.sleep(0.05) or x
+        ).run()
+        start = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            list(it)
+        assert time.perf_counter() - start < 2.0
+
+    def test_deadline_not_hit_when_fast(self):
+        out = list(
+            pipeline("t", deadline_s=30.0).source(range(10)).map_parallel(
+                lambda x: x, workers=2
+            ).run()
+        )
+        assert out == list(range(10))
+
+
+class TestExceptionPropagation:
+    def test_map_parallel_error_reaches_consumer(self):
+        def boom(x):
+            if x == 7:
+                raise ValueError("x was seven")
+            return x
+
+        with pytest.raises(ValueError, match="x was seven"):
+            list(pipeline("t").source(range(20)).map_parallel(boom, workers=3).run())
+
+    def test_flat_map_error_reaches_consumer_in_order(self):
+        def explode(i):
+            yield i
+            if i == 2:
+                raise RuntimeError("stream died")
+
+        got = []
+        with pytest.raises(RuntimeError, match="stream died"):
+            for v in pipeline("t").source(range(10)).flat_map_parallel(
+                explode, workers=2
+            ).run():
+                got.append(v)
+        assert got == [0, 1, 2]  # everything before the failure, in order
+
+    def test_source_error_through_prefetch(self):
+        def source():
+            yield 1
+            raise OSError("decode failed")
+
+        it = pipeline("t").source(source()).prefetch(2).run()
+        assert next(it) == 1
+        with pytest.raises(OSError, match="decode failed"):
+            next(it)
+
+    def test_map_stage_error_upstream_of_prefetch_surfaces_original(self):
+        """A stage failure INSIDE the pump must reach the consumer as the
+        original exception, never as an opaque PipelineCancelled — even
+        though the cancel flag races the queue hand-off."""
+
+        def boom(x):
+            if x == 3:
+                raise KeyError("collate died")
+            return x
+
+        for _ in range(20):  # the original bug was a race: hammer it
+            with pytest.raises(KeyError, match="collate died"):
+                list(
+                    pipeline("t").source(range(10)).map(boom).prefetch(2).run()
+                )
+
+    def test_failure_log_carries_trace_id(self, caplog):
+        from lakesoul_tpu.obs import span
+
+        with caplog.at_level(logging.ERROR, logger="lakesoul_tpu.runtime.pipeline"):
+            with span("test.op", trace_id="trace-pipeline-test"):
+                with pytest.raises(ValueError):
+                    list(
+                        pipeline("t").source(range(5)).map_parallel(
+                            lambda x: (_ for _ in ()).throw(ValueError("dead")),
+                            workers=2,
+                        ).run()
+                    )
+        assert any("trace-pipeline-test" in r.message for r in caplog.records)
+
+
+# ----------------------------------------------------------- fault injection
+class TestFaultInjection:
+    def test_spec_parsing(self):
+        s = faults.FaultSpec.parse("decode:0.5")
+        assert (s.stage, s.probability, s.kind) == ("decode", 0.5, "error")
+        s = faults.FaultSpec.parse("scan.fetch:1:delay:0.25")
+        assert (s.stage, s.kind, s.seconds) == ("scan.fetch", "delay", 0.25)
+        with pytest.raises(ValueError):
+            faults.FaultSpec.parse("nocolon")
+        with pytest.raises(ValueError):
+            faults.FaultSpec.parse("s:2.0")  # probability out of range
+
+    def test_error_injection_kills_stage(self):
+        faults.install("victim:1.0")
+        with pytest.raises(FaultInjected, match="victim"):
+            list(
+                pipeline("p").source(range(5)).map_parallel(
+                    lambda x: x, workers=2, name="victim"
+                ).run()
+            )
+
+    def test_qualified_stage_match(self):
+        faults.install("only.this:1.0")
+        # same stage name under a different pipeline: untouched
+        out = list(
+            pipeline("other").source(range(3)).map(lambda x: x, name="this").run()
+        )
+        assert out == [0, 1, 2]
+        with pytest.raises(FaultInjected):
+            list(pipeline("only").source(range(3)).map(lambda x: x, name="this").run())
+
+    def test_delay_injection_slows_stage(self):
+        faults.install("lag:1.0:delay:0.05")
+        start = time.perf_counter()
+        list(pipeline("p").source(range(3)).map(lambda x: x, name="lag").run())
+        assert time.perf_counter() - start >= 0.14
+
+    def test_env_spec_load(self, monkeypatch):
+        monkeypatch.setattr(faults, "_ENV_LOADED", False)
+        monkeypatch.setattr(faults, "_SPECS", [])
+        monkeypatch.setattr(faults, "_ENABLED", False)
+        monkeypatch.setenv("LAKESOUL_FAULTS", "a:0.5,b:1:delay:0.2")
+        active = faults.active()
+        assert [(s.stage, s.kind) for s in active] == [("a", "error"), ("b", "delay")]
+
+
+# ------------------------------------------------------- scan-path integration
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+def _two_file_table(tmp_path):
+    catalog = LakeSoulCatalog(str(tmp_path / "wh"))
+    t = catalog.create_table("ft", SCHEMA)
+    t.write_arrow(pa.table({"id": np.arange(50), "v": np.zeros(50)}))
+    t.write_arrow(pa.table({"id": np.arange(50, 100), "v": np.ones(50)}))
+    return t
+
+
+class TestScanFaults:
+    def test_killed_decode_stage_propagates_with_trace_id(self, tmp_path, caplog):
+        """Acceptance: kill a mid-pipeline stage during a real scan; the
+        error reaches the caller AND the failure log carries the scan's
+        trace id."""
+        from lakesoul_tpu.obs import span
+
+        t = _two_file_table(tmp_path)
+        faults.install("scan_unit.decode:1.0")
+        with caplog.at_level(logging.ERROR, logger="lakesoul_tpu.runtime.pipeline"):
+            with span("test.scan", trace_id="trace-scan-kill"):
+                with pytest.raises(FaultInjected):
+                    t.scan().to_arrow()
+        assert any("trace-scan-kill" in r.message for r in caplog.records)
+
+    def test_scan_survives_injected_latency(self, tmp_path):
+        t = _two_file_table(tmp_path)
+        faults.install("scan_unit.decode:1.0:delay:0.02")
+        table = t.scan().to_arrow()
+        assert table.num_rows == 100
+        assert sorted(table.column("id").to_pylist()) == list(range(100))
+
+
+class TestScanDeterminism:
+    def test_parallel_to_arrow_matches_serial(self, tmp_path):
+        catalog = LakeSoulCatalog(str(tmp_path / "wh"))
+        t = catalog.create_table("d", SCHEMA, primary_keys=["id"], hash_bucket_num=4)
+        rng = np.random.default_rng(1)
+        for _ in range(3):
+            ids = rng.choice(10_000, 2_000, replace=False)
+            t.write_arrow(pa.table({"id": np.sort(ids), "v": rng.normal(size=2_000)}))
+        serial = t.scan().to_arrow(parallel=False)
+        par = t.scan().to_arrow(parallel=True)
+        assert serial.equals(par)
+
+    def test_threaded_batches_match_serial_order(self, tmp_path):
+        t = _two_file_table(tmp_path)
+        serial = list(t.scan().batch_size(16).to_batches())
+        threaded = list(t.scan().batch_size(16).to_batches(num_threads=4))
+        assert len(serial) == len(threaded)
+        for a, b in zip(serial, threaded):
+            assert a.equals(b)
+
+    def test_threaded_batches_multi_unit_flat_map_path(self, tmp_path):
+        """Multi-unit scans take the runtime flat_map slot path (single-unit
+        ones stay serial): the batch stream must still be byte-identical."""
+        catalog = LakeSoulCatalog(str(tmp_path / "wh"))
+        t = catalog.create_table("mu", SCHEMA, primary_keys=["id"], hash_bucket_num=4)
+        rng = np.random.default_rng(3)
+        for _ in range(2):
+            ids = np.sort(rng.choice(50_000, 5_000, replace=False))
+            t.write_arrow(pa.table({"id": ids, "v": rng.normal(size=5_000)}))
+        assert len(t.scan().scan_plan()) > 1  # really exercises flat_map
+        serial = list(t.scan().batch_size(512).to_batches())
+        threaded = list(t.scan().batch_size(512).to_batches(num_threads=4))
+        assert len(serial) == len(threaded)
+        for a, b in zip(serial, threaded):
+            assert a.equals(b)
+
+
+class TestLoaderOnRuntime:
+    def test_stats_report_queue_depth_and_stall(self, tmp_path):
+        t = _two_file_table(tmp_path)
+        it = t.scan().batch_size(32).to_jax_iter(device_put=False, drop_remainder=False)
+        rows = 0
+        for batch in it:
+            rows += len(batch["id"])
+        s = it.stats()
+        assert rows == 100
+        assert s["rows"] == 100 and s["epochs"] == 1
+        assert s["stall_s"] >= 0.0 and "queue_depth" in s
+        assert s["rows_per_sec"] > 0
+
+    def test_loader_break_stops_pipeline(self, tmp_path):
+        t = _two_file_table(tmp_path)
+        it = t.scan().batch_size(8).to_jax_iter(device_put=False)
+        n = 0
+        for _ in it:
+            n += 1
+            if n == 2:
+                break
+        s = it.stats()
+        assert s["batches"] == 2 and s["epochs"] == 0  # incomplete epoch
+
+    def test_loader_fault_injection_surfaces(self, tmp_path):
+        t = _two_file_table(tmp_path)
+        faults.install("loader.collate:1.0")
+        with pytest.raises(FaultInjected):
+            for _ in t.scan().batch_size(32).to_jax_iter(device_put=False):
+                pass
+
+
+@pytest.mark.slow
+class TestStress:
+    def test_many_items_random_latency_ordered(self):
+        rng = np.random.default_rng(7)
+        delays = rng.uniform(0, 0.002, size=5000)
+
+        def work(i):
+            time.sleep(delays[i])
+            return i
+
+        out = list(
+            pipeline("stress")
+            .source(range(5000))
+            .map_parallel(work, workers=8, name="jitter")
+            .prefetch(16)
+            .run()
+        )
+        assert out == list(range(5000))
+
+    def test_stress_with_random_delay_faults(self):
+        faults.install("stress2.jitter:0.05:delay:0.002")
+        out = list(
+            pipeline("stress2")
+            .source(range(2000))
+            .flat_map_parallel(lambda i: iter((i, -i)), workers=6, name="jitter")
+            .run()
+        )
+        assert out == [v for i in range(2000) for v in (i, -i)]
