@@ -10,6 +10,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py spill      # writer auto-flush (spill) + re-merge
     python benchmarks/micro.py meta       # plan 1 partition out of 100k (ms)
     python benchmarks/micro.py pipeline   # serial vs runtime-pipelined scan
+    python benchmarks/micro.py lint       # lakelint wall-time over the package
     python benchmarks/micro.py all
 """
 
@@ -348,6 +349,29 @@ def bench_pipeline_scan(
         )
 
 
+def bench_lint() -> None:
+    """Analyzer wall-time over the whole package (CI-gate cost leg: the
+    lint gate runs on every PR, so its cost is tracked next to the perf
+    legs; target < 5 s)."""
+    from lakesoul_tpu.analysis import run_repo
+
+    # parse+rule cost is dominated by file IO the first time; report the
+    # steady-state of a fresh run, which is what CI pays
+    start = time.perf_counter()
+    findings, _ = run_repo()
+    dt = time.perf_counter() - start
+    n_files = sum(
+        len([f for f in files if f.endswith(".py")])
+        for _, _, files in os.walk(os.path.join(REPO, "lakesoul_tpu"))
+    )
+    _emit(
+        "lint_package", dt * 1e3, "ms",
+        files=n_files, findings=len(findings),
+        files_per_s=round(n_files / dt, 1),
+    )
+    assert dt < 5.0, f"lint gate took {dt:.1f}s — budget is 5s"
+
+
 LEGS = {
     "merge": bench_merge,
     "formats": bench_formats,
@@ -356,6 +380,7 @@ LEGS = {
     "spill": bench_spill,
     "meta": bench_meta_prune,
     "pipeline": bench_pipeline_scan,
+    "lint": bench_lint,
 }
 
 

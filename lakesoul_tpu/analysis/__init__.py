@@ -1,0 +1,36 @@
+"""lakelint: project-native static analysis + runtime lock-order detection.
+
+Two complementary halves:
+
+- :mod:`engine` + :mod:`rules` — AST lint over the package with
+  project-specific rules (thread discipline, lock-held blocking calls,
+  stage determinism, reader lifetimes, env-var docs, metric naming, sqlite
+  scope), a checked-in ``baseline.json`` and inline
+  ``# lakelint: ignore[rule]`` pragmas.  CLI:
+  ``python -m lakesoul_tpu.analysis`` (also installed as ``lakesoul-lint``
+  and the console's ``lint`` command); CI gate:
+  ``tests/test_analysis_clean.py``.
+- :mod:`lockgraph` — opt-in (``LAKESOUL_LOCKCHECK=1``) instrumented
+  ``Lock``/``RLock`` that records the per-thread acquisition graph at
+  runtime, flags lock-order cycles (potential deadlock) and
+  lock-held-across-``pool.submit``; wired into the test suite via a
+  conftest fixture.
+"""
+
+from lakesoul_tpu.analysis.engine import (
+    Baseline,
+    Finding,
+    Rule,
+    default_baseline_path,
+    run,
+    run_repo,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Rule",
+    "default_baseline_path",
+    "run",
+    "run_repo",
+]

@@ -1,0 +1,114 @@
+"""lakelint CLI.
+
+::
+
+    python -m lakesoul_tpu.analysis                 # lint the package
+    python -m lakesoul_tpu.analysis --json          # machine-readable
+    python -m lakesoul_tpu.analysis path/to/file.py # lint specific paths
+    python -m lakesoul_tpu.analysis --write-baseline  # absorb current findings
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = bad usage.
+Stale baseline entries (suppressions that no longer match anything) are
+reported on stderr so the baseline only ever shrinks — they do not fail the
+run, the CI gate test does that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from lakesoul_tpu.analysis.engine import (
+    Baseline,
+    default_baseline_path,
+    package_root,
+    run,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="lakesoul-lint",
+        description="project-native static analysis for lakesoul_tpu",
+    )
+    parser.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
+    parser.add_argument("--json", action="store_true", help="JSON findings on stdout")
+    parser.add_argument(
+        "--baseline",
+        default=str(default_baseline_path()),
+        help="baseline file (default: analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="ignore the baseline entirely"
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write all current findings into the baseline (reasons start as "
+        "TODO and must be justified before review)",
+    )
+    args = parser.parse_args(argv)
+
+    paths = [Path(p) for p in args.paths] or None
+    baseline = (
+        Baseline([]) if args.no_baseline else Baseline.load(Path(args.baseline))
+    )
+
+    if args.write_baseline:
+        findings, _ = run(paths, baseline=Baseline([]))
+        payload = {
+            "version": 1,
+            "suppressions": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "message": f.message,
+                    "reason": "TODO: justify or fix",
+                }
+                for f in findings
+            ],
+        }
+        Path(args.baseline).write_text(
+            json.dumps(payload, indent=2) + "\n", encoding="utf-8"
+        )
+        print(f"wrote {len(findings)} suppressions to {args.baseline}")
+        return 0
+
+    findings, baseline = run(paths, baseline=baseline)
+
+    if args.json:
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "message": f.message,
+                    }
+                    for f in findings
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\n{len(findings)} finding(s)")
+        else:
+            print(f"clean: no unsuppressed findings under {package_root().name}/")
+
+    for stale in baseline.stale_entries():
+        print(
+            "stale baseline entry (fixed? delete it): "
+            f"[{stale['rule']}] {stale['path']}: {stale['message']}",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

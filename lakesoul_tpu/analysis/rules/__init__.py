@@ -1,0 +1,38 @@
+"""lakelint rule catalog.
+
+Every rule encodes one invariant this codebase has already been burned by
+(or will be at production scale).  The catalog, with rationale, lives in
+ARCHITECTURE.md §Analysis; adding a rule = subclass
+:class:`~lakesoul_tpu.analysis.engine.Rule` in a module here and list it in
+:func:`all_rules`.
+"""
+
+from __future__ import annotations
+
+from lakesoul_tpu.analysis.engine import Rule
+
+from lakesoul_tpu.analysis.rules.concurrency import (
+    LockHeldCallRule,
+    RawThreadRule,
+    SqliteScopeRule,
+)
+from lakesoul_tpu.analysis.rules.conventions import (
+    MetricNameRule,
+    UndocumentedEnvRule,
+)
+from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.resources import UnclosedReaderRule
+
+__all__ = ["all_rules"]
+
+
+def all_rules() -> list[Rule]:
+    return [
+        RawThreadRule(),
+        LockHeldCallRule(),
+        StageNondeterminismRule(),
+        UnclosedReaderRule(),
+        UndocumentedEnvRule(),
+        MetricNameRule(),
+        SqliteScopeRule(),
+    ]

@@ -1,0 +1,186 @@
+"""Concurrency-discipline rules.
+
+The three invariants that keep the threaded data path deadlock- and
+race-free:
+
+- ``raw-thread``: all parallelism flows through ``runtime/pool.py`` so the
+  host's thread budget stays one knob and ``in_worker()`` can break nested
+  blocking submits.  A stray ``ThreadPoolExecutor`` reintroduces exactly the
+  oversubscription + nested-pool deadlock class PR 2 removed.
+- ``lock-held-call``: no blocking call (pool submit/result, join, wait,
+  sleep, file open) while holding a lock — a worker parked on a lock that a
+  blocked submitter holds is the canonical pool deadlock.
+- ``sqlite-scope``: sqlite connections/cursors only inside ``meta/store.py``
+  whose RLock serializes the shared ``:memory:`` connection (the
+  "Cursor needed to be reset" race fixed in PR 2 stays fixed).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from lakesoul_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Rule,
+    dotted_name,
+    walk_stopping_at_functions,
+)
+
+# the one module allowed to construct raw thread primitives
+_POOL_MODULE = "runtime/pool.py"
+
+_THREAD_CTORS = {
+    "threading.Thread",
+    "Thread",
+    "concurrent.futures.ThreadPoolExecutor",
+    "futures.ThreadPoolExecutor",
+    "ThreadPoolExecutor",
+}
+
+
+class RawThreadRule(Rule):
+    id = "raw-thread"
+    title = "raw threading.Thread / ThreadPoolExecutor outside runtime/pool.py"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.relpath.endswith(_POOL_MODULE):
+            return
+        for node in module.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _THREAD_CTORS:
+                yield Finding(
+                    self.id,
+                    module.relpath,
+                    node.lineno,
+                    f"{name}(...) bypasses the shared worker pool "
+                    "(runtime/pool.py); use get_pool()/pipeline stages, or "
+                    "justify with an inline pragma / baseline entry",
+                )
+
+
+# method names that block the calling thread; attribute calls only, so
+# ubiquitous non-blocking names (dict.get, …) stay out
+_BLOCKING_METHODS = {"submit", "result", "join", "wait", "sleep"}
+_BLOCKING_FUNCS = {"open"}
+
+# receivers whose .join is string/path assembly, never a blocking wait
+_JOIN_SAFE_PREFIXES = ("os.path", "posixpath", "ntpath", "pathlib")
+
+
+def _is_blocking_join(call: ast.Call, receiver: str | None) -> bool:
+    """``.join`` is only a blocking wait on thread-like receivers:
+    ``str.join``/``os.path.join`` always take positional arguments while
+    ``Thread.join`` takes none (timeouts are keyword in this codebase), so
+    a positional-arg join is string/path assembly unless the receiver name
+    says otherwise."""
+    if receiver and any(
+        receiver == p or receiver.startswith(p + ".") for p in _JOIN_SAFE_PREFIXES
+    ):
+        return False
+    if not call.args:
+        return True
+    terminal = (receiver or "").rsplit(".", 1)[-1].lower()
+    return any(hint in terminal for hint in ("thread", "proc", "worker", "pump"))
+
+
+def _is_lock_expr(node: ast.expr) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    terminal = name.rsplit(".", 1)[-1]
+    return "lock" in terminal.lower()
+
+
+class LockHeldCallRule(Rule):
+    id = "lock-held-call"
+    title = "blocking call or pool.submit while holding a lock"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in module.walk():
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_names = [
+                dotted_name(item.context_expr)
+                for item in node.items
+                if _is_lock_expr(item.context_expr)
+            ]
+            if not lock_names:
+                continue
+            held = lock_names[0]
+            for inner in walk_stopping_at_functions(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                func = inner.func
+                if isinstance(func, ast.Attribute) and func.attr in _BLOCKING_METHODS:
+                    if isinstance(func.value, ast.Constant):
+                        continue  # ", ".join(...) — a str method, not a thread
+                    receiver = dotted_name(func.value)
+                    if func.attr == "join" and not _is_blocking_join(inner, receiver):
+                        continue
+                    called = dotted_name(func) or func.attr
+                elif isinstance(func, ast.Name) and func.id in _BLOCKING_FUNCS:
+                    called = func.id
+                else:
+                    continue
+                yield Finding(
+                    self.id,
+                    module.relpath,
+                    inner.lineno,
+                    f"{called}(...) can block while holding {held} — the "
+                    "nested-pool deadlock class; move the blocking work "
+                    "outside the critical section",
+                )
+
+
+_STORE_MODULE = "meta/store.py"
+_SQLITE_MARKERS = {"sqlite3.connect", "sqlite3.Connection", "sqlite3.Cursor"}
+
+
+class SqliteScopeRule(Rule):
+    id = "sqlite-scope"
+    title = "direct sqlite use outside the serialized meta/store.py path"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.relpath.endswith(_STORE_MODULE):
+            return
+        for node in module.walk():
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "sqlite3":
+                        yield Finding(
+                            self.id,
+                            module.relpath,
+                            node.lineno,
+                            "import sqlite3 outside meta/store.py — all "
+                            "sqlite access must go through the store's "
+                            "RLock-serialized connection",
+                        )
+            elif isinstance(node, ast.ImportFrom) and node.module == "sqlite3":
+                yield Finding(
+                    self.id,
+                    module.relpath,
+                    node.lineno,
+                    "from sqlite3 import … outside meta/store.py — all "
+                    "sqlite access must go through the store's "
+                    "RLock-serialized connection",
+                )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in _SQLITE_MARKERS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "cursor"
+                    and (dotted_name(node.func) or "").split(".")[-2:-1]
+                    in (["conn"], ["connection"], ["db"], ["_conn"], ["_db"])
+                ):
+                    yield Finding(
+                        self.id,
+                        module.relpath,
+                        node.lineno,
+                        f"{name or 'cursor'}(...) outside meta/store.py — "
+                        "the shared :memory: connection races without the "
+                        "store's RLock (the 'Cursor needed to be reset' bug)",
+                    )

@@ -1,0 +1,195 @@
+"""``unclosed-reader``: pyarrow readers / IPC streams / memory maps must be
+closed or context-managed.
+
+A leaked ``pa.memory_map`` pins a file descriptor and the whole mapping
+until GC gets around to it; at loader rates (thousands of scan units per
+epoch) that is an fd-exhaustion outage, and on Windows an unclosed map
+blocks compaction's file replacement.  The LSF reader leaked exactly this
+way until this rule flagged it (``LsfFile`` now closes — see io/lsf.py).
+
+Heuristics, in order:
+
+1. constructor used as a ``with`` context manager → fine;
+2. chained use-and-drop (``Ctor(...).attr``) or bare expression → flagged;
+3. assigned to a local name → the enclosing function must ``close()`` it,
+   ``with`` it, wrap it in ``contextlib.closing``, return/yield it
+   (ownership transferred), or pass it onward as a call argument;
+4. stored on ``self`` → the class must define ``close``/``__exit__``/
+   ``__del__`` (someone has to end the object's lifetime deliberately).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from lakesoul_tpu.analysis.engine import Finding, Module, Rule, dotted_name
+
+_CLOSABLE_CTORS = {
+    "pa.memory_map",
+    "pyarrow.memory_map",
+    "pa.OSFile",
+    "pyarrow.OSFile",
+    "pa.ipc.open_stream",
+    "pyarrow.ipc.open_stream",
+    "ipc.open_stream",
+    "pa.ipc.open_file",
+    "pyarrow.ipc.open_file",
+    "ipc.open_file",
+    "pa.ipc.new_stream",
+    "pyarrow.ipc.new_stream",
+    "ipc.new_stream",
+    "pa.ipc.new_file",
+    "pyarrow.ipc.new_file",
+    "ipc.new_file",
+    "pq.ParquetFile",
+    "pyarrow.parquet.ParquetFile",
+    "ParquetFile",
+    # project-native closable readers
+    "LsfFile",
+}
+
+
+def _nearest(parents, node, kinds):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _name_released(scope: ast.AST, name: str) -> bool:
+    """True when ``name`` is closed, context-managed, escapes by return/yield,
+    or is handed to another call inside ``scope``."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "close"
+                and dotted_name(func.value) == name
+            ):
+                return True
+            if dotted_name(func) in ("contextlib.closing", "closing") and any(
+                dotted_name(a) == name for a in node.args
+            ):
+                return True
+            if any(dotted_name(a) == name for a in node.args):
+                return True  # ownership handed onward
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if dotted_name(item.context_expr) == name:
+                    return True
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if dotted_name(node.value) == name:
+                return True
+        elif isinstance(node, (ast.Yield, ast.YieldFrom)) and node.value is not None:
+            if dotted_name(node.value) == name:
+                return True
+        elif isinstance(node, ast.Assign):
+            # re-homed onto self.<attr>: the attribute rule takes over
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and dotted_name(node.value) == name
+                ):
+                    return True
+    return False
+
+
+def _class_can_close(cls: ast.ClassDef) -> bool:
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and stmt.name in (
+            "close",
+            "__exit__",
+            "__del__",
+        ):
+            return True
+    return False
+
+
+class UnclosedReaderRule(Rule):
+    id = "unclosed-reader"
+    title = "pyarrow reader / IPC stream / memory map never closed"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        parents = module.parents()
+        for node in module.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in _CLOSABLE_CTORS:
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue
+            msg = (
+                f"{name}(...) holds an fd/mapping — close it, use a `with` "
+                "block, or transfer ownership explicitly"
+            )
+            if isinstance(parent, ast.Attribute):
+                # Ctor(...).x — used once and dropped; nothing can close it.
+                # Exception: footer-only metadata reads that the ctor itself
+                # documents as self-closing would be context-managed instead.
+                yield Finding(self.id, module.relpath, node.lineno, msg)
+                continue
+            if isinstance(parent, ast.Expr):
+                yield Finding(self.id, module.relpath, node.lineno, msg)
+                continue
+            if isinstance(parent, ast.Assign):
+                tgt = parent.targets[0]
+                scope = _nearest(
+                    parents, node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) or module.tree
+                if isinstance(tgt, ast.Name):
+                    if not _name_released(scope, tgt.id):
+                        yield Finding(self.id, module.relpath, node.lineno, msg)
+                    elif _stored_on_self_without_close(
+                        scope, tgt.id, parents, node
+                    ):
+                        yield Finding(
+                            self.id,
+                            module.relpath,
+                            node.lineno,
+                            f"{name}(...) is stored on self but the class "
+                            "defines no close()/__exit__/__del__ — the "
+                            "mapping lives until GC",
+                        )
+                elif (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    cls = _nearest(parents, node, (ast.ClassDef,))
+                    if cls is not None and not _class_can_close(cls):
+                        yield Finding(
+                            self.id,
+                            module.relpath,
+                            node.lineno,
+                            f"{name}(...) is stored on self but "
+                            f"{cls.name} defines no close()/__exit__/"
+                            "__del__ — the mapping lives until GC",
+                        )
+
+
+def _stored_on_self_without_close(scope, name, parents, node) -> bool:
+    """Local name later stashed on ``self`` — walk up to the class and apply
+    the attribute criterion."""
+    stored = False
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign):
+            for tgt in n.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and dotted_name(n.value) == name
+                ):
+                    stored = True
+    if not stored:
+        return False
+    cls = _nearest(parents, node, (ast.ClassDef,))
+    return cls is not None and not _class_can_close(cls)

@@ -249,26 +249,31 @@ class LsfFormat(PhysicalFormat):
 
     def read_table(self, path, *, columns=None, arrow_filter=None,
                    storage_options=None, zone_predicates=None):
-        return self._open(path, storage_options).read(
-            columns, arrow_filter, zone_predicates=zone_predicates
-        )
+        # close the mapping as soon as decode finishes: decoded arrays keep
+        # their own reference to the mapped region, but the fd (and on
+        # Windows the file-replacement block) is released here, not at GC
+        with self._open(path, storage_options) as f:
+            return f.read(columns, arrow_filter, zone_predicates=zone_predicates)
 
     def iter_batches(self, path, *, columns=None, arrow_filter=None,
                      batch_size=65_536, storage_options=None, zone_predicates=None):
-        yield from self._open(path, storage_options).iter_batches(
-            columns, arrow_filter, batch_size, zone_predicates=zone_predicates
-        )
+        with self._open(path, storage_options) as f:
+            yield from f.iter_batches(
+                columns, arrow_filter, batch_size, zone_predicates=zone_predicates
+            )
 
     def read_schema(self, path, storage_options=None):
         from lakesoul_tpu.io.lsf import LsfFile
 
-        return LsfFile(path, storage_options, footer_only=True).schema
+        with LsfFile(path, storage_options, footer_only=True) as f:
+            return f.schema
 
     def count_rows(self, path, storage_options=None):
         # footer-only: local mmap or two ranged GETs, no column data decoded
         from lakesoul_tpu.io.lsf import LsfFile
 
-        return LsfFile(path, storage_options, footer_only=True).n_rows
+        with LsfFile(path, storage_options, footer_only=True) as f:
+            return f.n_rows
 
     def write_table(self, table, path, *, config=None):
         from lakesoul_tpu.io.lsf import write_lsf_table

@@ -339,6 +339,7 @@ class LsfFile:
 
         fs, p = filesystem_for(path, storage_options)
         self._buf = None
+        self._mm = None
         if _is_local(fs):
             mm = pa.memory_map(p, "r")
             self._mm = mm  # the buffer views this mapping; keep it alive
@@ -369,6 +370,26 @@ class LsfFile:
         )
         self.n_rows = footer["n_rows"]
         self.chunks_decoded = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        """Release the file mapping / download buffer.  Decoded arrays stay
+        valid: arrow buffers hold their own reference to the mapped region,
+        so closing here only drops the fd and THIS object's pin on the
+        mapping.  Idempotent."""
+        mm, self._mm = self._mm, None
+        self._buf = None
+        if mm is not None:
+            try:
+                mm.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "LsfFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ------------------------------------------------------------- decoding
     def _np(self, buf_loc, dtype, count=None) -> np.ndarray:

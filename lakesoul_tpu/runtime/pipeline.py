@@ -429,7 +429,7 @@ class PipelineIterator:
             # loop starve every other pipeline in the process.  The pool
             # is reserved for runnable work (map_parallel items).
             self._threads = [t for t in self._threads if t.is_alive()]
-            t = threading.Thread(
+            t = threading.Thread(  # lakelint: ignore[raw-thread] consumer-paced slot pump; a parked pool worker would starve other pipelines
                 target=produce, args=(item, q),
                 daemon=True, name=f"{self._name}-{st.name}-slot",
             )
@@ -501,7 +501,7 @@ class PipelineIterator:
                         self._first_error = e
                 self._q_put(q, e)
 
-        t = threading.Thread(
+        t = threading.Thread(  # lakelint: ignore[raw-thread] prefetch pump parks on a bounded queue; pool workers are reserved for runnable work
             target=pump, daemon=True, name=f"{self._name}-{st.name}"
         )
         self._threads.append(t)

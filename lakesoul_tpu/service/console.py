@@ -65,6 +65,7 @@ class Console:
             "  clean                        run the cleaner (TTLs, discard list)\n"
             "  cache-stats                  page cache counters (via the obs registry)\n"
             "  obs-stats [prefix]           full metrics-registry snapshot\n"
+            "  lint                         lakelint static analysis over the package\n"
             "  user-add <name> <pw> [group] register a gateway/proxy user\n"
             "  drop <table>                 drop a table\n"
             "  quit"
@@ -178,6 +179,23 @@ class Console:
             else:
                 lines.append(f"{name} {value}")
         return "\n".join(lines) or "(no metrics recorded)"
+
+    def cmd_lint(self, args) -> str:
+        """Run lakelint (the project-native static analysis) over the
+        installed package with the checked-in baseline — same checks as
+        ``python -m lakesoul_tpu.analysis`` / CI's test_analysis_clean."""
+        from lakesoul_tpu.analysis import run_repo
+
+        findings, baseline = run_repo()
+        lines = [f.render() for f in findings]
+        for stale in baseline.stale_entries():
+            lines.append(
+                f"stale baseline entry: [{stale['rule']}] {stale['path']}"
+            )
+        if not lines:
+            return "lint clean: no unsuppressed findings"
+        lines.append(f"{len(findings)} finding(s)")
+        return "\n".join(lines)
 
     def cmd_drop(self, args) -> str:
         self.catalog.drop_table(args[0])

@@ -36,3 +36,40 @@ def tmp_warehouse(tmp_path):
     wh = tmp_path / "warehouse"
     wh.mkdir()
     return wh
+
+
+# --------------------------------------------------------------- lockcheck
+# LAKESOUL_LOCKCHECK=1 arms lakelint's runtime lock-order/race detector
+# (lakesoul_tpu/analysis/lockgraph.py) for the modules whose race classes
+# have bitten before: the runtime pool/pipelines (nested-pool deadlock) and
+# the metadata store (shared :memory: sqlite cursor race).  Any lock-order
+# cycle or lock-held-across-pool.submit recorded during such a test fails
+# it at teardown.
+
+_LOCKCHECK_MODULES = ("test_runtime", "test_metadata")
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rpartition(".")[2] not in _LOCKCHECK_MODULES:
+        yield
+        return
+    from lakesoul_tpu.analysis import lockgraph
+
+    if not lockgraph.env_requested() or lockgraph.enabled():
+        # not armed, or something else already manages the detector
+        yield
+        return
+    lockgraph.reset()
+    lockgraph.enable()
+    try:
+        yield
+    finally:
+        violations = lockgraph.violations()
+        lockgraph.disable()
+        lockgraph.reset()
+    assert not violations, "lockgraph violations:\n" + "\n\n".join(
+        v.render() for v in violations
+    )

@@ -1,0 +1,1 @@
+"""Test fixtures: reference tables, seeded lint/lock bugs (lint/, lockbugs)."""

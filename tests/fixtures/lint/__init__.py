@@ -1,0 +1,7 @@
+"""Seeded-bug fixtures for lakelint (tests/test_analysis.py).
+
+Each ``bad_*.py`` module deliberately violates exactly the invariants one
+lint rule guards; the engine must flag every seeded line.  ``ok_clean.py``
+exercises the allowed variants of the same patterns and must stay clean.
+These modules are parsed by the analyzer, never imported.
+"""

@@ -1,0 +1,6 @@
+"""Seeded undocumented-env violation: a knob no README row documents."""
+
+import os
+
+SECRET_KNOB = os.environ.get("LAKESOUL_UNDOCUMENTED_KNOB", "0")  # SEED: undocumented-env
+DOCUMENTED = os.environ.get("LAKESOUL_FIXTURE_DOCUMENTED", "")  # allowed: in fixture README

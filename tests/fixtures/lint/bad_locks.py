@@ -1,0 +1,44 @@
+"""Seeded lock-held-call violations: the nested-pool deadlock shape."""
+
+import threading
+import time
+
+
+class Staging:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._pool = pool
+        self._futures = []
+
+    def schedule(self, fn):
+        with self._lock:
+            fut = self._pool.submit(fn)  # SEED: lock-held-call (submit)
+            self._futures.append(fut)
+            return fut.result()  # SEED: lock-held-call (result)
+
+    def drain(self):
+        with self._lock:
+            time.sleep(0.1)  # SEED: lock-held-call (sleep)
+            data = open("/tmp/state.json").read()  # SEED: lock-held-call (open)
+        return data
+
+    def reap(self, worker_thread):
+        with self._lock:
+            worker_thread.join()  # SEED: lock-held-call (thread join)
+
+    def closure_is_fine(self):
+        with self._lock:
+            # nested function bodies run LATER, outside the critical
+            # section — must not be flagged
+            def later():
+                return self._pool.submit(len)
+
+            self._futures.append(later)
+
+    def string_and_path_joins_are_fine(self, parts, sep, base, name):
+        import os
+
+        with self._lock:
+            key = sep.join(parts)  # allowed: positional-arg join = assembly
+            path = os.path.join(base, name)  # allowed: path assembly
+        return key, path

@@ -1,0 +1,14 @@
+"""Seeded metric-name violations: naming scheme + kind clashes."""
+
+from lakesoul_tpu.obs import registry
+
+
+def record(n):
+    registry().counter("BadCamelName").inc(n)  # SEED: metric-name (scheme)
+    registry().counter("lakesoul_widget_count").inc(n)  # SEED: metric-name (_total)
+    registry().histogram("lakesoul_widget_latency").observe(n)  # SEED: metric-name (_seconds)
+    registry().counter("lakesoul_clash_total").inc(n)  # SEED: metric-name (kind clash)
+    registry().gauge("lakesoul_clash_total").set(n)
+    registry().counter("lakesoul_widget_rows_total").inc(n)  # allowed
+    registry().histogram("lakesoul_widget_decode_seconds").observe(n)  # allowed
+    registry().gauge("lakesoul_widget_depth").set(n)  # allowed

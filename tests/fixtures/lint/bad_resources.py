@@ -1,0 +1,42 @@
+"""Seeded unclosed-reader violations: every leak tier the rule knows."""
+
+import pyarrow as pa
+
+
+def chained_use_and_drop(path):
+    return pa.ipc.open_file(path).schema  # SEED: unclosed-reader (chained)
+
+
+def assigned_never_closed(path):
+    mm = pa.memory_map(path, "r")  # SEED: unclosed-reader (no close)
+    return mm.size()
+
+
+class Holder:
+    """Stores a mapping on self but can never release it."""
+
+    def __init__(self, path):
+        self._mm = pa.memory_map(path, "r")  # SEED: unclosed-reader (no close method)
+
+
+def with_block_is_fine(path):
+    with pa.ipc.open_stream(path) as rd:  # allowed: context-managed
+        return rd.read_all()
+
+
+def closed_is_fine(path):
+    mm = pa.memory_map(path, "r")  # allowed: closed below
+    try:
+        return mm.read_buffer(mm.size())
+    finally:
+        mm.close()
+
+
+class ClosableHolder:
+    """Stores a mapping on self AND owns its lifetime."""
+
+    def __init__(self, path):
+        self._mm = pa.memory_map(path, "r")  # allowed: close() below
+
+    def close(self):
+        self._mm.close()

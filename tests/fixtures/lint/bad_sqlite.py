@@ -1,0 +1,9 @@
+"""Seeded sqlite-scope violations: bypassing the serialized meta store."""
+
+import sqlite3  # SEED: sqlite-scope (import)
+
+
+def count_rows(db_path):
+    conn = sqlite3.connect(db_path)  # SEED: sqlite-scope (connect)
+    cur = conn.cursor()  # SEED: sqlite-scope (cursor)
+    return cur.execute("SELECT COUNT(*) FROM t").fetchone()[0]

@@ -1,0 +1,21 @@
+"""Seeded stage-nondeterminism violations (scoped in via the rule's
+``scope`` parameter — stands in for an ordered pipeline-stage module)."""
+
+import random
+import time
+
+
+def decode_batch(batch):
+    started = time.time()  # SEED: stage-nondeterminism (wall clock)
+    if random.random() < 0.5:  # SEED: stage-nondeterminism (global rng)
+        batch = list(reversed(batch))
+    return batch, time.time() - started  # SEED: stage-nondeterminism
+
+
+def seeded_jitter_is_fine(seed):
+    rng = random.Random(seed)  # allowed: seeded instance
+    return rng.random()
+
+
+def monotonic_is_fine():
+    return time.monotonic(), time.perf_counter()  # allowed

@@ -1,0 +1,15 @@
+"""Seeded raw-thread violations: both spellings of both primitives."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def spawn_worker(fn):
+    t = threading.Thread(target=fn, daemon=True)  # SEED: raw-thread
+    t.start()
+    return t
+
+
+def fan_out(fns):
+    ex = ThreadPoolExecutor(max_workers=4)  # SEED: raw-thread
+    return [ex.submit(f) for f in fns]

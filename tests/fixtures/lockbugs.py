@@ -1,0 +1,70 @@
+"""Deliberate runtime concurrency bugs for the lockgraph detector
+(tests/test_analysis.py).  Each function reproduces one race class with the
+threads SEQUENCED so the bug is observable without the test ever actually
+deadlocking:
+
+- :func:`lock_order_inversion` — thread 1 takes A→B, thread 2 takes B→A.
+  Run back-to-back (never concurrently) it cannot deadlock, but the
+  acquisition graph records A→B then sees B→A close the cycle — exactly
+  the evidence a production deadlock leaves AFTER the fact, available here
+  BEFORE it.
+- :func:`submit_while_locked` — pool work submitted while a lock is held:
+  the nested-pool deadlock shape (a worker needing that lock + a full pool
+  = wedge).
+- :func:`well_ordered` — the same primitives used correctly; must stay
+  violation-free (false-positive guard).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def lock_order_inversion() -> None:
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def first():
+        with a:
+            with b:
+                pass
+
+    def second():
+        with b:
+            with a:  # inversion: the graph already holds a -> b
+                pass
+
+    for fn in (first, second):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+
+def submit_while_locked() -> None:
+    from lakesoul_tpu.runtime.pool import get_pool
+
+    guard = threading.Lock()
+    with guard:
+        fut = get_pool().submit(lambda: 1)
+    assert fut.result() == 1
+
+
+def well_ordered(rounds: int = 3) -> None:
+    a = threading.Lock()
+    b = threading.Lock()
+    r = threading.RLock()
+
+    def use():
+        for _ in range(rounds):
+            with a:
+                with b:
+                    pass
+            with r:
+                with r:  # re-entrancy is not an inversion
+                    pass
+
+    threads = [threading.Thread(target=use) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

@@ -1,0 +1,336 @@
+"""lakelint: every rule must catch its seeded fixture bug, suppression must
+work both ways (pragma + baseline), and the lockgraph detector must catch
+the seeded lock-order inversion and lock-held-across-submit — and stay
+silent on correct code, including the real runtime/meta paths."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+
+import pytest
+
+from lakesoul_tpu.analysis import Baseline, run
+from lakesoul_tpu.analysis import lockgraph
+from lakesoul_tpu.analysis.engine import Module
+from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures"
+LINT = FIXTURES / "lint"
+
+
+def lint_fixture(name: str, rules=None):
+    findings, _ = run([LINT / name], root=LINT, rules=rules)
+    return findings
+
+
+# --------------------------------------------------------------- lint rules
+
+
+def test_raw_thread_rule_catches_both_primitives():
+    found = lint_fixture("bad_threads.py")
+    rules = [f.rule for f in found]
+    assert rules.count("raw-thread") == 2
+    lines = {f.line for f in found if f.rule == "raw-thread"}
+    src = (LINT / "bad_threads.py").read_text().splitlines()
+    for line in lines:
+        assert "SEED: raw-thread" in src[line - 1]
+
+
+def test_lock_held_call_rule_catches_each_blocking_call():
+    found = [f for f in lint_fixture("bad_locks.py") if f.rule == "lock-held-call"]
+    called = sorted(f.message.split("(", 1)[0] for f in found)
+    assert len(found) == 5, found
+    assert any("submit" in c for c in called)
+    assert any("result" in c for c in called)
+    assert any("sleep" in c for c in called)
+    assert any("worker_thread.join" in c for c in called)
+    assert any(c.strip() == "open" for c in called)
+    # the closure body must NOT be flagged (runs outside the lock)
+    src = (LINT / "bad_locks.py").read_text().splitlines()
+    for f in found:
+        assert "SEED: lock-held-call" in src[f.line - 1]
+
+
+def test_stage_nondeterminism_rule():
+    rules = [StageNondeterminismRule(scope=("bad_stage.py",))]
+    found = [
+        f for f in lint_fixture("bad_stage.py", rules=rules)
+        if f.rule == "stage-nondeterminism"
+    ]
+    assert len(found) == 3, found
+    src = (LINT / "bad_stage.py").read_text().splitlines()
+    for f in found:
+        assert "SEED: stage-nondeterminism" in src[f.line - 1]
+    # out-of-scope module: silent even with violations present
+    assert lint_fixture("bad_stage.py") == []
+
+
+def test_unclosed_reader_rule_flags_each_leak_tier_only():
+    found = [f for f in lint_fixture("bad_resources.py") if f.rule == "unclosed-reader"]
+    src = (LINT / "bad_resources.py").read_text().splitlines()
+    assert len(found) == 3, found
+    for f in found:
+        assert "SEED: unclosed-reader" in src[f.line - 1]
+
+
+def test_undocumented_env_rule_reads_readme_table():
+    found = [f for f in lint_fixture("bad_env.py") if f.rule == "undocumented-env"]
+    assert len(found) == 1
+    assert "LAKESOUL_UNDOCUMENTED_KNOB" in found[0].message
+
+
+def test_undocumented_env_wildcard_direction(tmp_path):
+    """A wildcard README row covers vars UNDER the prefix and explicit
+    dynamic-prefix constants (ending in "_"), but a var that merely happens
+    to be a prefix of the row must NOT pass."""
+    (tmp_path / "README.md").write_text(
+        "| `LAKESOUL_PROXY_S3_*` | unset | proxy config |\n"
+    )
+    (tmp_path / "mod.py").write_text(
+        'import os\n'
+        'a = os.environ.get("LAKESOUL_PROXY_S3_ENDPOINT")  # covered\n'
+        'b = "LAKESOUL_PROXY_S3_"  # dynamic prefix: covered\n'
+        'c = os.environ.get("LAKESOUL_PROXY")  # NOT documented\n'
+    )
+    found, _ = run([tmp_path / "mod.py"], root=tmp_path)
+    env = [f for f in found if f.rule == "undocumented-env"]
+    assert len(env) == 1, env
+    assert env[0].message.startswith("LAKESOUL_PROXY ")
+
+
+def test_metric_name_rule_scheme_suffixes_and_kind_clash():
+    found = [f for f in lint_fixture("bad_metrics.py") if f.rule == "metric-name"]
+    msgs = "\n".join(f.message for f in found)
+    assert "'BadCamelName'" in msgs
+    assert "'lakesoul_widget_count'" in msgs and "_total" in msgs
+    assert "'lakesoul_widget_latency'" in msgs and "_seconds" in msgs
+    assert "multiple kinds" in msgs and "'lakesoul_clash_total'" in msgs
+    assert len(found) == 4, found
+
+
+def test_sqlite_scope_rule():
+    found = [f for f in lint_fixture("bad_sqlite.py") if f.rule == "sqlite-scope"]
+    assert len(found) >= 2  # import + connect (cursor heuristic is a bonus)
+    msgs = "\n".join(f.message for f in found)
+    assert "import sqlite3" in msgs
+    assert "sqlite3.connect" in msgs
+
+
+# ------------------------------------------------------------- suppression
+
+
+def test_inline_pragma_suppresses_finding():
+    assert lint_fixture("ok_pragma.py") == []
+    # the same code without the pragma IS a finding
+    mod = Module.load(LINT / "ok_pragma.py", LINT)
+    assert mod.pragma_rules(7) == {"raw-thread"}
+
+
+def test_baseline_suppresses_and_reports_stale(tmp_path):
+    findings, _ = run([LINT / "bad_threads.py"], root=LINT)
+    assert findings
+    entries = [
+        {"rule": f.rule, "path": f.path, "message": f.message, "reason": "test"}
+        for f in findings
+    ]
+    stale = {
+        "rule": "raw-thread",
+        "path": "gone.py",
+        "message": "was fixed long ago",
+        "reason": "test",
+    }
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(
+        json.dumps({"version": 1, "suppressions": entries + [stale]})
+    )
+    baseline = Baseline.load(bl_path)
+    left, baseline = run([LINT / "bad_threads.py"], root=LINT, baseline=baseline)
+    assert left == []
+    stales = baseline.stale_entries()
+    assert len(stales) == 1 and stales[0]["path"] == "gone.py"
+
+
+def test_baseline_requires_reasons(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "suppressions": [{"rule": "x", "path": "y", "message": "z"}],
+    }))
+    with pytest.raises(ValueError, match="justified"):
+        Baseline.load(p)
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    from lakesoul_tpu.analysis.__main__ import main
+
+    rc = main([str(LINT / "bad_threads.py"), "--no-baseline", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    assert {f["rule"] for f in payload} == {"raw-thread"}
+
+    rc = main([str(LINT / "ok_pragma.py"), "--no-baseline"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------- lockgraph
+
+
+@pytest.fixture()
+def clean_lockgraph():
+    lockgraph.reset()
+    yield
+    lockgraph.disable()
+    lockgraph.reset()
+
+
+def test_lockgraph_catches_seeded_inversion(clean_lockgraph):
+    from fixtures import lockbugs
+
+    with lockgraph.watch() as w:
+        lockbugs.lock_order_inversion()
+    kinds = [v.kind for v in w.violations]
+    assert kinds == ["lock-cycle"]
+    v = w.violations[0]
+    assert "inverts an existing lock order" in v.message
+    assert v.stacks  # the acquiring stacks ship with the report
+
+
+def test_lockgraph_catches_submit_while_locked(clean_lockgraph):
+    from fixtures import lockbugs
+    from lakesoul_tpu.runtime.pool import shutdown_pool
+
+    try:
+        with lockgraph.watch() as w:
+            lockbugs.submit_while_locked()
+    finally:
+        shutdown_pool()
+    kinds = [v.kind for v in w.violations]
+    assert kinds == ["submit-while-locked"]
+    assert "pool.submit while holding" in w.violations[0].message
+
+
+def test_lockgraph_silent_on_correct_code(clean_lockgraph):
+    from fixtures import lockbugs
+
+    with lockgraph.watch() as w:
+        lockbugs.well_ordered()
+    assert w.violations == []
+
+
+def test_lockgraph_handles_condition_and_queue(clean_lockgraph):
+    """Checked locks must stay duck-compatible with Condition/Queue — the
+    places a wrapper with missing protocol methods corrupts bookkeeping."""
+    import queue
+
+    with lockgraph.watch() as w:
+        q: queue.Queue = queue.Queue(maxsize=2)
+
+        def produce():
+            for i in range(10):
+                q.put(i)
+
+        t = threading.Thread(target=produce)
+        t.start()
+        got = [q.get() for _ in range(10)]
+        t.join()
+        assert got == list(range(10))
+
+        cond = threading.Condition()
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:
+            hits.append(1)
+            cond.notify_all()
+        t.join()
+    assert w.violations == []
+
+
+def test_lockgraph_no_false_cycle_from_address_reuse(clean_lockgraph):
+    """Edges are keyed by per-wrapper serials: GC'd locks whose id() gets
+    reused must not poison the graph with stale edges (regression: 200
+    fresh a->b pairs used to yield dozens of false cycles)."""
+    with lockgraph.watch() as w:
+        for _ in range(200):
+            a, b = threading.Lock(), threading.Lock()
+            with a:
+                with b:
+                    pass
+    assert w.violations == [], "\n".join(v.render() for v in w.violations)
+
+
+def test_lockgraph_cross_thread_release_clears_hold(clean_lockgraph):
+    """A plain Lock released by another thread (handoff/gate pattern) must
+    clear the acquiring thread's hold — no phantom submit-while-locked."""
+    from lakesoul_tpu.runtime.pool import get_pool, shutdown_pool
+
+    try:
+        with lockgraph.watch() as w:
+            gate = threading.Lock()
+            gate.acquire()
+
+            def release_from_other_thread():
+                gate.release()
+
+            t = threading.Thread(target=release_from_other_thread)
+            t.start()
+            t.join()
+            assert lockgraph.current_held() == []
+            assert get_pool().submit(lambda: 1).result() == 1
+    finally:
+        shutdown_pool()
+    assert w.violations == [], "\n".join(v.render() for v in w.violations)
+
+
+def test_lockgraph_disable_restores_primitives(clean_lockgraph):
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    with lockgraph.watch():
+        assert threading.Lock is not real_lock
+        assert threading.RLock is not real_rlock
+    assert threading.Lock is real_lock
+    assert threading.RLock is real_rlock
+
+
+def test_lockgraph_clean_on_real_data_path(clean_lockgraph, tmp_path):
+    """Integration guard: the runtime pipeline + meta store under
+    instrumentation — the two subsystems whose race classes this PR exists
+    to keep dead — must produce zero violations."""
+    import pyarrow as pa
+
+    from lakesoul_tpu.runtime.pipeline import pipeline
+    from lakesoul_tpu.runtime.pool import shutdown_pool
+
+    try:
+        with lockgraph.watch() as w:
+            it = (
+                pipeline("lockcheck")
+                .source(range(64))
+                .map_parallel(lambda x: x * 2, workers=4, name="double")
+                .prefetch(2)
+                .run()
+            )
+            assert list(it) == [x * 2 for x in range(64)]
+            it.close()
+
+            from lakesoul_tpu import LakeSoulCatalog
+
+            catalog = LakeSoulCatalog(
+                str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db")
+            )
+            t = catalog.create_table(
+                "lockcheck_t", pa.schema([("id", pa.int64())])
+            )
+            t.write_arrow(pa.table({"id": list(range(100))}))
+            assert t.to_arrow().num_rows == 100
+    finally:
+        shutdown_pool()
+    assert w.violations == [], "\n".join(v.render() for v in w.violations)

@@ -1,0 +1,49 @@
+"""CI gate: the repo must lint clean.
+
+``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
+findings over the whole package — and the checked-in baseline must stay
+honest: every suppression justified, none stale.  A new finding here means
+either fix the code or add a *justified* baseline entry in the same PR."""
+
+from __future__ import annotations
+
+from lakesoul_tpu.analysis import run_repo
+from lakesoul_tpu.analysis.engine import Baseline, default_baseline_path
+
+
+def test_package_lints_clean():
+    findings, _ = run_repo()
+    assert findings == [], "unsuppressed lint findings:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_baseline_entries_all_used_and_justified():
+    baseline = Baseline.load(default_baseline_path())
+    for e in baseline.entries:
+        reason = e.get("reason", "")
+        assert reason and "TODO" not in reason, (
+            f"baseline entry for {e['path']} lacks a real justification"
+        )
+    _, baseline = run_repo()
+    stale = baseline.stale_entries()
+    assert stale == [], "stale baseline entries (delete them):\n" + "\n".join(
+        f"[{e['rule']}] {e['path']}: {e['message']}" for e in stale
+    )
+
+
+def test_cli_gate_exit_zero(capsys):
+    from lakesoul_tpu.analysis.__main__ import main
+
+    assert main([]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_console_lint_command(tmp_warehouse):
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.service.console import Console
+
+    c = Console(LakeSoulCatalog(str(tmp_warehouse)))
+    out = c.execute("lint")
+    assert "lint clean" in out
+    assert "lint" in c.execute("help")
