@@ -1,6 +1,6 @@
 """lakelint: project-native static analysis + runtime lock-order detection.
 
-Two complementary halves:
+Three complementary layers:
 
 - :mod:`engine` + :mod:`rules` — AST lint over the package with
   project-specific rules (thread discipline, lock-held blocking calls,
@@ -10,6 +10,13 @@ Two complementary halves:
   ``python -m lakesoul_tpu.analysis`` (also installed as ``lakesoul-lint``
   and the console's ``lint`` command); CI gate:
   ``tests/test_analysis_clean.py``.
+- :mod:`callgraph` + :mod:`dataflow` — the interprocedural layer: a
+  project-wide call graph (conservative unknown edges for dynamic
+  dispatch) and a forward taint framework, powering the whole-program
+  rules (``rbac-gate-reachability``, ``taint-path-segments``,
+  ``transitive-lock-held-call``, ``interprocedural-unclosed-reader``).
+  Output/CI upgrades ride along: ``--format sarif`` (:mod:`sarif`) and the
+  diff-aware ``--diff BASE`` gate (:mod:`gitdiff`).
 - :mod:`lockgraph` — opt-in (``LAKESOUL_LOCKCHECK=1``) instrumented
   ``Lock``/``RLock`` that records the per-thread acquisition graph at
   runtime, flags lock-order cycles (potential deadlock) and
@@ -19,6 +26,7 @@ Two complementary halves:
 
 from lakesoul_tpu.analysis.engine import (
     Baseline,
+    EngineError,
     Finding,
     Rule,
     default_baseline_path,
@@ -28,6 +36,7 @@ from lakesoul_tpu.analysis.engine import (
 
 __all__ = [
     "Baseline",
+    "EngineError",
     "Finding",
     "Rule",
     "default_baseline_path",

@@ -2,15 +2,25 @@
 
 ::
 
-    python -m lakesoul_tpu.analysis                 # lint the package
-    python -m lakesoul_tpu.analysis --json          # machine-readable
-    python -m lakesoul_tpu.analysis path/to/file.py # lint specific paths
-    python -m lakesoul_tpu.analysis --write-baseline  # absorb current findings
+    python -m lakesoul_tpu.analysis                  # lint the package
+    python -m lakesoul_tpu.analysis --format json    # machine-readable
+    python -m lakesoul_tpu.analysis --format sarif   # SARIF 2.1.0 log
+    python -m lakesoul_tpu.analysis --sarif          # alias for the above
+    python -m lakesoul_tpu.analysis --rule raw-thread --rule sqlite-scope
+    python -m lakesoul_tpu.analysis --diff origin/main   # changed lines only
+    python -m lakesoul_tpu.analysis path/to/file.py  # lint specific paths
+    python -m lakesoul_tpu.analysis --write-baseline # absorb current findings
 
-Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = bad usage.
-Stale baseline entries (suppressions that no longer match anything) are
-reported on stderr so the baseline only ever shrinks — they do not fail the
-run, the CI gate test does that.
+Exit status contract (mirrored by the console ``lint`` command and relied
+on by CI): 0 = no unsuppressed findings, 1 = findings, 2 = the analyzer
+itself failed (unknown --rule id, unreadable baseline, git diff failure,
+bad usage).  Stale baseline entries (suppressions that no longer match
+anything) are reported on stderr so the baseline only ever shrinks — they
+do not fail the run, the CI gate test does that.
+
+``--diff BASE`` resolves findings against ``git diff BASE``: only findings
+on changed/added lines are reported, so a new rule can gate strictly on
+new code while legacy findings live in the baseline.
 """
 
 from __future__ import annotations
@@ -22,10 +32,54 @@ from pathlib import Path
 
 from lakesoul_tpu.analysis.engine import (
     Baseline,
+    EngineError,
     default_baseline_path,
     package_root,
     run,
 )
+
+FORMATS = ("text", "json", "sarif")
+
+
+def _select_rules(rule_ids: list[str] | None):
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    rules = all_rules()
+    if not rule_ids:
+        return rules
+    known = {r.id for r in rules}
+    unknown = [r for r in rule_ids if r not in known]
+    if unknown:
+        raise EngineError(
+            f"unknown rule id(s): {', '.join(unknown)} — known rules: "
+            + ", ".join(sorted(known))
+        )
+    wanted = set(rule_ids)
+    return [r for r in rules if r.id in wanted]
+
+
+def render(findings, rules, fmt: str) -> str:
+    """Findings in the requested format (shared with the console's ``lint``
+    command so both surfaces emit identical bytes)."""
+    if fmt == "json":
+        return json.dumps(
+            [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+            indent=2,
+        )
+    if fmt == "sarif":
+        from lakesoul_tpu.analysis.sarif import to_sarif
+
+        return json.dumps(to_sarif(findings, rules), indent=2)
+    lines = [f.render() for f in findings]
+    if findings:
+        lines.append(f"\n{len(findings)} finding(s)")
+    else:
+        lines.append(f"clean: no unsuppressed findings under {package_root().name}/")
+    return "\n".join(lines)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -34,7 +88,25 @@ def main(argv: list[str] | None = None) -> int:
         description="project-native static analysis for lakesoul_tpu",
     )
     parser.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
-    parser.add_argument("--json", action="store_true", help="JSON findings on stdout")
+    parser.add_argument(
+        "--format", choices=FORMATS, default=None,
+        help="findings format on stdout (default: text)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="alias for --format json"
+    )
+    parser.add_argument(
+        "--sarif", action="store_true", help="alias for --format sarif"
+    )
+    parser.add_argument(
+        "--rule", action="append", metavar="ID", dest="rules",
+        help="run only this rule id (repeatable)",
+    )
+    parser.add_argument(
+        "--diff", metavar="BASE", default=None,
+        help="report only findings on lines changed since the git ref BASE "
+        "(strict-on-new-code mode; legacy findings stay in the baseline)",
+    )
     parser.add_argument(
         "--baseline",
         default=str(default_baseline_path()),
@@ -51,62 +123,66 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    fmt = args.format or ("json" if args.json else "sarif" if args.sarif else "text")
     paths = [Path(p) for p in args.paths] or None
-    baseline = (
-        Baseline([]) if args.no_baseline else Baseline.load(Path(args.baseline))
-    )
 
-    if args.write_baseline:
-        findings, _ = run(paths, baseline=Baseline([]))
-        payload = {
-            "version": 1,
-            "suppressions": [
-                {
-                    "rule": f.rule,
-                    "path": f.path,
-                    "message": f.message,
-                    "reason": "TODO: justify or fix",
-                }
-                for f in findings
-            ],
-        }
-        Path(args.baseline).write_text(
-            json.dumps(payload, indent=2) + "\n", encoding="utf-8"
+    try:
+        rules = _select_rules(args.rules)
+        baseline = (
+            Baseline([]) if args.no_baseline else Baseline.load(Path(args.baseline))
         )
-        print(f"wrote {len(findings)} suppressions to {args.baseline}")
-        return 0
 
-    findings, baseline = run(paths, baseline=baseline)
-
-    if args.json:
-        print(
-            json.dumps(
-                [
+        if args.write_baseline:
+            if args.rules:
+                raise EngineError(
+                    "--write-baseline with --rule would overwrite the "
+                    "baseline with ONLY the filtered rule's findings, "
+                    "deleting every other rule's justified suppressions — "
+                    "run it without --rule"
+                )
+            findings, _ = run(paths, rules=rules, baseline=Baseline([]))
+            payload = {
+                "version": 1,
+                "suppressions": [
                     {
                         "rule": f.rule,
                         "path": f.path,
-                        "line": f.line,
                         "message": f.message,
+                        "reason": "TODO: justify or fix",
                     }
                     for f in findings
                 ],
-                indent=2,
+            }
+            Path(args.baseline).write_text(
+                json.dumps(payload, indent=2) + "\n", encoding="utf-8"
             )
-        )
-    else:
-        for f in findings:
-            print(f.render())
-        if findings:
-            print(f"\n{len(findings)} finding(s)")
-        else:
-            print(f"clean: no unsuppressed findings under {package_root().name}/")
+            print(f"wrote {len(findings)} suppressions to {args.baseline}")
+            return 0
 
-    for stale in baseline.stale_entries():
-        print(
-            "stale baseline entry (fixed? delete it): "
-            f"[{stale['rule']}] {stale['path']}: {stale['message']}",
-            file=sys.stderr,
-        )
+        findings, baseline = run(paths, rules=rules, baseline=baseline)
+
+        if args.diff is not None:
+            from lakesoul_tpu.analysis.gitdiff import filter_to_diff
+
+            findings = filter_to_diff(
+                findings, args.diff, package_root().parent
+            )
+    except EngineError as e:
+        print(f"lakesoul-lint: engine error: {e}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as e:
+        print(f"lakesoul-lint: engine error: {e}", file=sys.stderr)
+        return 2
+
+    print(render(findings, rules, fmt))
+
+    if not args.rules:  # a rule filter makes other rules' entries look stale
+        for stale in baseline.stale_entries():
+            print(
+                "stale baseline entry (fixed? delete it): "
+                f"[{stale['rule']}] {stale['path']}: {stale['message']}",
+                file=sys.stderr,
+            )
     return 1 if findings else 0
 
 

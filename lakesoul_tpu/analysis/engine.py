@@ -39,6 +39,7 @@ from pathlib import Path
 from typing import Iterable, Iterator
 
 __all__ = [
+    "EngineError",
     "Finding",
     "Module",
     "Project",
@@ -49,6 +50,13 @@ __all__ = [
     "package_root",
     "default_baseline_path",
 ]
+
+
+class EngineError(Exception):
+    """The analyzer itself failed (bad rule id, unreadable baseline, git
+    diff failure) — distinct from "the code has findings": the CLI maps
+    findings to exit 1 and EngineError to exit 2 so CI can tell a broken
+    gate from a failing one."""
 
 _PRAGMA_RE = re.compile(r"#\s*lakelint:\s*ignore\[([a-z0-9_,\- ]+)\]")
 
@@ -130,6 +138,17 @@ class Project:
 
     root: Path
     modules: list[Module] = field(default_factory=list)
+    _callgraph: "object | None" = field(default=None, repr=False)
+
+    def callgraph(self):
+        """The project call graph, built ONCE and shared by every
+        interprocedural rule (building it is a full extra pass over the
+        shared AST walks — four rules must not pay it four times)."""
+        if self._callgraph is None:
+            from lakesoul_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph.build(self)
+        return self._callgraph
 
     def readme_text(self) -> str:
         for name in ("README.md", "README.rst", "README"):

@@ -5,6 +5,10 @@ Every rule encodes one invariant this codebase has already been burned by
 ARCHITECTURE.md §Analysis; adding a rule = subclass
 :class:`~lakesoul_tpu.analysis.engine.Rule` in a module here and list it in
 :func:`all_rules`.
+
+Two generations: the PR 3 per-function rules (``check(module)`` over one
+file's shared AST) and the interprocedural rules (``finalize(project)``
+over the shared project call graph — ``Project.callgraph()``).
 """
 
 from __future__ import annotations
@@ -15,19 +19,28 @@ from lakesoul_tpu.analysis.rules.concurrency import (
     LockHeldCallRule,
     RawThreadRule,
     SqliteScopeRule,
+    TransitiveLockHeldCallRule,
 )
 from lakesoul_tpu.analysis.rules.conventions import (
     MetricNameRule,
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
-from lakesoul_tpu.analysis.rules.resources import UnclosedReaderRule
+from lakesoul_tpu.analysis.rules.resources import (
+    InterproceduralUnclosedReaderRule,
+    UnclosedReaderRule,
+)
+from lakesoul_tpu.analysis.rules.security import (
+    RbacGateReachabilityRule,
+    TaintPathSegmentsRule,
+)
 
-__all__ = ["all_rules"]
+__all__ = ["all_rules", "rule_ids"]
 
 
 def all_rules() -> list[Rule]:
     return [
+        # per-function (PR 3)
         RawThreadRule(),
         LockHeldCallRule(),
         StageNondeterminismRule(),
@@ -35,4 +48,13 @@ def all_rules() -> list[Rule]:
         UndocumentedEnvRule(),
         MetricNameRule(),
         SqliteScopeRule(),
+        # interprocedural (call graph + dataflow)
+        RbacGateReachabilityRule(),
+        TaintPathSegmentsRule(),
+        TransitiveLockHeldCallRule(),
+        InterproceduralUnclosedReaderRule(),
     ]
+
+
+def rule_ids() -> list[str]:
+    return [r.id for r in all_rules()]

@@ -95,36 +95,57 @@ def _is_lock_expr(node: ast.expr) -> bool:
     return "lock" in terminal.lower()
 
 
+def blocking_call_name(call: ast.Call) -> str | None:
+    """The dotted name of a direct blocking call, or None.  Shared by the
+    lexical rule and the transitive (call-graph) rule so both agree on what
+    "blocking" means — submit/result/join/wait/sleep attribute calls (with
+    the join string/path disambiguation) plus bare ``open``."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in _BLOCKING_METHODS:
+        if isinstance(func.value, ast.Constant):
+            return None  # ", ".join(...) — a str method, not a thread
+        receiver = dotted_name(func.value)
+        if func.attr == "join" and not _is_blocking_join(call, receiver):
+            return None
+        return dotted_name(func) or func.attr
+    if isinstance(func, ast.Name) and func.id in _BLOCKING_FUNCS:
+        return func.id
+    return None
+
+
+def _iter_lock_bodies_from(nodes):
+    """``(with_node, held_lock_name)`` for the ``with <lock>:`` blocks in
+    ``nodes`` — the ONE definition of "a held lock" shared by the lexical
+    and transitive rules (divergence here would make them disagree about
+    what counts as a critical section)."""
+    for node in nodes:
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_names = [
+            dotted_name(item.context_expr)
+            for item in node.items
+            if _is_lock_expr(item.context_expr)
+        ]
+        if lock_names:
+            yield node, lock_names[0]
+
+
+def iter_lock_bodies(module: Module):
+    """Every ``with <lock>:`` block in the module."""
+    yield from _iter_lock_bodies_from(module.walk())
+
+
 class LockHeldCallRule(Rule):
     id = "lock-held-call"
     title = "blocking call or pool.submit while holding a lock"
 
     def check(self, module: Module) -> Iterable[Finding]:
-        for node in module.walk():
-            if not isinstance(node, (ast.With, ast.AsyncWith)):
-                continue
-            lock_names = [
-                dotted_name(item.context_expr)
-                for item in node.items
-                if _is_lock_expr(item.context_expr)
-            ]
-            if not lock_names:
-                continue
-            held = lock_names[0]
+        for node, held in iter_lock_bodies(module):
             for inner in walk_stopping_at_functions(node.body):
                 if not isinstance(inner, ast.Call):
                     continue
-                func = inner.func
-                if isinstance(func, ast.Attribute) and func.attr in _BLOCKING_METHODS:
-                    if isinstance(func.value, ast.Constant):
-                        continue  # ", ".join(...) — a str method, not a thread
-                    receiver = dotted_name(func.value)
-                    if func.attr == "join" and not _is_blocking_join(inner, receiver):
-                        continue
-                    called = dotted_name(func) or func.attr
-                elif isinstance(func, ast.Name) and func.id in _BLOCKING_FUNCS:
-                    called = func.id
-                else:
+                called = blocking_call_name(inner)
+                if called is None:
                     continue
                 yield Finding(
                     self.id,
@@ -134,6 +155,101 @@ class LockHeldCallRule(Rule):
                     "nested-pool deadlock class; move the blocking work "
                     "outside the critical section",
                 )
+
+
+class TransitiveLockHeldCallRule(Rule):
+    """The lexical rule upgraded with call-graph reach: a helper that
+    sleeps is just as much a deadlock under a held lock as an inline
+    ``sleep`` — and exactly the thing a refactor extracts.  Flags calls in
+    a ``with <lock>:`` body whose resolved callee reaches a direct blocking
+    call within ``max_hops`` call-graph edges (hop 1 = the callee itself).
+    Lexically-direct blocking calls stay the lexical rule's findings."""
+
+    id = "transitive-lock-held-call"
+    title = "blocking call reachable through helpers while holding a lock"
+
+    def __init__(self, max_hops: int = 3):
+        self.max_hops = max_hops
+
+    def finalize(self, project) -> Iterable[Finding]:
+        graph = project.callgraph()
+        blocking_memo: dict[str, "tuple[str, int] | None"] = {}
+
+        def direct_blocking(qname: str):
+            hit = blocking_memo.get(qname, _UNSET)
+            if hit is not _UNSET:
+                return hit
+            fn = graph.functions[qname]
+            found = None
+            for call in walk_stopping_at_functions(fn.node.body):
+                if isinstance(call, ast.Call):
+                    name = blocking_call_name(call)
+                    if name is not None:
+                        found = (name, call.lineno)
+                        break
+            blocking_memo[qname] = found
+            return found
+
+        for fn in graph.functions.values():
+            edges_by_node = {id(e.node): e for e in graph.callees(fn.qname)}
+            for with_node, held in iter_lock_bodies_in(fn):
+                for inner in walk_stopping_at_functions(with_node.body):
+                    if not isinstance(inner, ast.Call):
+                        continue
+                    edge = edges_by_node.get(id(inner))
+                    if edge is None or edge.callee is None:
+                        continue
+                    chain = self._find_blocking_chain(
+                        graph, edge.callee, direct_blocking
+                    )
+                    if chain is None:
+                        continue
+                    path = " -> ".join(
+                        [edge.raw] + [c.rsplit("::", 1)[-1] for c in chain[0][1:]]
+                        + [chain[1]]
+                    )
+                    yield Finding(
+                        self.id,
+                        fn.relpath,
+                        inner.lineno,
+                        f"{edge.raw}(...) reaches {chain[1]}(...) ({path}) "
+                        f"within {len(chain[0])} call(s) while holding "
+                        f"{held} — the nested-pool deadlock class, one "
+                        "refactor away from lock-held-call",
+                    )
+
+    def _find_blocking_chain(self, graph, start: str, direct_blocking):
+        """BFS over resolved edges: shortest (qnames, blocking_name) chain
+        from ``start`` to a function with a direct blocking call, within
+        ``max_hops`` functions; None if none."""
+        frontier = [(start, [start])]
+        seen = {start}
+        for _ in range(self.max_hops):
+            nxt = []
+            for q, path in frontier:
+                hit = direct_blocking(q)
+                if hit is not None:
+                    return path, hit[0]
+                if len(path) >= self.max_hops:
+                    continue
+                for e in graph.callees(q):
+                    if e.callee is not None and e.callee not in seen:
+                        seen.add(e.callee)
+                        nxt.append((e.callee, path + [e.callee]))
+            frontier = nxt
+            if not frontier:
+                break
+        return None
+
+
+_UNSET = object()
+
+
+def iter_lock_bodies_in(fn):
+    """``(with_node, held)`` for with-lock blocks lexically inside ``fn``
+    (not inside its nested defs — those bodies belong to the nested
+    function's own analysis)."""
+    yield from _iter_lock_bodies_from(walk_stopping_at_functions(fn.node.body))
 
 
 _STORE_MODULE = "meta/store.py"

@@ -477,7 +477,13 @@ def get_cache(
     with _CACHES_LOCK:
         cache = _CACHES.get(key)
         if cache is None:
-            cache = DiskPageCache(
+            # construction must stay under _CACHES_LOCK: the one-instance-
+            # per-directory invariant is load-bearing (a racing throwaway
+            # instance would register in _INSTANCES and double-count the
+            # metrics collector until GC).  The work inside is a bounded
+            # local-disk scan + marker open — it never touches the worker
+            # pool, so the nested-pool deadlock class does not apply.
+            cache = DiskPageCache(  # lakelint: ignore[transitive-lock-held-call] singleton construction: bounded local-disk scan under the registry lock, no pool interaction
                 key,
                 max_bytes=int(max_bytes) if max_bytes is not None else DEFAULT_MAX_BYTES,
                 page_bytes=int(page_bytes) if page_bytes is not None else DEFAULT_PAGE_BYTES,

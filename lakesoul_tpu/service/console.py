@@ -65,7 +65,8 @@ class Console:
             "  clean                        run the cleaner (TTLs, discard list)\n"
             "  cache-stats                  page cache counters (via the obs registry)\n"
             "  obs-stats [prefix]           full metrics-registry snapshot\n"
-            "  lint                         lakelint static analysis over the package\n"
+            "  lint [--rule ID] [--format text|json|sarif]\n"
+            "                               lakelint static analysis over the package\n"
             "  user-add <name> <pw> [group] register a gateway/proxy user\n"
             "  drop <table>                 drop a table\n"
             "  quit"
@@ -182,16 +183,42 @@ class Console:
 
     def cmd_lint(self, args) -> str:
         """Run lakelint (the project-native static analysis) over the
-        installed package with the checked-in baseline — same checks as
-        ``python -m lakesoul_tpu.analysis`` / CI's test_analysis_clean."""
-        from lakesoul_tpu.analysis import run_repo
+        installed package with the checked-in baseline — same checks, same
+        ``--rule``/``--format`` filters and same rendering as
+        ``python -m lakesoul_tpu.analysis`` / CI's test_analysis_clean.
 
-        findings, baseline = run_repo()
-        lines = [f.render() for f in findings]
-        for stale in baseline.stale_entries():
-            lines.append(
-                f"stale baseline entry: [{stale['rule']}] {stale['path']}"
+        Usage: ``lint [--rule ID]... [--format text|json|sarif]``"""
+        from lakesoul_tpu.analysis import Baseline, EngineError, run
+        from lakesoul_tpu.analysis.__main__ import FORMATS, _select_rules, render
+        from lakesoul_tpu.analysis.engine import default_baseline_path
+
+        rule_ids: list[str] = []
+        fmt = "text"
+        it = iter(args)
+        for tok in it:
+            if tok == "--rule":
+                rule_ids.append(next(it, ""))
+            elif tok == "--format":
+                fmt = next(it, "text")
+            else:
+                return f"lint: unknown argument {tok!r}"
+        if fmt not in FORMATS:
+            return f"lint: unknown format {fmt!r} (choose from {'/'.join(FORMATS)})"
+        try:
+            rules = _select_rules(rule_ids or None)
+            findings, baseline = run(
+                rules=rules, baseline=Baseline.load(default_baseline_path())
             )
+        except EngineError as e:
+            return f"lint: engine error: {e}"
+        if fmt != "text":
+            return render(findings, rules, fmt)
+        lines = [f.render() for f in findings]
+        if not rule_ids:  # a rule filter makes other entries look stale
+            for stale in baseline.stale_entries():
+                lines.append(
+                    f"stale baseline entry: [{stale['rule']}] {stale['path']}"
+                )
         if not lines:
             return "lint clean: no unsuppressed findings"
         lines.append(f"{len(findings)} finding(s)")

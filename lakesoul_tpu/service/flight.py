@@ -332,15 +332,29 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
         if action.type == "create_table":
             schema = pa.ipc.read_schema(pa.BufferReader(bytes.fromhex(body["schema_ipc_hex"])))
-            self.catalog.create_table(
+            ns = body.get("namespace", "default")
+            # a table that does not exist yet has no domain to check, so
+            # creation is open to any AUTHENTICATED principal (reference
+            # semantics: new tables land in the public domain)
+            self.catalog.create_table(  # lakelint: ignore[rbac-gate-reachability] pre-create there is no table domain to check; the post-create _check below gates the result
                 body["table"],
                 schema,
                 primary_keys=body.get("primary_keys"),
                 range_partitions=body.get("range_partitions"),
                 hash_bucket_num=body.get("hash_bucket_num"),
                 cdc=body.get("cdc", False),
-                namespace=body.get("namespace", "default"),
+                namespace=ns,
             )
+            # post-create gate: the creator must have access to what now
+            # exists — a creation that lands in a domain the caller cannot
+            # reach (raced concurrent create, non-default domain policy)
+            # fails closed, AND rolls the registration back so an
+            # unauthorized caller cannot squat the table name
+            try:
+                self._check(context, ns, body["table"])
+            except flight.FlightUnauthorizedError:
+                self.catalog.drop_table(body["table"], ns)  # lakelint: ignore[rbac-gate-reachability] rollback of the caller's own just-created empty shell after the check DENIED — deleting it IS the enforcement
+                raise
             return [flight.Result(b"ok")]
         if action.type == "drop_table":
             ns = body.get("namespace", "default")

@@ -339,10 +339,14 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             done: set = set()
             try:
                 for key, w in txn.writers.items():
+                    # RBAC ran per-stream at ingest/stage time — each writer
+                    # in txn.writers exists only because its ingest passed
+                    # _check; EndTransaction merely publishes those already-
+                    # authorized staged files under the transaction id
                     if key in txn.replace:
-                        w.checkpoint_replace(cid)
+                        w.checkpoint_replace(cid)  # lakelint: ignore[rbac-gate-reachability] every staged writer passed _check at ingest time; commit publishes only authorized stages
                     else:
-                        w.checkpoint(cid)
+                        w.checkpoint(cid)  # lakelint: ignore[rbac-gate-reachability] every staged writer passed _check at ingest time; commit publishes only authorized stages
                     done.add(key)
             except Exception as e:  # noqa: BLE001 — ANY failure must clean up
                 # per-table commits are individually atomic but there is no
@@ -760,9 +764,20 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             if opts.if_not_exist == pb.CommandStatementIngest.TableDefinitionOptions.TABLE_NOT_EXIST_OPTION_FAIL:
                 raise flight.FlightServerError(f"table {ns}.{name} does not exist")
             pk = [c for c in (msg.options.get("primary_keys") or "").split(",") if c]
-            self.catalog.create_table(
+            # pre-create there is no table domain to check (creation is
+            # open to any authenticated principal); the post-create _check
+            # gates the ingest into what now exists, so a creation racing
+            # into a foreign domain fails closed before any rows stage
+            self.catalog.create_table(  # lakelint: ignore[rbac-gate-reachability] no domain exists pre-create; the _check on the next line gates the created table before any write
                 name, reader.schema, namespace=ns, primary_keys=pk or None
             )
+            try:
+                self._check(context, ns, name)
+            except flight.FlightUnauthorizedError:
+                # roll the registration back: an unauthorized caller must
+                # not squat the table name with an empty shell
+                self.catalog.drop_table(name, ns)  # lakelint: ignore[rbac-gate-reachability] rollback of the caller's own just-created empty shell after the check DENIED — deleting it IS the enforcement
+                raise
         else:
             self._check(context, ns, name)
             if opts.if_exists == pb.CommandStatementIngest.TableDefinitionOptions.TABLE_EXISTS_OPTION_FAIL:

@@ -55,6 +55,33 @@ from lakesoul_tpu.service.rbac import RbacVerifier
 CHUNK = 1 << 20  # streaming unit for GET/PUT bodies
 
 
+def sanitize_path_segments(parts: list[str]) -> list[str] | None:
+    """THE path sanitizer: every request-derived string that can reach a
+    filesystem/object-store call must pass through here first (lakelint's
+    ``taint-path-segments`` rule enforces it interprocedurally).
+
+    An empty/'.'/'..' segment would let the object path escape the
+    RBAC-checked table directory (cross-table DELETE/overwrite through
+    '..').  The DECODED form is checked too: '%2e%2e' passes a raw check
+    but the object key is unquoted before it reaches the signed upstream,
+    where a normalizing endpoint would resolve it.  A trailing slash is an
+    empty segment and is REJECTED, not stripped: silently aliasing the
+    distinct S3 key 'obj/' onto 'obj' would point destructive verbs at the
+    wrong object.  Returns the validated segments, or None to reject."""
+    import urllib.parse
+
+    for p in parts:
+        decoded = urllib.parse.unquote(p)
+        if (
+            p in ("", ".", "..")
+            or decoded in ("", ".", "..")
+            or "/" in decoded
+            or "\\" in decoded
+        ):
+            return None
+    return list(parts)
+
+
 def parse_range(header: str | None, size: int) -> tuple[int, int] | None:
     """``Range: bytes=a-b`` → (start, end_exclusive), None = whole object.
 
@@ -155,26 +182,14 @@ class StorageProxy:
                         if min_parts >= 3 else "path must be /<namespace>/<table>",
                     )
                     return False
-                # path traversal: an empty/'.'/'..' segment would let
-                # _object_path escape the RBAC-checked table directory
-                # (cross-table DELETE/overwrite through '..').  Check the
-                # DECODED form too: '%2e%2e' passes the raw check but
-                # _object_key is unquoted before it reaches the signed
-                # upstream, where a normalizing endpoint would resolve it.
-                # A trailing slash is an empty segment and is REJECTED, not
-                # stripped: silently aliasing the distinct S3 key 'obj/'
-                # onto 'obj' would point destructive verbs at the wrong
-                # object
-                for p in parts:
-                    decoded = urllib.parse.unquote(p)
-                    if (
-                        p in ("", ".", "..")
-                        or decoded in ("", ".", "..")
-                        or "/" in decoded
-                        or "\\" in decoded
-                    ):
-                        self.send_error(400, "invalid path segment")
-                        return False
+                # path traversal: everything derived from the URL below
+                # this point flows through THE sanitizer (rationale on
+                # sanitize_path_segments; lakelint taint-path-segments
+                # tracks the flow across helpers)
+                parts = sanitize_path_segments(parts)
+                if parts is None:
+                    self.send_error(400, "invalid path segment")
+                    return False
                 ns, table = parts[0], parts[1]
                 table_path = f"{proxy.catalog.warehouse}/{ns}/{table}"
                 if not proxy.rbac.verify_permission_by_table_path(user, group, table_path):

@@ -1,0 +1,3 @@
+# seeded-bug fixture package for the interprocedural lakelint rules — each
+# module carries exactly the cross-function bug shape its rule exists for,
+# marked with "SEED: <rule-id>" on the line the rule must report

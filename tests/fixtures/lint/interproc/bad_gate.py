@@ -1,0 +1,33 @@
+"""Seeded bug: a Flight-style handler that mutates the catalog through a
+helper that skips ``_check`` — invisible to any per-function rule, exactly
+what ``rbac-gate-reachability`` exists for.  The guarded branch and the
+gate-carrying helper must stay clean."""
+
+
+class BadServer:
+    def _check(self, context, namespace, table):
+        raise PermissionError("denied")
+
+    def _ensure_access(self, context, table):
+        # gate-carrying helper: establishes the check for its caller
+        self._check(context, "default", table)
+
+    def _mutate_helper(self, body):
+        # no check anywhere on this path — the handler below is to blame
+        self.catalog.drop_table(body["table"])  # SEED: rbac-gate-reachability
+
+    def do_action(self, context, action):
+        body = {"table": "t"}
+        if action == "drop":
+            self._mutate_helper(body)
+        if action == "guarded_drop":
+            self._check(context, "default", body["table"])
+            self.catalog.drop_table(body["table"])  # guarded: NOT a finding
+        if action == "helper_guarded_drop":
+            self._ensure_access(context, body["table"])
+            self.catalog.drop_table(body["table"])  # guarded: NOT a finding
+        return []
+
+    def do_get(self, context, ticket):
+        # read-only handler: no mutation, no finding
+        return self.catalog.table(ticket["table"])

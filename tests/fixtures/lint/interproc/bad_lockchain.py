@@ -1,0 +1,28 @@
+"""Seeded bug: lock → helper → helper → sleep, the refactor shape the
+lexical ``lock-held-call`` rule cannot see (the blocking call is two
+call-graph hops away from the ``with _lock:`` body)."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def _inner():
+    time.sleep(0.1)  # blocking, two hops from the lock
+
+
+def _helper():
+    _inner()
+
+
+def do_work():
+    with _lock:
+        _helper()  # SEED: transitive-lock-held-call
+
+
+def do_safe():
+    with _lock:
+        x = 1 + 1
+    _helper()  # outside the critical section: NOT a finding
+    return x

@@ -1,0 +1,39 @@
+"""Seeded bug: reader ownership dropped across a call boundary.  The
+lexical rule treats "passed to a call" and "returned" as transfers; the
+interprocedural rule follows the transfer and must flag (only) the chains
+where nobody ever owns the fd."""
+
+import pyarrow as pa
+
+
+def _use_and_drop(reader):
+    # neither closes, stores, returns, nor forwards the reader
+    return reader.schema
+
+
+def _closes(reader):
+    reader.close()
+
+
+def leak_across_call(path):
+    f = pa.ipc.open_file(path)  # SEED: interprocedural-unclosed-reader
+    return _use_and_drop(f)
+
+
+def open_reader(path):
+    # ownership transferred to the caller — clean by itself
+    return pa.ipc.open_file(path)
+
+
+def drop_factory_result(path):
+    open_reader(path)  # SEED: interprocedural-unclosed-reader
+
+
+def good_factory_use(path):
+    with open_reader(path) as f:
+        return f.schema
+
+
+def good_handoff(path):
+    f = pa.ipc.open_file(path)
+    _closes(f)  # the helper closes it: NOT a finding

@@ -1,0 +1,39 @@
+"""Seeded bug: a request-derived filename laundered through a helper into
+a filesystem call — the sanitizer is skipped on one path and honored on
+the other, so ``taint-path-segments`` must flag exactly one flow."""
+
+
+def sanitize_path_segments(parts):
+    for p in parts:
+        if p in ("", ".", ".."):
+            return None
+    return parts
+
+
+class BadHandler:
+    def _authorize(self):
+        import urllib.parse
+
+        url = urllib.parse.urlsplit(self.path)
+        self._query = {
+            k: (v[0] if v else "")
+            for k, v in urllib.parse.parse_qs(url.query).items()
+        }
+        return True
+
+    def _write_to(self, path, data):
+        fs, p = filesystem_for(path, {})  # SEED: taint-path-segments
+        with fs.open(p, "wb") as f:
+            f.write(data)
+
+    def do_PUT(self):
+        # laundered: the query value rides through the helper unsanitized
+        name = self._query.get("file", "")
+        self._write_to(name, b"data")
+
+    def do_safe_PUT(self):
+        name = self._query.get("file", "")
+        parts = sanitize_path_segments([name])
+        if parts is None:
+            return
+        self._write_to(parts[0], b"data")  # sanitized: NOT a finding

@@ -1,28 +1,48 @@
 """lakelint: every rule must catch its seeded fixture bug, suppression must
-work both ways (pragma + baseline), and the lockgraph detector must catch
-the seeded lock-order inversion and lock-held-across-submit — and stay
-silent on correct code, including the real runtime/meta paths."""
+work both ways (pragma + baseline), the call-graph builder must resolve
+what it claims to resolve (and record what it cannot as unknown edges),
+the interprocedural rules must catch their seeded cross-function bugs, the
+SARIF/diff output contracts must hold, and the lockgraph detector must
+catch the seeded lock-order inversion and lock-held-across-submit — and
+stay silent on correct code, including the real runtime/meta paths."""
 
 from __future__ import annotations
 
 import json
 import pathlib
+import subprocess
 import threading
 
 import pytest
 
 from lakesoul_tpu.analysis import Baseline, run
 from lakesoul_tpu.analysis import lockgraph
-from lakesoul_tpu.analysis.engine import Module
+from lakesoul_tpu.analysis.engine import Module, Project
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.security import (
+    RbacGateReachabilityRule,
+    TaintPathSegmentsRule,
+)
 
 FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures"
 LINT = FIXTURES / "lint"
+INTERPROC = LINT / "interproc"
 
 
 def lint_fixture(name: str, rules=None):
     findings, _ = run([LINT / name], root=LINT, rules=rules)
     return findings
+
+
+def assert_seed_lines(findings, fixture_rel: str, rule: str):
+    """Every finding for ``rule`` sits on a line carrying its SEED marker,
+    and every SEED marker in the fixture is found — no misses, no drift."""
+    src = (LINT / fixture_rel).read_text().splitlines()
+    seeded = {
+        i + 1 for i, line in enumerate(src) if f"SEED: {rule}" in line
+    }
+    got = {f.line for f in findings if f.rule == rule}
+    assert got == seeded, (rule, sorted(got), sorted(seeded))
 
 
 # --------------------------------------------------------------- lint rules
@@ -116,6 +136,279 @@ def test_sqlite_scope_rule():
     msgs = "\n".join(f.message for f in found)
     assert "import sqlite3" in msgs
     assert "sqlite3.connect" in msgs
+
+
+# ---------------------------------------------------------------- callgraph
+
+
+def _interproc_project() -> Project:
+    project = Project(root=LINT)
+    for p in sorted(INTERPROC.glob("*.py")):
+        mod = Module.load(p, LINT)
+        if mod is not None:
+            project.modules.append(mod)
+    return project
+
+
+def test_callgraph_builds_nodes_and_resolves_edges():
+    graph = _interproc_project().callgraph()
+    # module functions, class methods and the module pseudo-node all exist
+    assert "interproc/bad_lockchain.py::_helper" in graph.functions
+    assert "interproc/bad_gate.py::BadServer.do_action" in graph.functions
+    fn = graph.functions["interproc/bad_gate.py::BadServer.do_action"]
+    assert fn.is_method and fn.class_qname == "interproc/bad_gate.py::BadServer"
+    # plain-name resolution: do_work → _helper → _inner
+    edges = graph.callees("interproc/bad_lockchain.py::do_work")
+    assert any(e.callee == "interproc/bad_lockchain.py::_helper" for e in edges)
+    edges = graph.callees("interproc/bad_lockchain.py::_helper")
+    assert any(e.callee == "interproc/bad_lockchain.py::_inner" for e in edges)
+    # self.<method> resolution through the enclosing class
+    edges = graph.callees("interproc/bad_gate.py::BadServer.do_action")
+    assert any(
+        e.callee == "interproc/bad_gate.py::BadServer._mutate_helper"
+        for e in edges
+    )
+
+
+def test_callgraph_records_unknown_edges_conservatively():
+    graph = _interproc_project().callgraph()
+    # self.catalog.drop_table: dynamic receiver → unknown edge with the
+    # receiver/attr text preserved for rules to pattern-match
+    edges = graph.callees("interproc/bad_gate.py::BadServer._mutate_helper")
+    dyn = [e for e in edges if e.attr == "drop_table"]
+    assert len(dyn) == 1 and not dyn[0].resolved
+    assert dyn[0].receiver == "self.catalog"
+    assert dyn[0].raw == "self.catalog.drop_table"
+    stats = graph.stats()
+    assert stats["unknown_edges"] >= 1 and stats["resolved_edges"] >= 4
+
+
+def test_callgraph_resolves_base_class_methods():
+    """``self._check`` on the Flight SQL server resolves into the base
+    gateway class — the real cross-module shape the RBAC rule leans on."""
+    from lakesoul_tpu.analysis.engine import package_root
+
+    project = Project(root=package_root().parent)
+    for rel in ("service/flight.py", "service/flight_sql.py"):
+        mod = Module.load(package_root() / rel, package_root().parent)
+        assert mod is not None
+        project.modules.append(mod)
+    graph = project.callgraph()
+    q = graph.resolve_method(
+        "lakesoul_tpu/service/flight_sql.py::LakeSoulFlightSqlServer", "_check"
+    )
+    assert q == "lakesoul_tpu/service/flight.py::LakeSoulFlightServer._check"
+
+
+# ------------------------------------------------------ interprocedural rules
+
+
+def test_rbac_gate_reachability_catches_gate_skipping_helper():
+    rules = [RbacGateReachabilityRule(scope=("interproc/bad_gate.py",))]
+    found = lint_fixture("interproc/bad_gate.py", rules=rules)
+    assert_seed_lines(found, "interproc/bad_gate.py", "rbac-gate-reachability")
+    assert len(found) == 1
+    msg = found[0].message
+    assert "do_action" in msg and "_mutate_helper" in msg
+
+
+def test_taint_path_segments_catches_laundered_segment():
+    rules = [TaintPathSegmentsRule(scope=("interproc/bad_taint.py",))]
+    found = lint_fixture("interproc/bad_taint.py", rules=rules)
+    assert_seed_lines(found, "interproc/bad_taint.py", "taint-path-segments")
+    assert len(found) == 1
+    assert "do_PUT" in found[0].message and "_write_to" in found[0].message
+
+
+def test_transitive_lock_held_call_catches_chain():
+    found = [
+        f for f in lint_fixture("interproc/bad_lockchain.py")
+        if f.rule == "transitive-lock-held-call"
+    ]
+    assert_seed_lines(
+        found, "interproc/bad_lockchain.py", "transitive-lock-held-call"
+    )
+    assert len(found) == 1
+    assert "time.sleep" in found[0].message and "_inner" in found[0].message
+    # the lexical rule must NOT double-report the chain
+    assert not [
+        f for f in lint_fixture("interproc/bad_lockchain.py")
+        if f.rule == "lock-held-call"
+    ]
+
+
+def test_interprocedural_unclosed_reader_catches_drops():
+    found = [
+        f for f in lint_fixture("interproc/bad_reader_drop.py")
+        if f.rule == "interprocedural-unclosed-reader"
+    ]
+    assert_seed_lines(
+        found, "interproc/bad_reader_drop.py", "interprocedural-unclosed-reader"
+    )
+    assert len(found) == 2  # handed-to-dropping-helper + factory result dropped
+    msgs = "\n".join(f.message for f in found)
+    assert "drops it" in msgs and "returns an open reader" in msgs
+
+
+def test_interproc_rules_silent_on_real_gateways():
+    """The real service/ modules (post-fix) must be clean under the
+    interprocedural rules without any baseline — pragmas only."""
+    from lakesoul_tpu.analysis.engine import package_root
+    from lakesoul_tpu.analysis.rules.concurrency import TransitiveLockHeldCallRule
+
+    findings, _ = run(
+        [package_root() / "service"],
+        rules=[
+            RbacGateReachabilityRule(),
+            TaintPathSegmentsRule(),
+            TransitiveLockHeldCallRule(),
+        ],
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------------- sarif
+
+
+def test_sarif_output_shape():
+    from lakesoul_tpu.analysis.rules import all_rules
+    from lakesoul_tpu.analysis.sarif import to_sarif
+
+    findings = lint_fixture("bad_threads.py")
+    assert findings
+    log = to_sarif(findings, all_rules())
+    # the SARIF 2.1.0 shape code-scanning consumers read
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-2.1.0.json")
+    (run_,) = log["runs"]
+    driver = run_["tool"]["driver"]
+    assert driver["name"] == "lakesoul-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert len(rule_ids) == 11 and "rbac-gate-reachability" in rule_ids
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+    assert len(run_["results"]) == len(findings)
+    for res, f in zip(run_["results"], findings):
+        assert res["ruleId"] == f.rule
+        assert res["message"]["text"] == f.message
+        (loc,) = res["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"] == f.path
+        assert phys["region"]["startLine"] == f.line
+
+
+def test_cli_sarif_flag(capsys):
+    from lakesoul_tpu.analysis.__main__ import main
+
+    rc = main([str(LINT / "bad_threads.py"), "--no-baseline", "--sarif"])
+    assert rc == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    assert {r["ruleId"] for r in log["runs"][0]["results"]} == {"raw-thread"}
+
+
+# ----------------------------------------------------------------- diff mode
+
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=str(cwd), check=True, capture_output=True,
+    )
+
+
+def test_diff_mode_reports_only_changed_lines(tmp_path):
+    """Two-commit synthetic repo: the legacy violation predates BASE, the
+    new one lands in the diff — only the new one may fail the gate."""
+    from lakesoul_tpu.analysis.gitdiff import changed_lines, filter_to_diff
+
+    _git(tmp_path, "init", "-q")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import threading\n"
+        "\n"
+        "def legacy():\n"
+        "    return threading.Thread(target=print)\n"
+    )
+    _git(tmp_path, "add", "mod.py")
+    _git(tmp_path, "commit", "-qm", "base")
+    mod.write_text(
+        "import threading\n"
+        "\n"
+        "def legacy():\n"
+        "    return threading.Thread(target=print)\n"
+        "\n"
+        "def fresh():\n"
+        "    return threading.Thread(target=print)\n"
+    )
+    _git(tmp_path, "add", "mod.py")
+    _git(tmp_path, "commit", "-qm", "new code")
+
+    findings, _ = run([mod], root=tmp_path)
+    raw = [f for f in findings if f.rule == "raw-thread"]
+    assert {f.line for f in raw} == {4, 7}  # both, pre-filter
+
+    changed = changed_lines("HEAD~1", tmp_path)
+    assert changed == {"mod.py": {5, 6, 7}}
+
+    kept = filter_to_diff(raw, "HEAD~1", tmp_path)
+    assert [f.line for f in kept] == [7]
+    # a base equal to HEAD: nothing changed, nothing reported
+    assert filter_to_diff(raw, "HEAD", tmp_path) == []
+
+    # user git config must not change the '+++' prefix out from under the
+    # parser (a 'w/' prefix would silently empty the map → vacuous gate)
+    _git(tmp_path, "config", "diff.mnemonicprefix", "true")
+    assert changed_lines("HEAD~1", tmp_path) == {"mod.py": {5, 6, 7}}
+
+
+def test_diff_mode_engine_error_is_exit_2(capsys):
+    from lakesoul_tpu.analysis.__main__ import main
+
+    rc = main([str(LINT / "bad_threads.py"), "--no-baseline",
+               "--diff", "no-such-ref-xyzzy"])
+    assert rc == 2
+    assert "engine error" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------- CLI filters
+
+
+def test_cli_rule_filter_and_formats(capsys):
+    from lakesoul_tpu.analysis.__main__ import main
+
+    # --rule filters to one id; --format json parses
+    rc = main([str(LINT / "bad_locks.py"), "--no-baseline",
+               "--rule", "lock-held-call", "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert {f["rule"] for f in json.loads(out)} == {"lock-held-call"}
+    # filtering to a rule with no findings in the file exits clean
+    rc = main([str(LINT / "bad_locks.py"), "--no-baseline",
+               "--rule", "sqlite-scope"])
+    capsys.readouterr()
+    assert rc == 0
+    # unknown rule id is an engine error, not findings
+    rc = main(["--rule", "not-a-rule"])
+    assert rc == 2
+    assert "unknown rule id" in capsys.readouterr().err
+    # --write-baseline under a rule filter would destroy the other rules'
+    # suppressions: refused as an engine error before touching the file
+    rc = main(["--rule", "raw-thread", "--write-baseline"])
+    assert rc == 2
+    assert "--write-baseline with --rule" in capsys.readouterr().err
+
+
+def test_console_lint_mirrors_cli_filters(tmp_warehouse):
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.service.console import Console
+
+    c = Console(LakeSoulCatalog(str(tmp_warehouse)))
+    out = c.execute("lint --rule raw-thread --format json")
+    assert json.loads(out) == []  # repo is clean under the filter
+    sarif = json.loads(c.execute("lint --format sarif"))
+    assert sarif["version"] == "2.1.0"
+    assert c.execute("lint --rule nope").startswith("lint: engine error")
 
 
 # ------------------------------------------------------------- suppression

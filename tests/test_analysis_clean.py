@@ -1,4 +1,5 @@
-"""CI gate: the repo must lint clean.
+"""CI gate: the repo must lint clean — under ALL 11 rules, the 7
+per-function ones and the 4 interprocedural ones (call graph + dataflow).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -10,12 +11,48 @@ from __future__ import annotations
 from lakesoul_tpu.analysis import run_repo
 from lakesoul_tpu.analysis.engine import Baseline, default_baseline_path
 
+EXPECTED_RULES = {
+    # per-function (PR 3)
+    "raw-thread", "lock-held-call", "stage-nondeterminism",
+    "unclosed-reader", "undocumented-env", "metric-name", "sqlite-scope",
+    # interprocedural
+    "rbac-gate-reachability", "taint-path-segments",
+    "transitive-lock-held-call", "interprocedural-unclosed-reader",
+}
+
+
+def test_all_eleven_rules_registered():
+    """run_repo runs the full catalog — a rule silently dropped from the
+    registry would turn this gate into a no-op for its invariant."""
+    from lakesoul_tpu.analysis.rules import rule_ids
+
+    ids = rule_ids()
+    assert len(ids) == len(set(ids)) == 11
+    assert set(ids) == EXPECTED_RULES
+
 
 def test_package_lints_clean():
     findings, _ = run_repo()
     assert findings == [], "unsuppressed lint findings:\n" + "\n".join(
         f.render() for f in findings
     )
+
+
+def test_interprocedural_rules_clean_repo_wide_without_baseline():
+    """The four interprocedural rules hold with NO baseline entries at all:
+    every intentionally-unguarded site carries an inline pragma whose
+    reason names the invariant (the baseline is reserved for the
+    pre-existing per-function suppressions)."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    interproc = [r for r in all_rules() if r.id in {
+        "rbac-gate-reachability", "taint-path-segments",
+        "transitive-lock-held-call", "interprocedural-unclosed-reader",
+    }]
+    assert len(interproc) == 4
+    findings, _ = run(rules=interproc, baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)
 
 
 def test_baseline_entries_all_used_and_justified():
