@@ -352,8 +352,9 @@ def bench_pipeline_scan(
 def bench_lint() -> None:
     """Analyzer wall-time over the whole package (CI-gate cost leg: the
     lint gate runs on every PR, so its cost is tracked next to the perf
-    legs; target < 10 s for all 11 rules INCLUDING the project call-graph
-    build the interprocedural rules share)."""
+    legs; target < 10 s for all 16 rules INCLUDING the project call-graph
+    build the interprocedural rules share and the device-index/taint
+    passes of the JAX/TPU pack)."""
     from lakesoul_tpu.analysis import run_repo
     from lakesoul_tpu.analysis.engine import Project, Module, package_root
 

@@ -1,6 +1,7 @@
-"""lakelint: project-native static analysis + runtime lock-order detection.
+"""lakelint: project-native static analysis + runtime lock-order and
+retrace detection.
 
-Three complementary layers:
+Four complementary layers:
 
 - :mod:`engine` + :mod:`rules` — AST lint over the package with
   project-specific rules (thread discipline, lock-held blocking calls,
@@ -17,11 +18,18 @@ Three complementary layers:
   ``transitive-lock-held-call``, ``interprocedural-unclosed-reader``).
   Output/CI upgrades ride along: ``--format sarif`` (:mod:`sarif`) and the
   diff-aware ``--diff BASE`` gate (:mod:`gitdiff`).
-- :mod:`lockgraph` — opt-in (``LAKESOUL_LOCKCHECK=1``) instrumented
-  ``Lock``/``RLock`` that records the per-thread acquisition graph at
-  runtime, flags lock-order cycles (potential deadlock) and
-  lock-held-across-``pool.submit``; wired into the test suite via a
-  conftest fixture.
+- :mod:`rules.jaxtpu` — the device pack: five JAX/TPU trace-safety rules
+  (``trace-impure-call``, ``trace-host-sync``, ``tpu-dtype-width``,
+  ``jit-static-arg-shape``, ``pallas-blockspec``) over a shared device
+  index (jit entries, pallas kernels, the traced-function closure) and
+  the taint framework's device-value lattice.
+- :mod:`lockgraph` / :mod:`tracecheck` — the opt-in runtime detectors:
+  ``LAKESOUL_LOCKCHECK=1`` instruments ``Lock``/``RLock`` to record the
+  per-thread acquisition graph (lock-order cycles,
+  lock-held-across-``pool.submit``); ``LAKESOUL_TRACECHECK=1`` wraps jit
+  entry points to count distinct abstract signatures per function and
+  flags functions that recompile beyond their budget.  Both are wired
+  into the test suite via conftest fixtures.
 """
 
 from lakesoul_tpu.analysis.engine import (

@@ -363,6 +363,37 @@ class CallGraph:
                 edges_for(q, info, info.node.body)
         edges_for(module_caller, None, mod.tree.body)
 
+    def resolve_reference(self, relpath: str, caller: "FuncInfo | None",
+                          dotted: str) -> str | None:
+        """Resolve a *reference* to a project function by its dotted source
+        text — same lookup order as call resolution (the caller's nested-def
+        chain, module top-level, imports, ``modalias.symbol``,
+        ``ClassName.method``) but usable where the function is an argument
+        (``lax.scan(layer, ...)``) rather than the thing being called.
+        Returns a function qname (classes resolve to ``__init__``), else
+        None."""
+        if not dotted or dotted.startswith(("self.", "cls.")):
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            if caller is not None:
+                chain = caller.name.split(".")
+                for i in range(len(chain), 0, -1):
+                    q = f"{relpath}::{'.'.join(chain[:i])}.{head}"
+                    if q in self.functions:
+                        return q
+            q = self._resolve_local_name(relpath, head)
+            return self._callable_qname(q) if q is not None else None
+        bound = self._resolve_local_name(relpath, head)
+        if bound is not None and bound in self.classes and "." not in rest:
+            return self.resolve_method(bound, rest)
+        tgt = self._imports.get(relpath, {}).get(head)
+        if tgt and tgt[0] == "mod":
+            mod_dotted, _, symbol = (tgt[1] + "." + rest).rpartition(".")
+            q = self._lookup_project_symbol(mod_dotted, symbol)
+            return self._callable_qname(q) if q is not None else None
+        return None
+
     # ------------------------------------------------------------- querying
 
     def callees(self, qname: str) -> list[CallEdge]:

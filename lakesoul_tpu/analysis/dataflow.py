@@ -59,7 +59,18 @@ _PROPAGATOR_CALLS = {
 
 @dataclass
 class TaintConfig:
-    """What a rule considers source, sanitizer, and sink."""
+    """What a rule considers source, sanitizer, and sink.
+
+    Two lattice policies share this machinery.  The *string-taint* rules
+    (path traversal) use the defaults: unknown calls launder (``int(x)``
+    converts), self-attr reads are the sources.  The *value-tracking*
+    rules (device arrays, 64-bit dtypes) invert both knobs:
+    ``propagate_all_calls`` keeps taint flowing through the jnp ops that
+    make up traced code, ``attr_sanitizers`` (``.shape``/``.dtype``) are
+    the only reads that step a device value back down to a static host
+    value, and ``source_call_predicate``/``receiver_sinks`` let a rule
+    taint call *results* (``np.asarray(x, np.int64)``) and flag tainted
+    *receivers* (``x.item()``)."""
 
     # self.<attr> reads that are taint roots
     source_self_attrs: frozenset[str] = frozenset({"path", "headers", "rfile"})
@@ -73,6 +84,24 @@ class TaintConfig:
     sink_methods: dict = field(default_factory=dict)
     # keyword names that are sinks on those same calls
     sink_keywords: frozenset[str] = frozenset()
+    # full dotted-name calls → index of the sink positional arg
+    # (``np.asarray`` must sink while ``jnp.asarray`` stays a device op —
+    # terminal-name matching cannot tell them apart)
+    sink_calls: dict = field(default_factory=dict)
+    # terminal names where EVERY positional argument is a sink (calls into
+    # jit entry points: any tainted arg crosses the device boundary)
+    sink_all_args_names: frozenset[str] = frozenset()
+    # attribute READS on a tainted base that return a clean value
+    # (x.shape, x.dtype: static metadata of a device value)
+    attr_sanitizers: frozenset[str] = frozenset()
+    # method calls whose TAINTED RECEIVER is itself the sink (x.item())
+    receiver_sinks: frozenset[str] = frozenset()
+    # predicate(call, dotted_name) → True when the call RESULT is a source
+    # (np.float64(...), np.asarray(x, dtype=np.int64), ...)
+    source_call_predicate: "object | None" = None
+    # unknown calls with tainted args return tainted (device-value lattice:
+    # every jnp op keeps the result on device) instead of laundering
+    propagate_all_calls: bool = False
 
     def is_sanitizer(self, terminal: str) -> bool:
         return terminal in self.sanitizers or any(
@@ -141,6 +170,15 @@ class TaintAnalysis:
             if key not in best or len(h.chain) < len(best[key].chain):
                 best[key] = h
         return sorted(best.values(), key=lambda h: (h.relpath, h.line))
+
+    def analyze_entry(self, qname: str,
+                      tainted_params: frozenset[str]) -> list[SinkHit]:
+        """Analyze ONE function with the given parameters tainted — the
+        entry form the device rules use (a jit boundary's array arguments
+        are the sources, not any self-attribute)."""
+        fn = self.graph.functions[qname]
+        _, hits = self._analyze(fn, tainted_params, depth=0)
+        return hits
 
     # ---------------------------------------------------- class attr taint
 
@@ -332,6 +370,8 @@ class TaintAnalysis:
         if isinstance(expr, ast.Name):
             return expr.id in state.tainted
         if isinstance(expr, ast.Attribute):
+            if expr.attr in cfg.attr_sanitizers:
+                return False  # static metadata of a tainted value (.shape)
             base = expr.value
             if isinstance(base, ast.Name) and base.id == "self":
                 if expr.attr in cfg.source_self_attrs:
@@ -394,6 +434,10 @@ class TaintAnalysis:
         cfg = self.config
         name = dotted_name(call.func)
         terminal = (name or "").rsplit(".", 1)[-1]
+        if cfg.source_call_predicate is not None and cfg.source_call_predicate(
+            call, name
+        ):
+            return True
         if name is not None and cfg.is_sanitizer(terminal):
             return False
         args_tainted = any(
@@ -425,6 +469,8 @@ class TaintAnalysis:
             tainted_params = self._map_tainted_params(call, callee, fn, state, depth)
             returns, _ = self._analyze(callee, tainted_params, depth + 1)
             return returns
+        if cfg.propagate_all_calls and args_tainted:
+            return True  # device-value lattice: jnp ops keep taint flowing
         return False
 
     def _resolved_callee(self, call: ast.Call, fn: FuncInfo) -> str | None:
@@ -463,14 +509,28 @@ class TaintAnalysis:
         for call in iter_calls_in_order([ast.Expr(value=expr)]):
             name = dotted_name(call.func)
             terminal = (name or "").rsplit(".", 1)[-1]
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in cfg.receiver_sinks
+                and self._expr_tainted(call.func.value, fn, state, depth=depth)
+            ):
+                hits.append(SinkHit(
+                    fn.relpath, call.lineno, name or terminal,
+                    _render(call.func.value), (fn.name,),
+                ))
             sink_idx = None
             if isinstance(call.func, ast.Name) and call.func.id in cfg.sink_functions:
                 sink_idx = cfg.sink_functions[call.func.id]
             elif isinstance(call.func, ast.Attribute) and call.func.attr in cfg.sink_methods:
                 sink_idx = cfg.sink_methods[call.func.attr]
-            if sink_idx is not None:
+            elif name in cfg.sink_calls:
+                sink_idx = cfg.sink_calls[name]
+            if sink_idx is not None or terminal in cfg.sink_all_args_names:
                 exprs = []
-                if sink_idx < len(call.args):
+                if terminal in cfg.sink_all_args_names:
+                    exprs.extend(call.args)
+                    exprs += [kw.value for kw in call.keywords]
+                elif sink_idx is not None and sink_idx < len(call.args):
                     exprs.append(call.args[sink_idx])
                 exprs += [
                     kw.value for kw in call.keywords

@@ -139,6 +139,9 @@ class Project:
     root: Path
     modules: list[Module] = field(default_factory=list)
     _callgraph: "object | None" = field(default=None, repr=False)
+    # the device pack's shared jit/pallas index, cached by
+    # rules.jaxtpu.device_index() with the same build-once contract
+    _device_index: "object | None" = field(default=None, repr=False)
 
     def callgraph(self):
         """The project call graph, built ONCE and shared by every

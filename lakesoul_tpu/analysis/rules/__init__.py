@@ -26,6 +26,13 @@ from lakesoul_tpu.analysis.rules.conventions import (
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.jaxtpu import (
+    JitStaticArgShapeRule,
+    PallasBlockSpecRule,
+    TpuDtypeWidthRule,
+    TraceHostSyncRule,
+    TraceImpureCallRule,
+)
 from lakesoul_tpu.analysis.rules.resources import (
     InterproceduralUnclosedReaderRule,
     UnclosedReaderRule,
@@ -53,6 +60,12 @@ def all_rules() -> list[Rule]:
         TaintPathSegmentsRule(),
         TransitiveLockHeldCallRule(),
         InterproceduralUnclosedReaderRule(),
+        # device pack (jit/pallas trace safety)
+        TraceImpureCallRule(),
+        TraceHostSyncRule(),
+        TpuDtypeWidthRule(),
+        JitStaticArgShapeRule(),
+        PallasBlockSpecRule(),
     ]
 
 

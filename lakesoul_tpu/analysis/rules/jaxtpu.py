@@ -1,0 +1,1018 @@
+"""JAX/TPU trace-safety rules (the device-side rule pack).
+
+The PR 3-4 rules guard the host/threaded half of the codebase; these five
+guard the device half — the jit/pallas-traced code the north-star training
+loop actually runs.  Their failure modes are *silent*: a host side effect
+inside a traced function runs once at trace time and never again; a
+``float64`` reaching a TPU boundary demotes without a word; a
+data-dependent shape recompiles per batch; a malformed BlockSpec either
+fails at Mosaic-compile time on real hardware (never on the CPU fallback
+CI runs) or quietly reads the wrong tile.  Deep Lake (arxiv 2209.10785)
+and arxiv 2604.21275 both identify host↔device transfer discipline and
+static-shape violations as the dominant silent-throughput killers in
+loader stacks — this pack makes them lint findings instead of benchmark
+regressions.
+
+Everything here keys off the **device index** built once per project:
+
+- **jit entries** — functions decorated ``@jax.jit`` /
+  ``@functools.partial(jax.jit, ...)`` (plus ``pjit``), functions passed
+  to a ``jax.jit(...)`` call by name or through ``functools.partial``,
+  and functions whose *parameter* some helper jits (the
+  ``jax.jit(step_fn, ...)`` factory pattern) — each with its parsed
+  ``static_argnames``/``static_argnums``;
+- **pallas kernels** — first argument of every ``pl.pallas_call``;
+- **traced functions** — the transitive closure over resolved call edges
+  *and* function references (``lax.scan(body, ...)``: the callback is
+  traced even when nobody "calls" it), starting from the entries,
+  shard_map-wrapped functions and kernels.  References inside
+  ``pure_callback``/``io_callback`` wrappers are excluded — those escape
+  to the host by design.
+
+The runtime counterpart is :mod:`lakesoul_tpu.analysis.tracecheck`
+(``LAKESOUL_TRACECHECK=1``): these rules catch the lexical causes of
+retraces, the detector catches whatever shape thrash survives them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from lakesoul_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    dotted_name,
+    walk_stopping_at_functions,
+)
+
+# ------------------------------------------------------------ device index
+
+_JIT_DOTTED = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
+_SHARD_MAP_DOTTED = {"shard_map", "jax.shard_map"}
+_PARTIAL_DOTTED = {"functools.partial", "partial"}
+
+# terminal attr names through which a function argument becomes traced code
+_TRANSFORM_TERMINALS = {
+    "jit", "pjit", "shard_map", "pallas_call",
+    "scan", "fori_loop", "while_loop", "cond", "switch", "associative_scan",
+    "vmap", "pmap", "grad", "value_and_grad", "remat", "checkpoint",
+    "custom_vjp", "custom_jvp",
+}
+# lax.map only — a bare ``map(f, xs)`` is the Python builtin
+_LAX_MAP_RECEIVERS = ("lax", "jax.lax")
+
+# callbacks escape the trace to the host on purpose; functions passed to
+# them are host code, not traced code
+_CALLBACK_TERMINALS = {"pure_callback", "io_callback", "callback", "debug_callback"}
+
+
+def _unwrap_partial(expr: ast.expr) -> tuple[ast.expr, "ast.Call | None"]:
+    """``functools.partial(f, ...)`` → (f, the partial call); else (expr, None)."""
+    if (
+        isinstance(expr, ast.Call)
+        and dotted_name(expr.func) in _PARTIAL_DOTTED
+        and expr.args
+    ):
+        return expr.args[0], expr
+    return expr, None
+
+
+def _static_info(call: "ast.Call | None") -> tuple[frozenset, frozenset]:
+    """(static_argnames, static_argnums) parsed from a jit/partial call."""
+    names: set[str] = set()
+    nums: set[int] = set()
+    if call is None:
+        return frozenset(), frozenset()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+        elif kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.add(v.value)
+    return frozenset(names), frozenset(nums)
+
+
+def _decorator_trace_info(dec: ast.expr):
+    """→ ("jit" | "shard_map", kwargs-carrying call | None), or None."""
+    name = dotted_name(dec)
+    if name in _JIT_DOTTED:
+        return "jit", None
+    if name in _SHARD_MAP_DOTTED:
+        return "shard_map", None
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_DOTTED:
+            return "jit", dec
+        if fname in _SHARD_MAP_DOTTED:
+            return "shard_map", dec
+        if fname in _PARTIAL_DOTTED and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner in _JIT_DOTTED:
+                return "jit", dec
+            if inner in _SHARD_MAP_DOTTED:
+                return "shard_map", dec
+    return None
+
+
+class DeviceIndex:
+    """Jit entries, pallas kernels, and the traced-function closure —
+    built ONCE per project (``device_index``) and shared by the pack."""
+
+    def __init__(self) -> None:
+        # qname → (static_argnames, static_argnums, decl line)
+        self.jit_entries: dict[str, tuple[frozenset, frozenset, int]] = {}
+        self.pallas_kernels: set[str] = set()
+        # qname → human reason it is traced ("@jax.jit", "lax.scan callback",
+        # "called from <fn>", ...)
+        self.traced: dict[str, str] = {}
+
+    @classmethod
+    def build(cls, project: Project) -> "DeviceIndex":
+        graph = project.callgraph()
+        idx = cls()
+        roots: list[tuple[str, str]] = []  # (qname, reason)
+
+        # 1. decorators
+        for q, fn in graph.functions.items():
+            for dec in fn.node.decorator_list:
+                info = _decorator_trace_info(dec)
+                if info is None:
+                    continue
+                kind, call = info
+                if kind == "jit":
+                    names, nums = _static_info(call)
+                    idx.jit_entries[q] = (names, nums, fn.node.lineno)
+                    roots.append((q, "@jax.jit"))
+                else:
+                    roots.append((q, "@shard_map"))
+
+        # 2. transform call sites: jit(f)/partial targets, scan/vmap/...
+        # callbacks, pallas kernels; plus the jit-a-parameter factory shape
+        param_jitters: dict[str, set[str]] = {}  # qname → param names it jits
+        for caller_q, edges in graph.edges.items():
+            caller = graph.functions.get(caller_q)
+            relpath = caller_q.split("::", 1)[0]
+            for e in edges:
+                terminal = e.attr
+                if terminal == "map" and e.receiver not in _LAX_MAP_RECEIVERS:
+                    continue
+                if terminal == "map" or terminal in _TRANSFORM_TERMINALS:
+                    is_jit = e.raw in _JIT_DOTTED or terminal == "pjit"
+                    is_kernel = terminal == "pallas_call"
+                    arg_exprs = list(e.node.args) + [
+                        kw.value for kw in e.node.keywords
+                    ]
+                    if is_kernel:
+                        arg_exprs = arg_exprs[:1]  # only the kernel argument
+                    for i, arg in enumerate(arg_exprs):
+                        target, partial_call = _unwrap_partial(arg)
+                        ref = dotted_name(target)
+                        if ref is None:
+                            continue
+                        if is_jit and isinstance(target, ast.Name) and caller \
+                                is not None:
+                            # jax.jit(step_fn, ...): the jitted thing is a
+                            # parameter of the caller OR of a lexically
+                            # enclosing function (the jit often lives in a
+                            # nested closure) — bindings at that function's
+                            # call sites become entries
+                            chain = caller.name.split(".")
+                            owner = None
+                            for depth in range(len(chain), 0, -1):
+                                fq = f"{relpath}::{'.'.join(chain[:depth])}"
+                                fi = graph.functions.get(fq)
+                                if fi is not None and target.id in fi.params:
+                                    owner = fq
+                                    break
+                            if owner is not None:
+                                param_jitters.setdefault(owner, set()).add(
+                                    target.id
+                                )
+                                continue
+                        q = graph.resolve_reference(relpath, caller, ref)
+                        if q is None:
+                            continue
+                        if is_kernel:
+                            idx.pallas_kernels.add(q)
+                            roots.append((q, "pallas kernel"))
+                        elif is_jit and i == 0:
+                            names, nums = _static_info(e.node)
+                            idx.jit_entries.setdefault(
+                                q, (names, nums, e.node.lineno)
+                            )
+                            roots.append((q, "jax.jit(...) target"))
+                        elif not is_jit:
+                            roots.append((q, f"{e.raw} callback"))
+
+        # 3. propagate through the jit-a-parameter factories
+        if param_jitters:
+            for caller_q, edges in graph.edges.items():
+                caller = graph.functions.get(caller_q)
+                relpath = caller_q.split("::", 1)[0]
+                for e in edges:
+                    jitted_params = param_jitters.get(e.callee or "")
+                    if not jitted_params:
+                        continue
+                    callee = graph.functions[e.callee]
+                    params = callee.params
+                    offset = 1 if callee.is_method and params and \
+                        params[0] in ("self", "cls") else 0
+                    bound: list[tuple[str, ast.expr]] = []
+                    for i, a in enumerate(e.node.args):
+                        j = i + offset
+                        if j < len(params):
+                            bound.append((params[j], a))
+                    bound += [
+                        (kw.arg, kw.value) for kw in e.node.keywords if kw.arg
+                    ]
+                    for pname, a in bound:
+                        if pname not in jitted_params:
+                            continue
+                        target, _ = _unwrap_partial(a)
+                        ref = dotted_name(target)
+                        q = graph.resolve_reference(relpath, caller, ref) \
+                            if ref else None
+                        if q is not None:
+                            idx.jit_entries.setdefault(q, (
+                                frozenset(), frozenset(), e.node.lineno
+                            ))
+                            roots.append(
+                                (q, f"jitted via {e.callee.rsplit('::', 1)[-1]}")
+                            )
+
+        # 4. traced closure: resolved callees + function references
+        frontier = []
+        for q, reason in roots:
+            if q in graph.functions and q not in idx.traced:
+                idx.traced[q] = reason
+                frontier.append(q)
+        while frontier:
+            nxt: list[str] = []
+            for q in frontier:
+                fn = graph.functions[q]
+                relpath = q.split("::", 1)[0]
+                for e in graph.callees(q):
+                    if e.callee is not None and e.callee not in idx.traced:
+                        idx.traced[e.callee] = \
+                            f"called from {q.rsplit('::', 1)[-1]}"
+                        nxt.append(e.callee)
+                # names referenced outside callback wrappers resolve too:
+                # lax.scan / attention_fn defaults / closures passed around
+                skip: set[int] = set()
+                for node in walk_stopping_at_functions(fn.node.body):
+                    if isinstance(node, ast.Call) and (
+                        (
+                            isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _CALLBACK_TERMINALS
+                        )
+                        or (
+                            isinstance(node.func, ast.Name)
+                            and node.func.id in _CALLBACK_TERMINALS
+                        )
+                    ):
+                        for a in node.args:
+                            skip.update(id(n) for n in ast.walk(a))
+                for node in walk_stopping_at_functions(fn.node.body):
+                    if id(node) in skip:
+                        continue
+                    ref = None
+                    if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load
+                    ):
+                        ref = node.id
+                    elif isinstance(node, ast.Attribute):
+                        ref = dotted_name(node)
+                    if ref is None:
+                        continue
+                    rq = graph.resolve_reference(relpath, fn, ref)
+                    if rq is not None and rq not in idx.traced:
+                        idx.traced[rq] = f"referenced from {q.rsplit('::', 1)[-1]}"
+                        nxt.append(rq)
+            frontier = nxt
+        return idx
+
+
+def device_index(project: Project) -> DeviceIndex:
+    """The per-project device index, built once and shared by the pack
+    (same contract as ``Project.callgraph()``)."""
+    idx = getattr(project, "_device_index", None)
+    if idx is None:
+        idx = DeviceIndex.build(project)
+        project._device_index = idx
+    return idx
+
+
+def _finding_fn_label(qname: str) -> str:
+    return qname.rsplit("::", 1)[-1]
+
+
+# -------------------------------------------------------- trace-impure-call
+
+_IMPURE_CALLS = {
+    "time.time": "wall clock is baked in as a constant at trace time",
+    "time.monotonic": "wall clock is baked in as a constant at trace time",
+    "time.perf_counter": "wall clock is baked in as a constant at trace time",
+    "time.time_ns": "wall clock is baked in as a constant at trace time",
+    "time.process_time": "wall clock is baked in as a constant at trace time",
+    "datetime.now": "wall clock is baked in as a constant at trace time",
+    "datetime.datetime.now": "wall clock is baked in as a constant at trace time",
+    "datetime.utcnow": "wall clock is baked in as a constant at trace time",
+    "os.urandom": "host entropy is drawn once at trace time",
+    "uuid.uuid4": "host entropy is drawn once at trace time",
+    "input": "host I/O runs at trace time only",
+    "print": "runs at trace time only — use jax.debug.print for traced values",
+    "open": "host I/O runs at trace time only",
+}
+
+# np/global RNG draws freeze one sample into the compiled graph; jax.random
+# with explicit keys is the traced-code RNG
+_NP_RANDOM_EXEMPT = {"default_rng", "Generator", "RandomState", "SeedSequence"}
+_PY_RANDOM_EXEMPT = {"Random", "SystemRandom"}
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "update", "setdefault", "pop", "popitem",
+    "clear", "add", "remove", "discard", "write", "writelines",
+}
+
+
+def _locally_bound_names(fn_node) -> set[str]:
+    """Params + every name the function itself binds (assignments, loop
+    targets, withitems, walrus) — mutation of these is trace-local and
+    legal; mutation of anything else escapes the trace."""
+    a = fn_node.args
+    bound = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    for node in walk_stopping_at_functions(fn_node.body):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            bound.difference_update(node.names)  # explicitly NOT local
+    return bound
+
+
+class TraceImpureCallRule(Rule):
+    id = "trace-impure-call"
+    title = "Python side effect reachable inside jit/pallas-traced code"
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        graph = project.callgraph()
+        idx = device_index(project)
+        for q, reason in sorted(idx.traced.items()):
+            fn = graph.functions[q]
+            label = _finding_fn_label(q)
+            bound = None
+            for node in walk_stopping_at_functions(fn.node.body):
+                # mutation of a captured container: the list/dict outlives
+                # the trace, so the mutation replays never.  Only calls
+                # whose result is DISCARDED count — `d.update(e)` mutates,
+                # `x, y = tx.update(...)` is a pure method that happens to
+                # share the name
+                if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                    mut = node.value
+                    if (
+                        isinstance(mut.func, ast.Attribute)
+                        and mut.func.attr in _MUTATING_METHODS
+                        and isinstance(mut.func.value, ast.Name)
+                    ):
+                        if bound is None:
+                            bound = _locally_bound_names(fn.node)
+                        recv = mut.func.value.id
+                        if recv not in bound:
+                            yield Finding(
+                                self.id, fn.relpath, mut.lineno,
+                                f"{recv}.{mut.func.attr}(...) inside {label} "
+                                f"({reason}) mutates a captured container — "
+                                "the side effect happens once at trace time "
+                                "and never again on replay",
+                            )
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                why = _IMPURE_CALLS.get(name or "")
+                if why is None and name is not None:
+                    terminal = name.rsplit(".", 1)[-1]
+                    if (
+                        name.startswith("random.")
+                        and terminal not in _PY_RANDOM_EXEMPT
+                    ):
+                        why = (
+                            "the global Python RNG draws once at trace time; "
+                            "thread a jax.random key instead"
+                        )
+                    elif (
+                        name.startswith(("np.random.", "numpy.random."))
+                        and terminal not in _NP_RANDOM_EXEMPT
+                    ):
+                        why = (
+                            "the numpy RNG draws once at trace time; "
+                            "thread a jax.random key instead"
+                        )
+                if why is not None:
+                    yield Finding(
+                        self.id, fn.relpath, node.lineno,
+                        f"{name}(...) inside {label} ({reason}) — {why}",
+                    )
+
+
+# --------------------------------------------------------- trace-host-sync
+
+# runtime pipeline stages on the loader hot path: a device sync here stalls
+# the decode/prefetch pipeline behind the accelerator
+_LOADER_HOT_PATH = (
+    "data/jax_iter.py",
+    "runtime/pipeline.py",
+    "io/reader.py",
+    "io/streaming_merge.py",
+)
+
+_HOST_SYNC_RECEIVER_SINKS = frozenset(
+    {"item", "tolist", "block_until_ready", "__array__"}
+)
+
+
+def _host_sync_config():
+    from lakesoul_tpu.analysis.dataflow import TaintConfig
+
+    return TaintConfig(
+        source_self_attrs=frozenset(),
+        sanitizers=frozenset({"len"}),
+        sanitizer_prefixes=(),
+        sink_functions={"float": 0, "int": 0, "bool": 0},
+        sink_calls={
+            "np.asarray": 0, "numpy.asarray": 0,
+            "np.array": 0, "numpy.array": 0,
+        },
+        receiver_sinks=_HOST_SYNC_RECEIVER_SINKS,
+        attr_sanitizers=frozenset({"shape", "dtype", "ndim", "size", "sharding"}),
+        propagate_all_calls=True,
+    )
+
+
+class TraceHostSyncRule(Rule):
+    id = "trace-host-sync"
+    title = "host sync / device→host transfer inside traced code or a loader stage"
+
+    def __init__(self, hot_path: tuple[str, ...] = _LOADER_HOT_PATH):
+        self.hot_path = hot_path
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not any(module.relpath.endswith(m) for m in self.hot_path):
+            return
+        for node in module.walk():
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                yield Finding(
+                    self.id, module.relpath, node.lineno,
+                    "block_until_ready() on the loader hot path stalls the "
+                    "host pipeline behind the device — double-buffered "
+                    "device_put already overlaps the transfer",
+                )
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        from lakesoul_tpu.analysis.dataflow import TaintAnalysis
+
+        graph = project.callgraph()
+        idx = device_index(project)
+        analysis = TaintAnalysis(graph, _host_sync_config())
+        seen: set[tuple] = set()
+        for q in sorted(set(idx.jit_entries) | idx.pallas_kernels):
+            fn = graph.functions.get(q)
+            if fn is None:
+                continue
+            static_names, static_nums, _ = idx.jit_entries.get(
+                q, (frozenset(), frozenset(), 0)
+            )
+            static = set(static_names) | {
+                fn.params[i] for i in static_nums if i < len(fn.params)
+            }
+            tainted = frozenset(
+                p for p in fn.params
+                if p not in static and p not in ("self", "cls")
+            )
+            for hit in analysis.analyze_entry(q, tainted):
+                key = (hit.relpath, hit.line, hit.sink)
+                if key in seen:
+                    continue
+                seen.add(key)
+                rendered = (
+                    f"{hit.sink}()"
+                    if hit.sink.rsplit(".", 1)[-1] in _HOST_SYNC_RECEIVER_SINKS
+                    else f"{hit.sink}({hit.source_desc})"
+                )
+                yield Finding(
+                    self.id, hit.relpath, hit.line,
+                    f"{rendered} forces a device→host sync inside traced "
+                    f"code (entry {_finding_fn_label(q)}) — a traced value "
+                    "cannot be concretized; keep the op in jnp or hoist it "
+                    "to the host wrapper",
+                )
+
+
+# --------------------------------------------------------- tpu-dtype-width
+
+_WIDE_DTYPE_ATTRS = {"float64", "int64", "uint64", "complex128"}
+_WIDE_DTYPE_STRINGS = {"float64", "int64", "uint64", "complex128"}
+_DTYPE_RECEIVERS = ("np", "numpy", "jnp", "jax.numpy")
+
+# the device-path modules whose host code feeds jit boundaries
+DEVICE_MODULE_SCOPE = (
+    "vector/kernels.py", "vector/kmeans.py", "vector/rabitq.py",
+    "vector/index.py", "vector/builder.py", "vector/serving.py",
+    "parallel/ring_attention.py", "parallel/ulysses.py",
+    "parallel/pipeline.py", "parallel/moe.py", "parallel/mesh.py",
+    "models/bert.py", "models/mlp.py", "models/resnet.py",
+    "models/train.py", "models/checkpoint.py",
+    "data/jax_iter.py",
+)
+
+
+def _is_wide_dtype_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr in _WIDE_DTYPE_ATTRS:
+        recv = dotted_name(node.value)
+        return recv in _DTYPE_RECEIVERS
+    if isinstance(node, ast.Constant) and node.value in _WIDE_DTYPE_STRINGS:
+        return True
+    return False
+
+
+def _call_has_wide_dtype(call: ast.Call, name: "str | None") -> bool:
+    terminal = (name or "").rsplit(".", 1)[-1]
+    if terminal in _WIDE_DTYPE_ATTRS and (name or "").rsplit(".", 1)[0] in \
+            _DTYPE_RECEIVERS:
+        return True  # np.float64(x) constructor
+    for kw in call.keywords:
+        if kw.arg == "dtype" and _is_wide_dtype_expr(kw.value):
+            return True
+    # positional dtype conventions: astype(t), np.asarray(x, t),
+    # np.zeros/ones/empty/full/arange(..., t)
+    if terminal == "astype" and call.args:
+        return _is_wide_dtype_expr(call.args[0])
+    if terminal in {"asarray", "array", "zeros", "ones", "empty", "arange",
+                    "full"} and len(call.args) >= 2:
+        return _is_wide_dtype_expr(call.args[-1])
+    return False
+
+
+_DEVICE_BOUNDARY_SINKS = {
+    "jax.device_put": 0, "device_put": 0,
+    "jnp.asarray": 0, "jnp.array": 0,
+    "jax.numpy.asarray": 0, "jax.numpy.array": 0,
+}
+
+
+class TpuDtypeWidthRule(Rule):
+    id = "tpu-dtype-width"
+    title = "64-bit dtype flowing into a jit/device boundary (TPU demotes silently)"
+
+    def __init__(self, scope: tuple[str, ...] = DEVICE_MODULE_SCOPE):
+        self.scope = scope
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        from lakesoul_tpu.analysis.dataflow import TaintAnalysis, TaintConfig
+
+        graph = project.callgraph()
+        idx = device_index(project)
+
+        # (a) direct: a 64-bit dtype named inside traced code is always a
+        # demotion (or an x64-flag landmine) on TPU — traced code is device
+        # code wherever it lives, so this half ignores the module scope
+        for q in sorted(idx.traced):
+            fn = graph.functions[q]
+            for node in walk_stopping_at_functions(fn.node.body):
+                if isinstance(node, ast.Attribute) and _is_wide_dtype_expr(node):
+                    yield Finding(
+                        self.id, fn.relpath, node.lineno,
+                        f"{dotted_name(node)} inside traced "
+                        f"{_finding_fn_label(q)} — TPU has no 64-bit lanes; "
+                        "the value silently demotes (or flips on "
+                        "jax_enable_x64); pick the 32-bit dtype explicitly",
+                    )
+
+        # (b) host flow: a 64-bit-typed value built on the host and handed
+        # across a device boundary (device_put / jnp.asarray / a jit entry)
+        entry_names = frozenset(
+            _finding_fn_label(q).rsplit(".", 1)[-1] for q in idx.jit_entries
+        )
+        config = TaintConfig(
+            source_self_attrs=frozenset(),
+            sanitizers=frozenset(),
+            sanitizer_prefixes=(),
+            sink_calls=dict(_DEVICE_BOUNDARY_SINKS),
+            sink_all_args_names=entry_names,
+            attr_sanitizers=frozenset({"shape", "ndim"}),
+            source_call_predicate=_call_has_wide_dtype,
+        )
+        analysis = TaintAnalysis(graph, config)
+        seen: set[tuple] = set()
+        for hit in analysis.run(self.scope):
+            key = (hit.relpath, hit.line, hit.sink)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                self.id, hit.relpath, hit.line,
+                f"64-bit value ({hit.source_desc}) reaches the device "
+                f"boundary at {hit.sink}(...) — TPU silently demotes to "
+                "32 bits; convert with an explicit 32-bit dtype on the host",
+            )
+
+        # (c) promoting literals: a Python int too wide for int32 at a
+        # device boundary overflows after the silent demotion
+        for mod in project.modules:
+            if not any(mod.relpath.endswith(s) for s in self.scope):
+                continue
+            for node in mod.walk():
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name not in _DEVICE_BOUNDARY_SINKS:
+                    continue
+                for arg in node.args[:1]:
+                    if (
+                        isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, int)
+                        and not isinstance(arg.value, bool)
+                        and abs(arg.value) > 2**31 - 1
+                    ):
+                        yield Finding(
+                            self.id, mod.relpath, node.lineno,
+                            f"integer literal {arg.value} at {name}(...) "
+                            "does not fit int32 — TPU demotes 64-bit ints "
+                            "and the value wraps",
+                        )
+
+
+# ----------------------------------------------------- jit-static-arg-shape
+
+def _is_const_int_expr(node: ast.expr) -> bool:
+    """Literal bound: a constant, a signed constant (``-1``), or arithmetic
+    over constants (``2 * K`` is NOT — K is a name) — a fixed slice offset
+    compiles exactly once and must not be called data-dependent."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, int)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        return _is_const_int_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_const_int_expr(node.left) and _is_const_int_expr(node.right)
+    return False
+
+
+_DYNAMIC_SHAPE_CALLS = {
+    "nonzero": "returns a data-dependent number of indices",
+    "flatnonzero": "returns a data-dependent number of indices",
+    "argwhere": "returns a data-dependent number of rows",
+    "unique": "returns a data-dependent number of elements",
+}
+
+
+class JitStaticArgShapeRule(Rule):
+    id = "jit-static-arg-shape"
+    title = "data-dependent shape under jit / static_argnames mismatch"
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        graph = project.callgraph()
+        idx = device_index(project)
+
+        # (a) static_argnames/static_argnums must name real parameters —
+        # a typo silently traces the arg dynamic and retraces per value
+        for q, (names, nums, line) in sorted(idx.jit_entries.items()):
+            fn = graph.functions.get(q)
+            if fn is None:
+                continue
+            params = fn.params
+            for n in sorted(names):
+                if n not in params:
+                    yield Finding(
+                        self.id, fn.relpath, line,
+                        f"static_argnames names {n!r} but "
+                        f"{_finding_fn_label(q)} has no such parameter — "
+                        "the intended static arg traces dynamic and "
+                        "retraces per value",
+                    )
+            n_pos = len(params)
+            for n in sorted(nums):
+                if n >= n_pos:
+                    yield Finding(
+                        self.id, fn.relpath, line,
+                        f"static_argnums includes {n} but "
+                        f"{_finding_fn_label(q)} takes only {n_pos} "
+                        "parameters",
+                    )
+
+        # (b) data-dependent shapes inside traced code
+        for q in sorted(idx.traced):
+            fn = graph.functions[q]
+            for node in walk_stopping_at_functions(fn.node.body):
+                if isinstance(node, ast.Subscript) and isinstance(
+                    node.slice, (ast.Compare, ast.BoolOp)
+                ):
+                    yield Finding(
+                        self.id, fn.relpath, node.lineno,
+                        f"boolean-mask indexing inside traced "
+                        f"{_finding_fn_label(q)} — the result shape depends "
+                        "on the data; use jnp.where(mask, x, fill) or a "
+                        "fixed-size gather",
+                    )
+                elif isinstance(node, ast.Call):
+                    name = dotted_name(node.func) or ""
+                    terminal = name.rsplit(".", 1)[-1]
+                    why = _DYNAMIC_SHAPE_CALLS.get(terminal)
+                    if why is not None and name.startswith(("jnp.", "jax.numpy.")):
+                        if any(kw.arg == "size" for kw in node.keywords):
+                            continue  # size= pins the output shape
+                        yield Finding(
+                            self.id, fn.relpath, node.lineno,
+                            f"{name}(...) inside traced "
+                            f"{_finding_fn_label(q)} {why} — not traceable "
+                            "without size=; pass size= or restructure",
+                        )
+                    elif terminal == "where" and name.startswith(
+                        ("jnp.", "jax.numpy.")
+                    ) and len(node.args) == 1:
+                        yield Finding(
+                            self.id, fn.relpath, node.lineno,
+                            f"single-argument jnp.where inside traced "
+                            f"{_finding_fn_label(q)} returns data-dependent "
+                            "indices — use the 3-argument form",
+                        )
+
+        # (c) data-dependent slice handed straight to a jit entry: every
+        # distinct length is a fresh compilation (the pow2-bucket discipline
+        # exists to prevent exactly this)
+        for caller_q, edges in graph.edges.items():
+            if caller_q in idx.traced:
+                continue  # inside a trace, slices of traced values differ
+            caller_rel = caller_q.split("::", 1)[0]
+            for e in edges:
+                if e.callee not in idx.jit_entries:
+                    continue
+                for arg in list(e.node.args) + [
+                    kw.value for kw in e.node.keywords
+                ]:
+                    if not (
+                        isinstance(arg, ast.Subscript)
+                        and isinstance(arg.slice, ast.Slice)
+                    ):
+                        continue
+                    bounds = (arg.slice.lower, arg.slice.upper)
+                    if any(
+                        b is not None and not _is_const_int_expr(b)
+                        for b in bounds
+                    ):
+                        yield Finding(
+                            self.id, caller_rel, e.line,
+                            f"data-dependent slice passed to jit entry "
+                            f"{e.raw}(...) — every distinct length compiles "
+                            "fresh; pad to a bucketed size before the call",
+                        )
+
+
+# --------------------------------------------------------- pallas-blockspec
+
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024  # per-core VMEM; blocks must fit
+
+# pallas primitives that write their first ref argument — as much "the
+# kernel writes this ref" as a subscript store is
+_REF_STORE_CALLS = {
+    "store", "swap", "atomic_add", "atomic_max", "atomic_min", "atomic_and",
+    "atomic_or", "atomic_xor", "atomic_xchg", "atomic_cas",
+}
+
+
+def _literal_tuple(node: "ast.expr | None") -> "list | None":
+    """Tuple/List of int constants → python list; else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class _BlockSpecInfo:
+    def __init__(self, call: ast.Call):
+        self.call = call
+        self.shape_node = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "block_shape":
+                self.shape_node = kw.value
+        self.index_map = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Lambda):
+            self.index_map = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "index_map" and isinstance(kw.value, ast.Lambda):
+                self.index_map = kw.value
+
+    @property
+    def shape_rank(self) -> "int | None":
+        if isinstance(self.shape_node, (ast.Tuple, ast.List)):
+            return len(self.shape_node.elts)
+        return None
+
+    @property
+    def literal_shape(self) -> "list | None":
+        return _literal_tuple(self.shape_node)
+
+
+def _iter_specs(node: "ast.expr | None") -> Iterator[ast.Call]:
+    if node is None:
+        return
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _iter_specs(e)
+    elif isinstance(node, ast.Call) and isinstance(node.func, (ast.Attribute, ast.Name)):
+        terminal = dotted_name(node.func) or ""
+        if terminal.rsplit(".", 1)[-1] == "BlockSpec":
+            yield node
+
+
+class PallasBlockSpecRule(Rule):
+    id = "pallas-blockspec"
+    title = "pallas_call BlockSpec/grid/kernel-signature inconsistency"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        # module-level function defs, for kernel signature resolution
+        top_defs = {
+            s.name: s for s in module.tree.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in module.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name.rsplit(".", 1)[-1] != "pallas_call":
+                continue
+            yield from self._check_call(module, node, top_defs)
+
+    def _check_call(self, module: Module, call: ast.Call, top_defs):
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        if "grid_spec" in kwargs:
+            return  # PrefetchScalarGridSpec etc. — different contract
+        grid = kwargs.get("grid")
+        grid_rank = None
+        if isinstance(grid, (ast.Tuple, ast.List)):
+            grid_rank = len(grid.elts)
+        elif grid is not None and (
+            isinstance(grid, ast.Constant) or _is_const_int_expr(grid)
+            or isinstance(grid, ast.BinOp)
+        ):
+            grid_rank = 1  # a scalar expression is rank 1 by construction
+        # anything else (a name holding a tuple, a call) stays unknown:
+        # literal-first, never guessed
+
+        # grid element `A // B` with literal remainder drops rows silently
+        if isinstance(grid, (ast.Tuple, ast.List)):
+            for e in grid.elts:
+                if (
+                    isinstance(e, ast.BinOp)
+                    and isinstance(e.op, ast.FloorDiv)
+                    and isinstance(e.left, ast.Constant)
+                    and isinstance(e.right, ast.Constant)
+                    and isinstance(e.left.value, int)
+                    and isinstance(e.right.value, int)
+                    and e.right.value != 0
+                    and e.left.value % e.right.value != 0
+                ):
+                    yield Finding(
+                        self.id, module.relpath, e.lineno,
+                        f"grid dimension {e.left.value} // {e.right.value} "
+                        f"drops {e.left.value % e.right.value} trailing "
+                        "rows — pad the operand or use a ceil-div grid",
+                    )
+
+        in_specs = list(_iter_specs(kwargs.get("in_specs")))
+        out_specs_node = kwargs.get("out_specs")
+        out_specs = list(_iter_specs(out_specs_node))
+        # out_shape is pallas_call's second positional parameter, so accept
+        # both spellings; only a literal shape (a ShapeDtypeStruct call or a
+        # tuple of them) pins the output count — a name holding one stays
+        # unknown and skips the arity checks
+        out_shape = kwargs.get("out_shape")
+        if out_shape is None and len(call.args) >= 2:
+            out_shape = call.args[1]
+        n_out = None
+        if isinstance(out_shape, (ast.Tuple, ast.List)):
+            n_out = len(out_shape.elts)
+        elif isinstance(out_shape, ast.Call):
+            n_out = 1
+
+        for spec_call in in_specs + out_specs:
+            info = _BlockSpecInfo(spec_call)
+            if info.index_map is not None and grid_rank is not None:
+                arity = len(info.index_map.args.args)
+                if arity != grid_rank:
+                    yield Finding(
+                        self.id, module.relpath, spec_call.lineno,
+                        f"BlockSpec index_map takes {arity} argument(s) but "
+                        f"the grid has rank {grid_rank} — one index per "
+                        "grid dimension",
+                    )
+            if info.index_map is not None and info.shape_rank is not None:
+                body = info.index_map.body
+                ret_rank = len(body.elts) if isinstance(body, ast.Tuple) else 1
+                if ret_rank != info.shape_rank:
+                    yield Finding(
+                        self.id, module.relpath, spec_call.lineno,
+                        f"BlockSpec index_map returns {ret_rank} block "
+                        f"coordinate(s) for a rank-{info.shape_rank} block "
+                        "shape — one coordinate per block dimension",
+                    )
+            shape = info.literal_shape
+            if shape:
+                size = 4  # dtype unknown statically; assume 4-byte lanes
+                for d in shape:
+                    size *= max(d, 1)
+                if size > _VMEM_BUDGET_BYTES:
+                    yield Finding(
+                        self.id, module.relpath, spec_call.lineno,
+                        f"BlockSpec block {tuple(shape)} needs ~{size // (1 << 20)}"
+                        " MiB of VMEM (≈16 MiB per core available) — tile "
+                        "smaller",
+                    )
+
+        # kernel signature vs specs, and out-ref writes
+        kernel_expr = call.args[0] if call.args else None
+        target, partial_call = _unwrap_partial(kernel_expr) if kernel_expr \
+            is not None else (None, None)
+        kname = target.id if isinstance(target, ast.Name) else None
+        kernel = top_defs.get(kname) if kname else None
+        if (
+            kernel is None
+            or kernel.args.vararg is not None
+            or not in_specs
+            or n_out is None
+        ):
+            return
+        n_pos = len(kernel.args.posonlyargs) + len(kernel.args.args)
+        bound_pos = len(partial_call.args) - 1 if partial_call is not None else 0
+        n_scratch = 0
+        scratch = kwargs.get("scratch_shapes")
+        if isinstance(scratch, (ast.Tuple, ast.List)):
+            n_scratch = len(scratch.elts)
+        elif scratch is not None:
+            return  # scratch count unknowable: skip the arity check
+        expected = len(in_specs) + n_out + n_scratch
+        got = n_pos - bound_pos
+        if got != expected:
+            yield Finding(
+                self.id, module.relpath, call.lineno,
+                f"kernel {kname} takes {got} ref argument(s) but pallas_call "
+                f"passes {len(in_specs)} in_spec(s) + {n_out} output(s)"
+                + (f" + {n_scratch} scratch" if n_scratch else "")
+                + f" = {expected} — refs and specs must line up 1:1",
+            )
+            return
+        # the output refs sit between the inputs and the scratch refs
+        # (pallas ref order: in, out, scratch): a kernel that never stores
+        # into one returns garbage for that block
+        all_params = [
+            p.arg for p in (kernel.args.posonlyargs + kernel.args.args)
+        ]
+        out_params = all_params[n_pos - n_out - n_scratch: n_pos - n_scratch]
+        stored: set[str] = set()
+        for node in walk_stopping_at_functions(kernel.body):
+            tgt_list = []
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgt_list = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+            for t in tgt_list:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Subscript) and isinstance(
+                        sub.value, ast.Name
+                    ):
+                        stored.add(sub.value.id)
+            # the store/atomic primitives write their first ref argument
+            if (
+                isinstance(node, ast.Call)
+                and (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                in _REF_STORE_CALLS
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                stored.add(node.args[0].id)
+        for p in out_params:
+            if p not in stored:
+                yield Finding(
+                    self.id, module.relpath, kernel.lineno,
+                    f"kernel {kname} never writes output ref {p!r} — the "
+                    "output block is returned uninitialized",
+                )

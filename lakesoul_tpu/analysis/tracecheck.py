@@ -1,0 +1,328 @@
+"""Runtime retrace detector (opt-in: ``LAKESOUL_TRACECHECK=1``).
+
+The static device rules (``trace-*``, ``jit-static-arg-shape``) catch the
+*lexical* causes of recompilation; this is their runtime half, in the
+:mod:`~lakesoul_tpu.analysis.lockgraph` mold: instrument the jit entry
+points themselves and count how many distinct abstract signatures — and
+therefore XLA compilations — each function accumulates.  A loader that
+feeds un-rebatched tails, a search path that forgets its pow2 bucketing, or
+a host wrapper that bakes a data-dependent length into a static arg shows
+up here as a per-function signature explosion long before it shows up as a
+benchmark regression (compile time is the dominant silent-throughput
+killer: a single BERT-step retrace costs more than an epoch of steps).
+
+Mechanics:
+
+- :func:`enable` patches ``jax.jit`` so every jit wrapper built *after*
+  enabling returns a counting proxy, and retro-instruments the
+  already-imported hot modules (``vector/kernels``, ``vector/kmeans``,
+  ``vector/rabitq``) whose jitted functions were created at import time.
+- Each top-level call computes the **abstract signature** — per-leaf
+  ``(shape, dtype)`` for array arguments, ``repr`` for static ones — and
+  records it per function.  Calls made *during another trace* (args are
+  tracers; jit-of-jit is inlined, no separate top-level compilation) are
+  not counted.
+- A function whose distinct-signature count exceeds its **budget**
+  (:data:`DEFAULT_BUDGET`, overridable per function via
+  :func:`set_budget`) records a :class:`Violation` carrying the full
+  signature history, so the failure message shows exactly which
+  shapes/dtypes thrashed the cache.
+
+Violations are *recorded*, not raised — instrumentation must never change
+program behavior; the conftest fixture fails the test at teardown, exactly
+like the lockgraph detector.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+__all__ = [
+    "DEFAULT_BUDGET",
+    "Violation",
+    "enable",
+    "disable",
+    "enabled",
+    "env_requested",
+    "reset",
+    "set_budget",
+    "signature_counts",
+    "violations",
+    "watch",
+]
+
+_ENV = "LAKESOUL_TRACECHECK"
+
+DEFAULT_BUDGET = 8
+
+# module-level jitted functions created at import time: patching jax.jit
+# after the fact cannot see them, so enable() rewraps them in place
+_HOT_MODULES = (
+    "lakesoul_tpu.vector.kernels",
+    "lakesoul_tpu.vector.kmeans",
+    "lakesoul_tpu.vector.rabitq",
+)
+
+
+@dataclass
+class Violation:
+    kind: str  # "retrace-budget"
+    function: str
+    count: int
+    budget: int
+    signatures: tuple[str, ...] = field(default_factory=tuple)
+
+    def render(self) -> str:
+        out = [
+            f"[{self.kind}] {self.function} compiled {self.count} distinct "
+            f"signatures (budget {self.budget}) — every new abstract "
+            "signature is a fresh XLA compilation; bucket/pad the thrashing "
+            "dimension or mark it static on purpose"
+        ]
+        for s in self.signatures:
+            out.append(f"  {s}")
+        return "\n".join(out)
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = False
+        # function label → ordered list of distinct signature strings
+        self.signatures: dict[str, list[str]] = {}
+        self.budgets: dict[str, int] = {}
+        self.violations: list[Violation] = []
+        self.reported: set[str] = set()
+        # instrumented module attributes to restore on disable:
+        # (module, attr name, original object)
+        self.patched_attrs: list[tuple] = []
+        self.real_jit = None
+
+
+_STATE = _State()
+
+
+def _is_tracer(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def _leaf_sig(x) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(map(str, shape))}]"
+    return repr(x)
+
+
+def _abstract_signature(args, kwargs) -> str:
+    """Per-leaf (shape, dtype) over the call's pytree — the cache key a jit
+    wrapper derives, minus donation/layout detail.  Static (non-array)
+    leaves contribute their repr: a changed static arg IS a retrace."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    return "(" + ", ".join(_leaf_sig(x) for x in leaves) + ")"
+
+
+def _record(label: str, sig: str) -> None:
+    with _STATE.lock:
+        if not _STATE.enabled:
+            return
+        seen = _STATE.signatures.setdefault(label, [])
+        if sig in seen:
+            return
+        seen.append(sig)
+        budget = _STATE.budgets.get(label, DEFAULT_BUDGET)
+        if len(seen) > budget and label not in _STATE.reported:
+            _STATE.reported.add(label)
+            _STATE.violations.append(
+                Violation(
+                    "retrace-budget", label, len(seen), budget, tuple(seen)
+                )
+            )
+        elif len(seen) > budget:
+            # keep the violation's history current past the first overrun
+            for v in _STATE.violations:
+                if v.function == label:
+                    v.count = len(seen)
+                    v.signatures = tuple(seen)
+
+
+class _TraceCheckedFn:
+    """Counting proxy around one jit wrapper.  ``__getattr__`` falls through
+    so AOT surfaces (``lower``, ``eval_shape``, ``clear_cache``) keep
+    working on the instrumented object."""
+
+    def __init__(self, inner, label: str):
+        self._inner = inner
+        self._label = label
+
+    def __call__(self, *args, **kwargs):
+        # cheap fast path first: proxies built while armed outlive
+        # disable() (closures, module globals outside the hot modules), so
+        # the per-call flatten + signature build must not be paid forever
+        # after recording stops
+        if _STATE.enabled:
+            # tracer args ⇒ this call happens inside an enclosing trace and
+            # is inlined there — no top-level compilation of its own
+            import jax
+
+            if not any(
+                _is_tracer(x) for x in jax.tree_util.tree_leaves((args, kwargs))
+            ):
+                _record(self._label, _abstract_signature(args, kwargs))
+        return self._inner(*args, **kwargs)
+
+    def __getattr__(self, item):
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(item)
+        return getattr(inner, item)
+
+    def __repr__(self):
+        return f"<tracechecked {self._label}>"
+
+
+def _label_for(fun) -> str:
+    mod = getattr(fun, "__module__", None) or "<unknown>"
+    name = getattr(fun, "__qualname__", None) or getattr(
+        fun, "__name__", repr(fun)
+    )
+    return f"{mod}.{name}"
+
+
+def _checked_jit(real_jit):
+    def jit(fun=None, **kwargs):
+        if fun is None:
+            # decorator-with-kwargs form: jax.jit(static_argnames=...)(f)
+            return lambda f: jit(f, **kwargs)
+        wrapped = real_jit(fun, **kwargs)
+        # functools.partial(f, ...) carries no name; label via its target
+        target = getattr(fun, "func", fun)
+        return _TraceCheckedFn(wrapped, _label_for(target))
+
+    jit._tracecheck_orig = real_jit
+    return jit
+
+
+def _looks_jitted(obj) -> bool:
+    # duck-typing over jaxlib's PjitFunction: the compiled-call surface is
+    # stable across versions even when the class name is not
+    return (
+        callable(obj)
+        and not isinstance(obj, type)
+        and hasattr(obj, "lower")
+        and (hasattr(obj, "clear_cache") or hasattr(obj, "_cache_size"))
+    )
+
+
+def _instrument_hot_modules() -> None:
+    import sys
+
+    for modname in _HOT_MODULES:
+        mod = sys.modules.get(modname)
+        if mod is None:
+            continue  # not imported: the jax.jit patch will catch it
+        for attr, obj in list(vars(mod).items()):
+            if isinstance(obj, _TraceCheckedFn) or not _looks_jitted(obj):
+                continue
+            label = f"{modname}.{attr}"
+            setattr(mod, attr, _TraceCheckedFn(obj, label))
+            _STATE.patched_attrs.append((mod, attr, obj))
+
+
+# ------------------------------------------------------------------ control
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def env_requested() -> bool:
+    return os.environ.get(_ENV, "").strip() == "1"
+
+
+def set_budget(function_label: str, budget: int) -> None:
+    """Declare a per-function compilation budget (label as rendered in
+    violations: ``module.qualname``).  Applies to future recordings."""
+    with _STATE.lock:
+        _STATE.budgets[function_label] = budget
+
+
+def signature_counts() -> dict[str, int]:
+    with _STATE.lock:
+        return {k: len(v) for k, v in _STATE.signatures.items()}
+
+
+def violations() -> list[Violation]:
+    with _STATE.lock:
+        return list(_STATE.violations)
+
+
+def reset() -> None:
+    """Drop recorded signatures/violations (instrumentation stays)."""
+    with _STATE.lock:
+        _STATE.signatures.clear()
+        _STATE.violations.clear()
+        _STATE.reported.clear()
+
+
+def enable() -> None:
+    """Patch ``jax.jit`` + retro-instrument hot modules.  Idempotent."""
+    if _STATE.enabled:
+        return
+    import jax
+
+    if not hasattr(jax.jit, "_tracecheck_orig"):
+        _STATE.real_jit = jax.jit
+        jax.jit = _checked_jit(jax.jit)
+    _instrument_hot_modules()
+    _STATE.enabled = True
+
+
+def disable() -> None:
+    """Restore ``jax.jit`` and the instrumented module attributes.  Proxies
+    already handed out keep delegating; recording stops."""
+    if not _STATE.enabled:
+        return
+    import jax
+
+    orig = getattr(jax.jit, "_tracecheck_orig", None)
+    if orig is not None:
+        jax.jit = orig
+    _STATE.real_jit = None
+    for mod, attr, obj in _STATE.patched_attrs:
+        setattr(mod, attr, obj)
+    _STATE.patched_attrs.clear()
+    _STATE.enabled = False
+
+
+class Watch:
+    """Handle yielded by :func:`watch`: violations recorded since entry."""
+
+    def __init__(self, mark: int):
+        self._mark = mark
+
+    @property
+    def violations(self) -> list[Violation]:
+        return violations()[self._mark :]
+
+
+class watch:
+    """``with watch() as w:`` — enable for the block, inspect
+    ``w.violations`` after (state is NOT reset on exit so nested watches
+    compose; call :func:`reset` between independent scenarios)."""
+
+    def __enter__(self) -> Watch:
+        self._was_enabled = _STATE.enabled
+        enable()
+        return Watch(len(violations()))
+
+    def __exit__(self, *exc):
+        if not self._was_enabled:
+            disable()
+        return False

@@ -170,18 +170,58 @@ class LoaderCheckpoint:
         return cls(d["rows_delivered"], d.get("plan_digest"))
 
 
+def _is_stringlike(t: pa.DataType) -> bool:
+    """String/binary columns (incl. dictionary-encoded ones, which Parquet
+    readers commonly produce) keep the documented stay-as-object contract."""
+    if pa.types.is_dictionary(t):
+        return _is_stringlike(t.value_type)
+    return (
+        pa.types.is_string(t)
+        or pa.types.is_large_string(t)
+        or pa.types.is_binary(t)
+        or pa.types.is_large_binary(t)
+    )
+
+
 def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
     """Arrow → dict of numpy arrays (zero-copy where possible).  Fixed-width
-    columns map directly; strings stay as object arrays (caller should
-    tokenize/encode upstream for TPU consumption)."""
+    columns map directly; ``fixed_size_list`` tensor columns (token rows,
+    image pixels) collate to real 2-D fixed-width arrays; strings stay as
+    object arrays (caller should tokenize/encode upstream for TPU
+    consumption).  Anything that only lowers to dtype=object (variable
+    lists, structs, maps) fails LOUDLY: the old object-array fallback
+    survived until ``jax.device_put`` rejected the batch deep inside the
+    pipeline, with no hint of which column was responsible."""
+    from lakesoul_tpu.errors import ConfigError
+
     out: dict[str, np.ndarray] = {}
     table = pa.table(batch) if isinstance(batch, pa.RecordBatch) else batch
     for name in table.column_names:
         col = table.column(name)
+        if pa.types.is_fixed_size_list(col.type):
+            arr = col.combine_chunks()
+            width = col.type.list_size
+            flat = arr.flatten().to_numpy(zero_copy_only=False)
+            if flat.dtype != object and len(flat) == len(arr) * width:
+                out[name] = flat.reshape(len(arr), width)
+                continue
         try:
-            out[name] = col.to_numpy(zero_copy_only=False)
-        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-            out[name] = np.asarray(col.to_pylist(), dtype=object)
+            arr = col.to_numpy(zero_copy_only=False)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as e:
+            raise ConfigError(
+                f"column {name!r} has Arrow type {col.type} which only "
+                "collates to dtype=object — object arrays cannot be "
+                "device_put; flatten/encode the column upstream or pass a "
+                "collate_fn that handles it"
+            ) from e
+        if arr.dtype == object and not _is_stringlike(col.type):
+            raise ConfigError(
+                f"column {name!r} has Arrow type {col.type} which only "
+                "collates to dtype=object — object arrays cannot be "
+                "device_put; flatten/encode the column upstream or pass a "
+                "collate_fn that handles it"
+            )
+        out[name] = arr
     return out
 
 

@@ -33,6 +33,50 @@ def _specs_to_shardings(mesh, rules):
     )
 
 
+def _place_opt_state(opt_state, mesh):
+    """Put every optimizer leaf on the mesh: zeros_like moments inherit their
+    param's NamedSharding from ``tx.init``, but fresh scalars (adam's
+    ``count``) land committed to a single device — mixing the two in one
+    jitted step is rejected outright."""
+    return jax.tree.map(
+        lambda x: x
+        if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding)
+        else jax.device_put(x, NamedSharding(mesh, P())),
+        opt_state,
+    )
+
+
+def _jit_step_pinning_opt_shardings(step_fn, param_shardings, batch_shardings,
+                                    loss_sharding):
+    """jit a (params, opt_state, *batch) step with both donated carries pinned.
+
+    opt_state is donated, and donation requires the output buffer to alias the
+    input one exactly — but its leaves' shardings only exist on the concrete
+    arrays ``tx.init`` built, not in any spec the factory could precompute.
+    Leaving the output unspecified lets GSPMD re-shard a replicated leaf (the
+    observed "aliased input/output size" failure), so the shardings are
+    captured from the first call's arrays and pinned identically on input and
+    output."""
+    box: dict = {}
+
+    def call(params, opt_state, *batch):
+        fn = box.get("fn")
+        if fn is None:
+            opt_shardings = jax.tree.map(
+                lambda x: x.sharding if isinstance(x, jax.Array) else None,
+                opt_state,
+            )
+            fn = box["fn"] = jax.jit(
+                step_fn,
+                in_shardings=(param_shardings, opt_shardings) + batch_shardings,
+                out_shardings=(param_shardings, opt_shardings, loss_sharding),
+                donate_argnums=(0, 1),
+            )
+        return fn(params, opt_state, *batch)
+
+    return call
+
+
 def make_bert_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, seed: int = 0):
     """Initialize (params, opt_state) laid out on the mesh."""
     rules = param_sharding_rules(plan, n_experts=cfg.n_experts)
@@ -40,7 +84,8 @@ def make_bert_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, 
     init_fn = jax.jit(functools.partial(init_bert_params, cfg), out_shardings=shardings)
     params = init_fn(jax.random.key(seed))
     tx = optax.adamw(lr)
-    opt_state = tx.init(params)  # mirrors param sharding via GSPMD propagation
+    # moments mirror param sharding via zeros_like; scalars get replicated
+    opt_state = _place_opt_state(tx.init(params), plan.mesh)
     return params, opt_state, tx, shardings
 
 
@@ -80,19 +125,17 @@ def make_bert_train_step(
         ),
     )
 
-    @functools.partial(
-        jax.jit,
-        in_shardings=(param_shardings, None, batch_sharding, batch_sharding, batch_sharding),
-        out_shardings=(param_shardings, None, NamedSharding(plan.mesh, P())),
-        donate_argnums=(0, 1),
-    )
     def train_step(params, opt_state, input_ids, labels, mask):
         loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, mask)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return train_step
+    return _jit_step_pinning_opt_shardings(
+        train_step, param_shardings,
+        (batch_sharding, batch_sharding, batch_sharding),
+        NamedSharding(plan.mesh, P()),
+    )
 
 
 def make_bert_pipeline_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float = 1e-4, seed: int = 0):
@@ -117,7 +160,7 @@ def make_bert_pipeline_train_state(cfg: BertConfig, plan: MeshPlan, *, lr: float
     init_fn = jax.jit(functools.partial(init_bert_params, cfg), out_shardings=shardings)
     params = init_fn(jax.random.key(seed))
     tx = optax.adamw(lr)
-    return params, tx.init(params), tx, shardings
+    return params, _place_opt_state(tx.init(params), plan.mesh), tx, shardings
 
 
 def make_bert_pipeline_train_step(
@@ -161,19 +204,17 @@ def make_bert_pipeline_train_step(
         x = merge_microbatches(out, B)["x"]
         return masked_nll(bert_head(params, x), labels)
 
-    @functools.partial(
-        jax.jit,
-        in_shardings=(param_shardings, None, batch_sharding, batch_sharding, batch_sharding),
-        out_shardings=(param_shardings, None, NamedSharding(plan.mesh, P())),
-        donate_argnums=(0, 1),
-    )
     def train_step(params, opt_state, input_ids, labels, mask):
         loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, mask)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return train_step
+    return _jit_step_pinning_opt_shardings(
+        train_step, param_shardings,
+        (batch_sharding, batch_sharding, batch_sharding),
+        NamedSharding(plan.mesh, P()),
+    )
 
 
 def make_mlp_train_step(tx, mesh=None):

@@ -1,0 +1,65 @@
+"""JAX version compatibility for the parallel layer.
+
+``shard_map`` was promoted to the top-level ``jax`` namespace (and its
+replication-check knob renamed ``check_rep`` → ``check_vma``) in newer JAX
+releases; the toolchain this repo pins still ships it as
+``jax.experimental.shard_map.shard_map``.  One shim lets every wrapper
+(ring attention, Ulysses, the GPipe pipeline) write the modern calling
+convention and degrade transparently on older runtimes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from jax import lax
+
+try:  # modern JAX: top-level API
+    from jax import shard_map as _shard_map
+except ImportError:  # jax<=0.4.x: experimental API
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep → check_vma *after*
+# shard_map reached the top-level namespace, so feature-detect the kwarg
+# instead of keying off the import location
+try:
+    import inspect
+
+    _CHECK_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map).parameters
+        else "check_rep"
+    )
+except (TypeError, ValueError):  # signature unavailable: assume modern
+    _CHECK_KW = "check_vma"
+
+__all__ = ["axis_size", "shard_map"]
+
+
+def _axis_size_fallback(axis_name: str) -> int:
+    # psum of a Python int is evaluated statically at trace time, so this
+    # returns a concrete size usable in Python control flow — same contract
+    # as the modern lax.axis_size
+    return lax.psum(1, axis_name)
+
+
+axis_size = getattr(lax, "axis_size", _axis_size_fallback)
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` calling convention on every supported JAX."""
+    if f is None:
+        return functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

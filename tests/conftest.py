@@ -48,6 +48,43 @@ def tmp_warehouse(tmp_path):
 
 _LOCKCHECK_MODULES = ("test_runtime", "test_metadata")
 
+# -------------------------------------------------------------- tracecheck
+# LAKESOUL_TRACECHECK=1 arms lakelint's runtime retrace detector
+# (lakesoul_tpu/analysis/tracecheck.py) for the suites that drive jit entry
+# points hard: the ANN kernels (test_vector), the sharded model steps
+# (test_models_parallel), and the loader path (test_catalog).  A function
+# that accumulates more distinct abstract signatures than its budget during
+# one test — each one a fresh XLA compilation — fails that test at
+# teardown with the triggering shapes/dtypes.
+
+_TRACECHECK_MODULES = ("test_vector", "test_models_parallel", "test_catalog")
+
+
+@pytest.fixture(autouse=True)
+def _tracecheck(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rpartition(".")[2] not in _TRACECHECK_MODULES:
+        yield
+        return
+    from lakesoul_tpu.analysis import tracecheck
+
+    if not tracecheck.env_requested() or tracecheck.enabled():
+        # not armed, or something else already manages the detector
+        yield
+        return
+    tracecheck.reset()
+    tracecheck.enable()
+    try:
+        yield
+    finally:
+        violations = tracecheck.violations()
+        tracecheck.disable()
+        tracecheck.reset()
+    assert not violations, "tracecheck violations:\n" + "\n\n".join(
+        v.render() for v in violations
+    )
+
 
 @pytest.fixture(autouse=True)
 def _lockcheck(request):

@@ -1,0 +1,5 @@
+"""Seeded-bug fixtures for the JAX/TPU device rule pack
+(lakesoul_tpu/analysis/rules/jaxtpu.py) — one known-bad module per rule,
+each with ``SEED: <rule-id>`` on the exact line the rule must report plus
+clean twins that must stay silent.  Parsed by the analyzer, never
+imported."""

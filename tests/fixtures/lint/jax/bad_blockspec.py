@@ -1,0 +1,94 @@
+"""Seeded pallas-blockspec violations: spec/grid/kernel mismatches that
+fail only at Mosaic-compile time on real TPUs (never on the CPU fallback
+CI runs) — or worse, quietly read the wrong tile."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _forgets_output(x_ref, o_ref):  # SEED: pallas-blockspec (output never written)
+    tmp = x_ref[...] * 2.0
+    del tmp
+
+
+def index_map_arity_mismatch(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        grid=(2, 2),
+        in_specs=[pl.BlockSpec((64, 64), lambda i: (i, 0))],  # SEED: pallas-blockspec (index_map arity)
+        out_specs=pl.BlockSpec((64, 64), lambda i, j: (i, j)),
+    )(x)
+
+
+def index_map_rank_mismatch(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        grid=(2,),
+        in_specs=[pl.BlockSpec((64, 64), lambda i: (i,))],  # SEED: pallas-blockspec (coordinate rank)
+        out_specs=pl.BlockSpec((64, 64), lambda i: (i, 0)),
+    )(x)
+
+
+def kernel_arity_mismatch(a, b):
+    return pl.pallas_call(  # SEED: pallas-blockspec (kernel arity)
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((64, 128), lambda i: (i, 0)),
+            pl.BlockSpec((64, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0)),
+    )(a, b)
+
+
+def unwritten_output(x):
+    return pl.pallas_call(
+        _forgets_output,
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        grid=(2,),
+        in_specs=[pl.BlockSpec((64, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0)),
+    )(x)
+
+
+def vmem_blowout(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((8192, 8192), jnp.float32),
+        grid=(2,),
+        in_specs=[pl.BlockSpec((4096, 8192), lambda i: (i, 0))],  # SEED: pallas-blockspec (VMEM budget)
+        out_specs=pl.BlockSpec((1, 8192), lambda i: (i, 0)),
+    )(x)
+
+
+def dropped_tail(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((1000, 128), jnp.float32),
+        grid=(1000 // 512,),  # SEED: pallas-blockspec (grid drops rows)
+        in_specs=[pl.BlockSpec((512, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((512, 128), lambda i: (i, 0)),
+    )(x)
+
+
+def clean_call(x):
+    # the packed_scan shape: everything lines up
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((1024, 128), jnp.float32),
+        grid=(8,),
+        in_specs=[
+            pl.BlockSpec((128, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )(x)

@@ -1,0 +1,39 @@
+"""Seeded tpu-dtype-width violations: 64-bit values reaching a device
+boundary, where TPU silently demotes to 32 bits."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def accumulate(x):
+    acc = jnp.zeros(4, jnp.float64)  # SEED: tpu-dtype-width (traced f64)
+    idx = x.astype(jnp.int64)  # SEED: tpu-dtype-width (traced i64)
+    return acc + idx.sum()
+
+
+@jax.jit
+def searcher(codes, q):
+    return jnp.dot(codes, q)
+
+
+def stage_rows(rows):
+    wide = np.asarray(rows, np.int64)
+    on_device = jax.device_put(wide)  # SEED: tpu-dtype-width (device_put)
+    return on_device
+
+
+def stage_scores(scores, q):
+    promoted = scores.astype("float64")
+    dists = searcher(promoted, q)  # SEED: tpu-dtype-width (jit boundary)
+    big = jnp.asarray(4000000000)  # SEED: tpu-dtype-width (int32 overflow)
+    return dists, big
+
+
+def clean_stage(rows, q):
+    # explicit 32-bit conversions on the host: the blessed pattern
+    narrow = np.asarray(rows, np.float32)
+    ids = np.asarray(rows, dtype=np.int32)
+    on_device = jax.device_put(narrow)
+    return searcher(on_device, q), ids

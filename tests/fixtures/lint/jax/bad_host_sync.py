@@ -1,0 +1,45 @@
+"""Seeded trace-host-sync violations: concretizing a traced value forces a
+device→host sync (or a ConcretizationTypeError) inside jitted code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _norm_host(v):
+    # helper reached with a traced argument: the sync hides one call deep
+    arr = np.asarray(v)  # SEED: trace-host-sync (np.asarray via helper)
+    return arr / np.linalg.norm(arr)
+
+
+@jax.jit
+def leaky_distance(q, x):
+    scale = float(q)  # SEED: trace-host-sync (float() on traced value)
+    host = x.item()  # SEED: trace-host-sync (.item())
+    listed = x.tolist()  # SEED: trace-host-sync (.tolist())
+    x.block_until_ready()  # SEED: trace-host-sync (.block_until_ready())
+    normed = _norm_host(x)
+    del host, listed, normed
+    return jnp.sum(x * scale)
+
+
+@jax.jit
+def clean_distance(q, x):
+    # static metadata reads and device-side ops never sync
+    d = float(x.shape[0])
+    n = int(x.ndim)
+    y = jnp.asarray(x, jnp.float32)  # jnp stays on device
+    return jnp.sum(y) / d + n
+
+
+def host_collate(rows):
+    # NOT traced: host-side numpy conversion is the loader's job
+    return np.asarray(rows, dtype=np.float32)
+
+
+def hot_stage_sync(batch):
+    """Stands in for a loader pipeline stage (scoped in via the rule's
+    ``hot_path`` parameter in the test)."""
+    out = jax.device_put(batch)
+    out["x"].block_until_ready()  # SEED: trace-host-sync (loader hot path)
+    return out

@@ -1,0 +1,52 @@
+"""Seeded trace-impure-call violations: host side effects inside traced
+code run once at trace time and silently never again."""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SEEN = []
+_CACHE = {}
+
+
+@jax.jit
+def stamped_step(x):
+    started = time.time()  # SEED: trace-impure-call (wall clock)
+    noise = random.random()  # SEED: trace-impure-call (global rng)
+    jitter = np.random.normal()  # SEED: trace-impure-call (numpy rng)
+    print("step", started)  # SEED: trace-impure-call (trace-time print)
+    _SEEN.append(noise)  # SEED: trace-impure-call (captured list)
+    _CACHE.update(last=jitter)  # SEED: trace-impure-call (captured dict)
+    return x * noise + jitter
+
+
+def scan_body(carry, x):
+    with open("/tmp/trace.log", "a") as f:  # SEED: trace-impure-call (host io)
+        f.write(str(x))  # noqa — inside the with, runs at trace time
+    return carry + x, x
+
+
+def run_scan(xs):
+    # scan callbacks are traced even without an enclosing jit
+    return jax.lax.scan(scan_body, jnp.float32(0.0), xs)
+
+
+@jax.jit
+def clean_step(key, x):
+    # jax.random with an explicit key is the traced-code RNG; local
+    # containers are trace-local and legal
+    parts = []
+    parts.append(jax.random.normal(key, x.shape))
+    rng = random.Random(0)  # seeded instance construction: allowed
+    del rng
+    return x + parts[0]
+
+
+def host_wrapper(x):
+    # NOT traced: host timing around the device call is fine
+    started = time.time()
+    out = clean_step(jax.random.key(0), jnp.asarray(x))
+    return out, time.time() - started

@@ -1,0 +1,35 @@
+"""Seeded jit-static-arg-shape violations: data-dependent shapes retrace
+per batch; static_argnames typos silently trace the arg dynamic."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def bucketed(x, tile_size):  # SEED: jit-static-arg-shape (static name typo)
+    return x.reshape(-1, tile_size)
+
+
+@jax.jit
+def filter_positive(x):
+    hits = x[x > 0]  # SEED: jit-static-arg-shape (boolean mask)
+    where_idx = jnp.where(x > 0)  # SEED: jit-static-arg-shape (1-arg where)
+    nz = jnp.nonzero(x)  # SEED: jit-static-arg-shape (nonzero, no size=)
+    uniq = jnp.unique(x)  # SEED: jit-static-arg-shape (unique, no size=)
+    return hits, where_idx, nz, uniq
+
+
+@jax.jit
+def masked_fixed(x):
+    # fixed-shape alternatives: always legal under jit
+    kept = jnp.where(x > 0, x, 0.0)
+    nz = jnp.nonzero(x, size=4)
+    return kept, nz
+
+
+def host_search(x, n):
+    tail = filter_positive(x[:n])  # SEED: jit-static-arg-shape (dynamic slice)
+    head = filter_positive(x[:128])  # constant slice: one compile, fine
+    return tail, head

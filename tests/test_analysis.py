@@ -284,7 +284,8 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 11 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 16 and "rbac-gate-reachability" in rule_ids
+    assert "pallas-blockspec" in rule_ids
     for r in driver["rules"]:
         assert r["shortDescription"]["text"]
     assert len(run_["results"]) == len(findings)
@@ -627,3 +628,343 @@ def test_lockgraph_clean_on_real_data_path(clean_lockgraph, tmp_path):
     finally:
         shutdown_pool()
     assert w.violations == [], "\n".join(v.render() for v in w.violations)
+
+
+# ------------------------------------------------------- device rule pack
+
+
+JAXF = LINT / "jax"
+
+
+def jax_fixture(name: str, rules=None):
+    findings, _ = run([JAXF / name], root=LINT, rules=rules)
+    return findings
+
+
+def test_trace_impure_call_catches_each_side_effect():
+    found = [
+        f for f in jax_fixture("bad_impure.py")
+        if f.rule == "trace-impure-call"
+    ]
+    assert_seed_lines(found, "jax/bad_impure.py", "trace-impure-call")
+    msgs = "\n".join(f.message for f in found)
+    # the scan callback is traced without any enclosing jit
+    assert "scan_body" in msgs
+    assert "captured container" in msgs
+    assert "jax.debug.print" in msgs
+
+
+def test_trace_host_sync_catches_syncs_and_loader_stage():
+    from lakesoul_tpu.analysis.rules.jaxtpu import TraceHostSyncRule
+
+    found = [
+        f for f in jax_fixture(
+            "bad_host_sync.py",
+            rules=[TraceHostSyncRule(hot_path=("bad_host_sync.py",))],
+        )
+        if f.rule == "trace-host-sync"
+    ]
+    assert_seed_lines(found, "jax/bad_host_sync.py", "trace-host-sync")
+    # the helper's sink is found interprocedurally (tainted arg one call deep)
+    assert any("np.asarray(v)" in f.message for f in found)
+
+
+def test_trace_host_sync_clean_half_without_hot_path_scope():
+    """With the default (real) hot-path scope the fixture's traced-code
+    seeds still fire; only the stand-in loader stage needs the scope."""
+    found = [
+        f for f in jax_fixture("bad_host_sync.py")
+        if f.rule == "trace-host-sync"
+    ]
+    assert {f.line for f in found} == {11, 17, 18, 19, 20}
+
+
+def test_tpu_dtype_width_catches_traced_and_host_flows():
+    from lakesoul_tpu.analysis.rules.jaxtpu import TpuDtypeWidthRule
+
+    found = [
+        f for f in jax_fixture(
+            "bad_dtype.py", rules=[TpuDtypeWidthRule(scope=("bad_dtype.py",))]
+        )
+        if f.rule == "tpu-dtype-width"
+    ]
+    assert_seed_lines(found, "jax/bad_dtype.py", "tpu-dtype-width")
+    msgs = "\n".join(f.message for f in found)
+    assert "device_put" in msgs  # host value crossing the boundary
+    assert "searcher" in msgs  # jit entry as the boundary
+    assert "4000000000" in msgs  # promoting literal
+
+
+def test_jit_static_arg_shape_catches_each_shape_hazard():
+    found = [
+        f for f in jax_fixture("bad_static_shape.py")
+        if f.rule == "jit-static-arg-shape"
+    ]
+    assert_seed_lines(found, "jax/bad_static_shape.py", "jit-static-arg-shape")
+    msgs = "\n".join(f.message for f in found)
+    assert "static_argnames" in msgs
+    assert "boolean-mask" in msgs
+    assert "pad to a bucketed size" in msgs
+
+
+def test_pallas_blockspec_catches_each_mismatch():
+    found = [
+        f for f in jax_fixture("bad_blockspec.py")
+        if f.rule == "pallas-blockspec"
+    ]
+    assert_seed_lines(found, "jax/bad_blockspec.py", "pallas-blockspec")
+    msgs = "\n".join(f.message for f in found)
+    assert "grid has rank" in msgs
+    assert "VMEM" in msgs
+    assert "never writes output ref" in msgs
+    assert "drops" in msgs
+
+
+def test_device_pack_fixture_files_trip_only_their_own_rule():
+    """Cross-contamination guard: each device fixture seeds exactly one
+    rule (the clean twins in every file stay silent under the whole
+    catalog, minus the scope-parameterized halves tested above)."""
+    for name, rule in [
+        ("bad_impure.py", "trace-impure-call"),
+        ("bad_static_shape.py", "jit-static-arg-shape"),
+        ("bad_blockspec.py", "pallas-blockspec"),
+    ]:
+        others = [
+            f for f in jax_fixture(name)
+            if f.rule != rule and f.rule != "undocumented-env"
+        ]
+        assert others == [], (name, others)
+
+
+def test_device_index_shapes():
+    """The shared device index must classify the fixture correctly:
+    decorated entries, transform callbacks, pallas kernels."""
+    from lakesoul_tpu.analysis.engine import Module, Project
+    from lakesoul_tpu.analysis.rules.jaxtpu import device_index
+
+    project = Project(root=LINT)
+    for name in ("bad_impure.py", "bad_blockspec.py"):
+        project.modules.append(Module.load(JAXF / name, LINT))
+    idx = device_index(project)
+    entries = {q.rsplit("::", 1)[-1] for q in idx.jit_entries}
+    assert {"stamped_step", "clean_step"} <= entries
+    traced = {q.rsplit("::", 1)[-1] for q in idx.traced}
+    assert "scan_body" in traced  # lax.scan callback
+    assert "host_wrapper" not in traced  # host code stays host
+    kernels = {q.rsplit("::", 1)[-1] for q in idx.pallas_kernels}
+    assert {"_scale_kernel", "_forgets_output"} <= kernels
+
+
+def test_device_rules_in_sarif_and_diff(tmp_path):
+    """The new rules ride the same output contracts: SARIF carries their
+    ids, and --diff BASE keeps only findings on changed lines."""
+    from lakesoul_tpu.analysis.gitdiff import filter_to_diff
+    from lakesoul_tpu.analysis.rules import all_rules
+    from lakesoul_tpu.analysis.sarif import to_sarif
+
+    findings = [
+        f for f in jax_fixture("bad_static_shape.py")
+        if f.rule == "jit-static-arg-shape"
+    ]
+    log = to_sarif(findings, all_rules())
+    ids = {r["id"] for r in log["runs"][0]["tool"]["driver"]["rules"]}
+    assert {
+        "trace-impure-call", "trace-host-sync", "tpu-dtype-width",
+        "jit-static-arg-shape", "pallas-blockspec",
+    } <= ids
+    assert all(
+        r["ruleId"] == "jit-static-arg-shape" for r in log["runs"][0]["results"]
+    )
+
+    _git(tmp_path, "init", "-q")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "@jax.jit\n"
+        "def legacy(x):\n"
+        "    return x[x > 0]\n"
+    )
+    _git(tmp_path, "add", "mod.py")
+    _git(tmp_path, "commit", "-qm", "base")
+    mod.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "@jax.jit\n"
+        "def legacy(x):\n"
+        "    return x[x > 0]\n"
+        "\n"
+        "@jax.jit\n"
+        "def fresh(x):\n"
+        "    return jnp.unique(x)\n"
+    )
+    _git(tmp_path, "add", "mod.py")
+    _git(tmp_path, "commit", "-qm", "new code")
+    findings, _ = run([mod], root=tmp_path)
+    shape = [f for f in findings if f.rule == "jit-static-arg-shape"]
+    assert {f.line for f in shape} == {6, 10}
+    kept = filter_to_diff(shape, "HEAD~1", tmp_path)
+    assert [f.line for f in kept] == [10]
+
+
+def test_pallas_blockspec_scratch_and_positional_out_shape(tmp_path):
+    """Pallas ref order is (in, out, scratch): the output-write check must
+    target the middle params, and a positional multi-output out_shape must
+    count toward the kernel arity."""
+    from lakesoul_tpu.analysis.rules.jaxtpu import PallasBlockSpecRule
+
+    (tmp_path / "m.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "\n"
+        "def good(x_ref, o_ref, acc_ref):\n"
+        "    o_ref[...] = x_ref[...] + acc_ref[...]\n"
+        "\n"
+        "def bad(x_ref, o_ref, acc_ref):\n"
+        "    acc_ref[...] = x_ref[...]\n"
+        "\n"
+        "def two_out(x_ref, a_ref, b_ref):\n"
+        "    a_ref[...] = x_ref[...]\n"
+        "    b_ref[...] = x_ref[...]\n"
+        "\n"
+        "def calls(x):\n"
+        "    a = pl.pallas_call(good,\n"
+        "        out_shape=jax.ShapeDtypeStruct((64, 64), jnp.float32),\n"
+        "        grid=(2,),\n"
+        "        in_specs=[pl.BlockSpec((32, 64), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((32, 64), lambda i: (i, 0)),\n"
+        "        scratch_shapes=(1,))(x)\n"
+        "    b = pl.pallas_call(bad,\n"
+        "        out_shape=jax.ShapeDtypeStruct((64, 64), jnp.float32),\n"
+        "        grid=(2,),\n"
+        "        in_specs=[pl.BlockSpec((32, 64), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((32, 64), lambda i: (i, 0)),\n"
+        "        scratch_shapes=(1,))(x)\n"
+        "    c = pl.pallas_call(two_out,\n"
+        "        (jax.ShapeDtypeStruct((64, 64), jnp.float32),\n"
+        "         jax.ShapeDtypeStruct((64, 64), jnp.float32)),\n"
+        "        grid=(2,),\n"
+        "        in_specs=[pl.BlockSpec((32, 64), lambda i: (i, 0))],\n"
+        "        out_specs=(pl.BlockSpec((32, 64), lambda i: (i, 0)),\n"
+        "                   pl.BlockSpec((32, 64), lambda i: (i, 0))))(x)\n"
+        "    return a, b, c\n"
+    )
+    findings, _ = run(
+        [tmp_path / "m.py"], root=tmp_path, rules=[PallasBlockSpecRule()]
+    )
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "bad" in findings[0].message and "'o_ref'" in findings[0].message
+
+
+def test_trace_impure_skips_bare_name_callback_targets(tmp_path):
+    """`from jax import pure_callback` + a bare-name call must still exclude
+    the callback target from the traced closure (host I/O there is the
+    sanctioned pattern)."""
+    from lakesoul_tpu.analysis.rules.jaxtpu import TraceImpureCallRule
+
+    (tmp_path / "m.py").write_text(
+        "import jax\n"
+        "from jax import pure_callback\n"
+        "\n"
+        "def log_row(x):\n"
+        "    print('row', x)\n"
+        "    return x\n"
+        "\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return pure_callback(log_row, x, x)\n"
+    )
+    findings, _ = run(
+        [tmp_path / "m.py"], root=tmp_path, rules=[TraceImpureCallRule()]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_device_rules_allow_store_staticnum_and_const_slices(tmp_path):
+    """False-positive guards: pl.store counts as an output write,
+    static_argnums params are static (host math on them is legal), and
+    constant-expression slice bounds are not data-dependent."""
+    from lakesoul_tpu.analysis.rules.jaxtpu import (
+        JitStaticArgShapeRule,
+        PallasBlockSpecRule,
+        TraceHostSyncRule,
+    )
+
+    (tmp_path / "m.py").write_text(
+        "import functools\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "\n"
+        "def store_kernel(x_ref, o_ref):\n"
+        "    pl.store(o_ref, (pl.dslice(0, 32),), x_ref[...])\n"
+        "\n"
+        "def call(x):\n"
+        "    return pl.pallas_call(store_kernel,\n"
+        "        out_shape=jax.ShapeDtypeStruct((64, 64), jnp.float32),\n"
+        "        grid=(2,),\n"
+        "        in_specs=[pl.BlockSpec((32, 64), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((32, 64), lambda i: (i, 0)))(x)\n"
+        "\n"
+        "@functools.partial(jax.jit, static_argnums=(1,))\n"
+        "def topk(x, k):\n"
+        "    width = int(k)\n"
+        "    return jnp.sort(x)[:width]\n"
+        "\n"
+        "def host(codes, n):\n"
+        "    a = topk(codes[:-1], 4)\n"
+        "    b = topk(codes[:2 * 8], 4)\n"
+        "    c = topk(codes[:n], 4)  # the only dynamic slice\n"
+        "    return a, b, c\n"
+    )
+    rules = [PallasBlockSpecRule(), TraceHostSyncRule(), JitStaticArgShapeRule()]
+    findings, _ = run([tmp_path / "m.py"], root=tmp_path, rules=rules)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].rule == "jit-static-arg-shape"
+    assert "codes[:n]" in (tmp_path / "m.py").read_text().splitlines()[
+        findings[0].line - 1
+    ]
+
+
+def test_pallas_blockspec_skips_non_literal_grid_and_out_shape(tmp_path):
+    """Literal-first, never guessed: a name holding the grid tuple or the
+    out_shape must skip the rank/arity checks rather than assume rank 1 /
+    one output."""
+    from lakesoul_tpu.analysis.rules.jaxtpu import PallasBlockSpecRule
+
+    (tmp_path / "m.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "\n"
+        "GRID = (2, 2)\n"
+        "OUT = (jax.ShapeDtypeStruct((64, 64), jnp.float32),\n"
+        "       jax.ShapeDtypeStruct((64, 64), jnp.float32))\n"
+        "\n"
+        "def k(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "\n"
+        "def k2(x_ref, a_ref, b_ref):\n"
+        "    a_ref[...] = x_ref[...]\n"
+        "    b_ref[...] = x_ref[...]\n"
+        "\n"
+        "def call_var_grid(x):\n"
+        "    return pl.pallas_call(k,\n"
+        "        out_shape=jax.ShapeDtypeStruct((64, 64), jnp.float32),\n"
+        "        grid=GRID,\n"
+        "        in_specs=[pl.BlockSpec((32, 32), lambda i, j: (i, j))],\n"
+        "        out_specs=pl.BlockSpec((32, 32), lambda i, j: (i, j)))(x)\n"
+        "\n"
+        "def call_var_out(x):\n"
+        "    return pl.pallas_call(k2, OUT, grid=(2,),\n"
+        "        in_specs=[pl.BlockSpec((32, 64), lambda i: (i, 0))],\n"
+        "        out_specs=(pl.BlockSpec((32, 64), lambda i: (i, 0)),\n"
+        "                   pl.BlockSpec((32, 64), lambda i: (i, 0))))(x)\n"
+    )
+    findings, _ = run(
+        [tmp_path / "m.py"], root=tmp_path, rules=[PallasBlockSpecRule()]
+    )
+    assert findings == [], [f.render() for f in findings]

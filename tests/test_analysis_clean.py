@@ -1,5 +1,6 @@
-"""CI gate: the repo must lint clean — under ALL 11 rules, the 7
-per-function ones and the 4 interprocedural ones (call graph + dataflow).
+"""CI gate: the repo must lint clean — under ALL 16 rules: the 7
+per-function ones, the 4 interprocedural ones (call graph + dataflow),
+and the 5 device-pack ones (jit/pallas trace safety).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -18,16 +19,24 @@ EXPECTED_RULES = {
     # interprocedural
     "rbac-gate-reachability", "taint-path-segments",
     "transitive-lock-held-call", "interprocedural-unclosed-reader",
+    # device pack (jit/pallas trace safety)
+    "trace-impure-call", "trace-host-sync", "tpu-dtype-width",
+    "jit-static-arg-shape", "pallas-blockspec",
+}
+
+DEVICE_RULES = {
+    "trace-impure-call", "trace-host-sync", "tpu-dtype-width",
+    "jit-static-arg-shape", "pallas-blockspec",
 }
 
 
-def test_all_eleven_rules_registered():
+def test_all_sixteen_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 11
+    assert len(ids) == len(set(ids)) == 16
     assert set(ids) == EXPECTED_RULES
 
 
@@ -84,3 +93,16 @@ def test_console_lint_command(tmp_warehouse):
     out = c.execute("lint")
     assert "lint clean" in out
     assert "lint" in c.execute("help")
+
+
+def test_device_pack_clean_repo_wide_without_baseline():
+    """The five device rules hold with NO baseline entries at all: every
+    intentionally-unguarded site carries an inline pragma whose reason
+    names the invariant (same contract as the interprocedural rules)."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    device = [r for r in all_rules() if r.id in DEVICE_RULES]
+    assert len(device) == 5
+    findings, _ = run(rules=device, baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)

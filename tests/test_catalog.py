@@ -259,6 +259,46 @@ class TestJaxDelivery:
         with pytest.raises(RuntimeError, match="boom"):
             list(t.scan().batch_size(2).to_jax_iter(device_put=False, transform=bad_transform))
 
+    def test_collate_keeps_stringlike_columns_including_dictionary(self):
+        """Strings keep the documented stay-as-object contract — including
+        dictionary-encoded ones (Parquet readers commonly produce them) —
+        while fixed_size_list tensors collate to real 2-D arrays."""
+        from lakesoul_tpu.data.jax_iter import _default_collate
+
+        out = _default_collate(
+            pa.table(
+                {
+                    "label": pa.array(["a", "b"]).dictionary_encode(),
+                    "name": pa.array(["x", "y"]),
+                    "tokens": pa.FixedSizeListArray.from_arrays(
+                        np.arange(8, dtype=np.int32), 4
+                    ),
+                }
+            )
+        )
+        assert out["label"].dtype == object
+        assert out["name"].dtype == object
+        assert out["tokens"].dtype == np.int32
+        assert out["tokens"].shape == (2, 4)
+
+    def test_collate_rejects_object_dtype_columns_by_name(self, catalog):
+        """A column that only collates to dtype=object (nested list) must
+        fail with a ConfigError naming the column and its Arrow type — not
+        surface later as an opaque device_put failure."""
+        from lakesoul_tpu.errors import ConfigError
+
+        schema = pa.schema(
+            [("id", pa.int64()), ("emb", pa.list_(pa.float32()))]
+        )
+        t = catalog.create_table("nested", schema)
+        t.write_arrow(
+            pa.table(
+                {"id": [1, 2], "emb": [[1.0, 2.0], [3.0]]}, schema=schema
+            )
+        )
+        with pytest.raises(ConfigError, match="'emb'.*list"):
+            list(t.scan().batch_size(2).to_jax_iter(device_put=False))
+
 
 class TestAdapters:
     def test_torch_adapter(self, catalog):

@@ -1,0 +1,158 @@
+"""tracecheck: the runtime retrace detector must count distinct abstract
+signatures per jit entry, trip the budget on shape-thrashing call patterns,
+stay silent on bucketed/stable ones, instrument and cleanly restore both
+future jit wrappings and already-imported hot modules, and never record
+trace-time (jit-of-jit) calls as top-level compilations."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lakesoul_tpu.analysis import tracecheck
+
+
+@pytest.fixture()
+def armed():
+    tracecheck.reset()
+    tracecheck.enable()
+    yield
+    tracecheck.disable()
+    tracecheck.reset()
+
+
+def test_shape_thrash_trips_budget(armed):
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    label = f"{__name__}.test_shape_thrash_trips_budget.<locals>.f"
+    tracecheck.set_budget(label, 3)
+    for n in range(1, 7):  # 6 distinct shapes against a budget of 3
+        f(np.ones(n, np.float32))
+    violations = tracecheck.violations()
+    assert len(violations) == 1
+    v = violations[0]
+    assert v.kind == "retrace-budget"
+    assert v.function == label
+    assert v.count == 6 and v.budget == 3
+    # the violation names the thrashing shapes so the fix is obvious
+    assert "float32[1]" in v.render() and "float32[6]" in v.render()
+
+
+def test_stable_and_bucketed_shapes_stay_clean(armed):
+    @jax.jit
+    def g(x):
+        return x + 1
+
+    tracecheck.set_budget(f"{__name__}.test_stable_and_bucketed_shapes_stay_clean.<locals>.g", 2)
+    for _ in range(10):
+        g(np.ones(8, np.float32))  # same signature every time
+    g(np.ones(16, np.float32))  # one pow2 bucket up: still within budget
+    assert tracecheck.violations() == []
+    counts = tracecheck.signature_counts()
+    (label,) = [k for k in counts if k.endswith(".g")]
+    assert counts[label] == 2
+
+
+def test_static_arg_change_counts_as_retrace(armed):
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def h(x, *, k):
+        return x[:k]
+
+    label = [k for k in [f"{__name__}.test_static_arg_change_counts_as_retrace.<locals>.h"]][0]
+    tracecheck.set_budget(label, 2)
+    for k in range(1, 5):
+        h(np.ones(8, np.float32), k=k)  # every k re-specializes
+    (v,) = tracecheck.violations()
+    assert v.count == 4
+
+
+def test_trace_time_inner_calls_not_counted(armed):
+    @jax.jit
+    def inner(x):
+        return x * 3
+
+    @jax.jit
+    def outer(x):
+        return inner(x) + 1  # traced call: inlined, no top-level compile
+
+    outer(np.ones(4, np.float32))
+    counts = tracecheck.signature_counts()
+    assert any(k.endswith(".outer") for k in counts)
+    assert not any(k.endswith(".inner") for k in counts)
+
+
+def test_hot_module_instrumented_and_restored():
+    import lakesoul_tpu.vector.kernels as kernels
+
+    orig = kernels.packed_dot_pallas
+    tracecheck.reset()
+    tracecheck.enable()
+    try:
+        assert isinstance(
+            kernels.packed_dot_pallas, tracecheck._TraceCheckedFn
+        )
+        # the jnp fallback path drives the jitted estimator end to end
+        codes = np.random.default_rng(0).integers(
+            0, 255, (100, 8), dtype=np.uint8
+        )
+        out = kernels.packed_scan(
+            codes, np.ones(100, np.float32), np.ones(100, np.float32),
+            np.ones(64, np.float32), d=64, pallas=False,
+        )
+        assert out.shape == (100,)
+        assert any(
+            "estimate_distances" in k for k in tracecheck.signature_counts()
+        )
+    finally:
+        tracecheck.disable()
+        tracecheck.reset()
+    assert kernels.packed_dot_pallas is orig  # restored exactly
+
+
+def test_jit_patch_restored_and_aot_surface_passthrough():
+    real_jit = jax.jit
+    tracecheck.reset()
+    tracecheck.enable()
+    try:
+        @jax.jit
+        def f(x):
+            return x - 1
+
+        # AOT/introspection surfaces must keep working on the proxy
+        assert f.lower(np.ones(3, np.float32)) is not None
+        f(np.ones(3, np.float32))
+    finally:
+        tracecheck.disable()
+        tracecheck.reset()
+    assert jax.jit is real_jit
+
+
+def test_watch_scopes_violations():
+    tracecheck.reset()
+    with tracecheck.watch() as w:
+        @jax.jit
+        def f(x):
+            return x
+
+        tracecheck.set_budget(
+            f"{__name__}.test_watch_scopes_violations.<locals>.f", 1
+        )
+        f(np.ones(2, np.float32))
+        f(np.ones(3, np.float32))
+    assert len(w.violations) == 1
+    assert not tracecheck.enabled()
+    tracecheck.reset()
+
+
+def test_env_gate():
+    assert tracecheck.env_requested() in (True, False)
+    # the conftest fixture only arms when LAKESOUL_TRACECHECK=1; the
+    # detector itself never auto-enables on import
+    assert not tracecheck.enabled() or tracecheck.env_requested()
