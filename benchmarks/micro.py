@@ -10,6 +10,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py spill      # writer auto-flush (spill) + re-merge
     python benchmarks/micro.py meta       # plan 1 partition out of 100k (ms)
     python benchmarks/micro.py pipeline   # serial vs runtime-pipelined scan
+    python benchmarks/micro.py chaos      # clean vs faulted-scan degradation
     python benchmarks/micro.py lint       # lakelint wall-time over the package
     python benchmarks/micro.py all
 """
@@ -349,10 +350,79 @@ def bench_pipeline_scan(
         )
 
 
+def bench_chaos(n_rows: int = 400_000, n_files: int = 8, p: float = 0.3) -> None:
+    """Clean vs chaos-faulted scan throughput (the resilience layer's cost
+    leg): the same table is scanned twice, the second time with p=0.3
+    transient faults injected into every object-store open/info call
+    (runtime/faults.py `flaky` kind).  The retry policy must absorb every
+    fault — the leg asserts the batch streams are BYTE-IDENTICAL — and the
+    published `degradation` ratio (faulted/clean throughput) is the price
+    of absorption.  Retry counters ride in the obs delta."""
+    import numpy as np
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.runtime import faults
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LAKESOUL_RETRY_MAX_ATTEMPTS", "LAKESOUL_RETRY_BASE_S",
+                  "LAKESOUL_RETRY_CAP_S")
+    }
+    os.environ.update({
+        "LAKESOUL_RETRY_MAX_ATTEMPTS": "10",
+        "LAKESOUL_RETRY_BASE_S": "0.001",
+        "LAKESOUL_RETRY_CAP_S": "0.01",
+    })
+    rng = np.random.default_rng(0)
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            catalog = LakeSoulCatalog(
+                "memory://chaos-bench/wh", db_path=os.path.join(d, "meta.db")
+            )
+            schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+            t = catalog.create_table("chaos", schema)
+            per = n_rows // n_files
+            for i in range(n_files):
+                t.write_arrow(pa.table({
+                    "id": np.arange(i * per, (i + 1) * per),
+                    "v": rng.normal(size=per),
+                }, schema=schema))
+
+            start = time.perf_counter()
+            clean = list(t.scan().batch_size(65_536).to_batches())
+            clean_dt = time.perf_counter() - start
+
+            faults.clear()
+            faults.install(f"object_store.open:{p}:flaky")
+            faults.install(f"object_store.info:{p}:flaky")
+            try:
+                start = time.perf_counter()
+                faulted = list(t.scan().batch_size(65_536).to_batches())
+                faulted_dt = time.perf_counter() - start
+            finally:
+                faults.clear()
+
+            assert len(clean) == len(faulted)
+            for a, b in zip(clean, faulted):
+                assert a.equals(b), "chaos run diverged from the clean scan"
+            _emit(
+                "chaos_scan", n_rows / faulted_dt, "rows/s",
+                clean_rows_per_s=round(n_rows / clean_dt, 1),
+                degradation=round((n_rows / faulted_dt) / (n_rows / clean_dt), 3),
+                fault_p=p, files=n_files,
+            )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def bench_lint() -> None:
     """Analyzer wall-time over the whole package (CI-gate cost leg: the
     lint gate runs on every PR, so its cost is tracked next to the perf
-    legs; target < 10 s for all 16 rules INCLUDING the project call-graph
+    legs; target < 10 s for all 17 rules INCLUDING the project call-graph
     build the interprocedural rules share and the device-index/taint
     passes of the JAX/TPU pack)."""
     from lakesoul_tpu.analysis import run_repo
@@ -394,6 +464,7 @@ LEGS = {
     "spill": bench_spill,
     "meta": bench_meta_prune,
     "pipeline": bench_pipeline_scan,
+    "chaos": bench_chaos,
     "lint": bench_lint,
 }
 

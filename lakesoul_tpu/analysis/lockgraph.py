@@ -189,7 +189,7 @@ def _on_acquired(lock: "_CheckedBase", n: int = 1) -> None:
 
 
 def _drop_entry(held: list, lock: "_CheckedBase", n: int) -> bool:
-    for i in range(len(held) - 1, -1, -1):
+    for i in range(len(held) - 1, -1, -1):  # lakelint: ignore[ad-hoc-retry] reverse index scan with a concurrent-remove guard, returns on first hit — not a retry loop
         if held[i][0] is lock:
             held[i][1] -= n
             if held[i][1] <= 0:

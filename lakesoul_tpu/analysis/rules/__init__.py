@@ -37,6 +37,7 @@ from lakesoul_tpu.analysis.rules.resources import (
     InterproceduralUnclosedReaderRule,
     UnclosedReaderRule,
 )
+from lakesoul_tpu.analysis.rules.robustness import AdHocRetryRule
 from lakesoul_tpu.analysis.rules.security import (
     RbacGateReachabilityRule,
     TaintPathSegmentsRule,
@@ -55,6 +56,7 @@ def all_rules() -> list[Rule]:
         UndocumentedEnvRule(),
         MetricNameRule(),
         SqliteScopeRule(),
+        AdHocRetryRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

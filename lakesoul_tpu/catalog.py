@@ -58,6 +58,7 @@ class LakeSoulCatalog:
             client = MetaDataClient(db_path=db_path)
         self.client = client
         self.storage_options = storage_options or {}
+        self._recover_on_open()
         # scan.cache() storage: LRU of decoded tables, keyed by scan
         # parameters + partition-version digest (commits invalidate naturally).
         # BYTE-bounded, not count-bounded: four 2M-row tables are GBs — the
@@ -65,6 +66,30 @@ class LakeSoulCatalog:
         self._scan_cache: dict = {}
         self._scan_cache_max_bytes = 512 << 20
         self._scan_cache_bytes = 0
+
+    def _recover_on_open(self) -> None:
+        """Crash-safe open: commits a killed process left between the two
+        metadata phases are rolled forward/back before the catalog serves
+        its first plan (MetaDataClient.recover_incomplete_commits).  Only
+        commits older than ``LAKESOUL_RECOVER_MIN_AGE_MS`` (default 1 h)
+        are swept, so live writers sharing the store are never raced; a
+        failing recovery must never fail the open itself."""
+        import logging
+        import os
+
+        raw = os.environ.get("LAKESOUL_RECOVER_MIN_AGE_MS", "").strip()
+        try:
+            min_age_ms = int(raw) if raw else 3_600_000
+        except ValueError:
+            min_age_ms = 3_600_000
+        try:
+            self.client.recover_incomplete_commits(
+                min_age_ms=min_age_ms, storage_options=self.storage_options
+            )
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "commit recovery on catalog open failed; continuing"
+            )
 
     def _scan_cache_get(self, key):
         hit = self._scan_cache.pop(key, None)

@@ -184,6 +184,7 @@ class CompactionService:
 
     def _compact_one_inner(self, event: CompactionEvent) -> None:
         from lakesoul_tpu.meta.client import partition_desc_to_dict
+        from lakesoul_tpu.runtime.resilience import RetryPolicy
 
         info = self.catalog.client.store.get_table_info_by_id(event.table_id)
         if info is None:
@@ -191,19 +192,36 @@ class CompactionService:
             return
         table = self.catalog.table(info.table_name, info.table_namespace)
         parts = partition_desc_to_dict(event.partition_desc) or None
+
         # writers may advance the partition mid-compact; each retry re-reads
-        # the fresh head, like the reference re-running on the next notify
-        for attempt in range(3):
+        # the fresh head, like the reference re-running on the next notify —
+        # now with backoff between attempts (a hot writer gets a beat to
+        # finish its burst) and a lakesoul_retry_exhausted_total{op=
+        # compaction.conflict} signal when the job gives up, instead of the
+        # old silent fixed-3 loop
+        def attempt() -> str:
             if not self._needs_compaction(table, event.partition_desc):
-                self.stats.bump("skipped")
-                return
+                return "skipped"
             try:
-                n = table.compact(parts)
-                self.stats.bump("compacted" if n else "skipped")
-                return
+                return "compacted" if table.compact(parts) else "skipped"
             except CommitConflictError:
                 self.stats.bump("conflicts")
-        logger.info("compaction kept losing races for %s; deferring", event.partition_desc)
+                raise
+
+        policy = RetryPolicy.from_env(
+            max_attempts=3,
+            base_delay_s=0.02,
+            max_delay_s=0.25,
+            classify=lambda e: isinstance(e, CommitConflictError),
+        )
+        try:
+            outcome = policy.run(attempt, op="compaction.conflict")
+        except CommitConflictError:
+            logger.info(
+                "compaction kept losing races for %s; deferring", event.partition_desc
+            )
+            return
+        self.stats.bump(outcome)
 
     def _needs_compaction(self, table, partition_desc: str) -> bool:
         """Size-tiered gate: only compact when some bucket stacks at least

@@ -43,3 +43,23 @@ class RBACError(LakeSoulError):
 
 class VectorIndexError(LakeSoulError):
     pass
+
+
+class TransientError(LakeSoulError):
+    """Marker base for failures that are expected to clear on their own
+    (network blips, 5xx, races): the resilience layer
+    (runtime/resilience.py) retries these and only these.  Raising a
+    subclass is how a layer declares "try me again"."""
+
+
+class OverloadedError(TransientError):
+    """Admission control rejected the request: the in-flight bound and the
+    bounded queue are both full (or the queue wait timed out).  Serving
+    surfaces map this to Flight UNAVAILABLE — the client may back off and
+    retry, which is why it is transient."""
+
+
+class CircuitOpenError(TransientError):
+    """A circuit breaker is open: recent failures crossed the threshold and
+    the protected dependency is being given time to recover.  Calls fail
+    fast instead of queueing behind a dead backend."""

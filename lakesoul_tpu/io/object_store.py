@@ -19,6 +19,16 @@ without leaking kwargs across backends.
 Remote READS go through the framework's own bounded disk page cache
 (io/page_cache.py, the role of rust/lakesoul-io/src/cache/disk_cache.rs)
 when ``lakesoul.cache_dir`` is set; writes always bypass it.
+
+Remote stores are additionally wrapped in :class:`ResilientFileSystem`:
+every GET-shaped call (``cat_file``, ``open`` for read, metadata lookups)
+is a fault-injection point (``object_store.cat_file`` etc. — see
+runtime/faults.py) and is retried under the shared
+:class:`~lakesoul_tpu.runtime.resilience.RetryPolicy` when the failure is
+transient.  Truncated responses (the ``truncate`` chaos kind, or a real
+short read) are detected by length and retried like any other transient
+fault.  Local filesystems are never wrapped — the wrapper exists for the
+network.
 """
 
 from __future__ import annotations
@@ -26,6 +36,10 @@ from __future__ import annotations
 import os
 
 import fsspec
+from fsspec.spec import AbstractFileSystem
+
+from lakesoul_tpu.runtime import faults
+from lakesoul_tpu.runtime.resilience import RetryPolicy
 
 # storage_options keys consumed by the framework itself (not passed to fsspec)
 OPTION_CACHE_DIR = "lakesoul.cache_dir"
@@ -81,18 +95,158 @@ def _scope_options(opts: dict, protocol: str) -> dict:
     return out
 
 
+class ResilientFileSystem(AbstractFileSystem):
+    """fsspec wrapper adding fault points + transient-failure retries to a
+    remote store (the role the reference delegates to object_store crate
+    retry config).  Read-shaped calls retry under the shared policy;
+    mutating calls delegate untouched (a half-applied PUT/DELETE replay is
+    the caller's protocol to own — the commit layer is already idempotent).
+
+    Chaos: ``object_store.cat_file`` / ``object_store.open`` /
+    ``object_store.info`` are the injection points; ``truncate`` faults on
+    ``cat_file`` are detected by length (the Content-Length check every
+    real HTTP client performs) and surface as a retryable short read."""
+
+    protocol = "lsresilient"
+
+    def __init__(self, target_fs, policy: RetryPolicy, **kwargs):
+        super().__init__(**kwargs)
+        self.target = target_fs
+        self.policy = policy
+
+    def __getattr__(self, name):
+        # backend-specific attributes (hdfs user, s3 endpoint, custom
+        # methods) read through to the wrapped filesystem
+        target = self.__dict__.get("target")
+        if target is None:
+            raise AttributeError(name)
+        return getattr(target, name)
+
+    def _retried(self, op: str, fn):
+        return self.policy.run(fn, op=op)
+
+    # ---------------------------------------------------------------- reads
+    def cat_file(self, path, start=None, end=None, **kwargs):
+        def attempt():
+            faults.maybe_inject("object_store.cat_file")
+            out = self.target.cat_file(path, start=start, end=end, **kwargs)
+            filtered = faults.filter_bytes("object_store.cat_file", out)
+            if len(filtered) < len(out):
+                # injected truncation: detectable exactly like a
+                # Content-Length mismatch, and just as retryable
+                raise ConnectionError(
+                    f"short read for {path}: got {len(filtered)} of {len(out)} bytes"
+                )
+            if start is not None and end is not None and len(out) < end - start:
+                # a REAL short read: a ranged GET may only legitimately come
+                # back short when the range overruns EOF — anything else is a
+                # body cut mid-flight (the Content-Length check every real
+                # HTTP client performs).  size() costs one metadata call and
+                # runs only on short results, i.e. tail reads and failures.
+                if end <= self.target.size(path):
+                    raise ConnectionError(
+                        f"short read for {path}: got {len(out)}"
+                        f" of {end - start} bytes"
+                    )
+            return out
+
+        return self._retried("object_store.cat_file", attempt)
+
+    def open(self, path, mode="rb", **kwargs):
+        if "r" in mode and "w" not in mode and "a" not in mode:
+            def attempt():
+                faults.maybe_inject("object_store.open")
+                return self.target.open(path, mode, **kwargs)
+
+            return self._retried("object_store.open", attempt)
+        return self.target.open(path, mode, **kwargs)
+
+    def _open(self, path, mode="rb", **kwargs):
+        return self.target.open(path, mode, **kwargs)
+
+    def info(self, path, **kwargs):
+        def attempt():
+            faults.maybe_inject("object_store.info")
+            return self.target.info(path, **kwargs)
+
+        return self._retried("object_store.info", attempt)
+
+    def ls(self, path, detail=True, **kwargs):
+        return self._retried(
+            "object_store.ls", lambda: self.target.ls(path, detail=detail, **kwargs)
+        )
+
+    def exists(self, path, **kwargs):
+        return self._retried(
+            "object_store.info", lambda: self.target.exists(path, **kwargs)
+        )
+
+    def size(self, path):
+        return self._retried("object_store.info", lambda: self.target.size(path))
+
+    def isfile(self, path):
+        return self._retried("object_store.info", lambda: self.target.isfile(path))
+
+    def isdir(self, path):
+        return self._retried("object_store.info", lambda: self.target.isdir(path))
+
+    def glob(self, path, **kwargs):
+        return self._retried("object_store.ls", lambda: self.target.glob(path, **kwargs))
+
+    def find(self, path, **kwargs):
+        return self._retried("object_store.ls", lambda: self.target.find(path, **kwargs))
+
+    # ------------------------------------------------------------ mutations
+    def pipe_file(self, path, value, **kwargs):
+        # full-buffer upload: replayable, so transient failures retry
+        return self._retried(
+            "object_store.put", lambda: self.target.pipe_file(path, value, **kwargs)
+        )
+
+    def rm_file(self, path):
+        return self.target.rm_file(path)
+
+    def rm(self, path, recursive=False, **kwargs):
+        return self.target.rm(path, recursive=recursive, **kwargs)
+
+    def makedirs(self, path, exist_ok=False):
+        return self.target.makedirs(path, exist_ok=exist_ok)
+
+    def mkdir(self, path, **kwargs):
+        return self.target.mkdir(path, **kwargs)
+
+    def mv(self, path1, path2, **kwargs):
+        return self.target.mv(path1, path2, **kwargs)
+
+    def touch(self, path, **kwargs):
+        return self.target.touch(path, **kwargs)
+
+
+def _store_retry_policy() -> RetryPolicy:
+    """The object-store read policy: ``LAKESOUL_RETRY_*`` env family with a
+    store-appropriate default shape (3 attempts, 50 ms base, 2 s cap)."""
+    return RetryPolicy.from_env()
+
+
 def filesystem_for(path: str, storage_options: dict | None = None, *, write: bool = False):
     """Resolve (fs, normalized_path) for a file or directory path.
 
-    When ``storage_options['lakesoul.cache_dir']`` is set and the path is
-    remote, reads are served through the bounded read-through page cache
-    (hit/miss/eviction counters via :func:`cache_stats`).  Optional knobs:
+    Remote paths are wrapped in :class:`ResilientFileSystem` (transient
+    failures retried, chaos fault points armed).  When
+    ``storage_options['lakesoul.cache_dir']`` is set and the path is
+    remote, reads are additionally served through the bounded read-through
+    page cache — stacked ABOVE the retry wrapper, so cache misses and
+    readahead fetches inherit the retries.  Optional knobs:
     ``lakesoul.cache_max_bytes`` (default 10 GiB) and
     ``lakesoul.cache_page_bytes`` (default 4 MiB)."""
     own, opts = _split_options(storage_options)
     cache_dir = own.get(OPTION_CACHE_DIR)
     protocol = fsspec.core.split_protocol(path)[0] or "file"
     fs, p = fsspec.core.url_to_fs(path, **_scope_options(opts, protocol))
+    if protocol not in OPTION_CACHE_DISABLED_PROTOCOLS:
+        policy = _store_retry_policy()
+        if policy.max_attempts > 1 or faults.active():
+            fs = ResilientFileSystem(fs, policy)
     if cache_dir and not write and protocol not in OPTION_CACHE_DISABLED_PROTOCOLS:
         from lakesoul_tpu.io.page_cache import CachedReadFileSystem, get_cache
 

@@ -19,7 +19,15 @@ constructor knob) prefetches the N pages following every ranged read on the
 shared runtime worker pool — sequential parquet column-chunk scans then find
 page k+1 already local when they ask for it.  Prefetches are best-effort
 (failures are swallowed), deduplicated while in flight, and counted in the
-``readahead_pages`` stat instead of hits/misses.
+``readahead_pages`` stat instead of hits/misses.  A failed prefetch backs
+the object off for ``LAKESOUL_RETRY_READAHEAD_BACKOFF_S`` (default 30 s —
+part of the shared resilience policy config, runtime/resilience.py; the
+``readahead_backoff_s`` constructor knob overrides per cache).
+
+Miss fetches ride the object-store retry policy: when ``filesystem_for``
+handed us a :class:`~lakesoul_tpu.io.object_store.ResilientFileSystem`
+target the retries live there; a raw target gets the same policy applied
+here, so direct constructions (tests, embedders) behave identically.
 """
 
 from __future__ import annotations
@@ -171,11 +179,19 @@ class DiskPageCache:
         max_bytes: int = DEFAULT_MAX_BYTES,
         page_bytes: int = DEFAULT_PAGE_BYTES,
         readahead_pages: int | None = None,
+        readahead_backoff_s: float | None = None,
     ):
+        from lakesoul_tpu.runtime.resilience import default_readahead_backoff_s
+
         self.cache_dir = str(cache_dir)
         self.max_bytes = int(max_bytes)
         self.readahead_pages = (
             _default_readahead() if readahead_pages is None else max(0, int(readahead_pages))
+        )
+        self.readahead_backoff_s = (
+            default_readahead_backoff_s()
+            if readahead_backoff_s is None
+            else max(0.0, float(readahead_backoff_s))
         )
         self.stats = CacheStats()
         self._lock = threading.Lock()
@@ -274,7 +290,7 @@ class DiskPageCache:
         run: list[int] = []
         for idx in missing + [None]:  # type: ignore[list-item]
             if run and (idx is None or idx != run[-1] + 1):
-                blob = target_fs.cat_file(path, start=run[0] * pb, end=(run[-1] + 1) * pb)
+                blob = self._fetch(target_fs, path, run[0] * pb, (run[-1] + 1) * pb)
                 self.stats.record_miss(len(blob))
                 for j, pidx in enumerate(run):
                     page = blob[j * pb : (j + 1) * pb]
@@ -297,6 +313,31 @@ class DiskPageCache:
         blob = b"".join(pages[i] for i in range(first, last + 1))
         lo = start - first * pb
         return blob[lo : lo + (end - start)]
+
+    def _fetch(self, target_fs, path: str, start: int, end: int) -> bytes:
+        """One coalesced miss GET, armed as the ``page_cache.fetch`` chaos
+        point.  A :class:`~lakesoul_tpu.io.object_store.ResilientFileSystem`
+        target already retries transients itself; a raw target gets the
+        same shared policy here so both constructions behave identically."""
+        from lakesoul_tpu.io.object_store import ResilientFileSystem
+        from lakesoul_tpu.runtime import faults
+        from lakesoul_tpu.runtime.resilience import RetryPolicy
+
+        if isinstance(target_fs, ResilientFileSystem):
+            # the wrapped fs owns retries for real I/O; only the cache's own
+            # chaos point needs policy cover here (never stacked, so a
+            # `page_cache.fetch` fault is absorbed identically either way)
+            RetryPolicy.from_env().run(
+                lambda: faults.maybe_inject("page_cache.fetch"),
+                op="page_cache.fetch",
+            )
+            return target_fs.cat_file(path, start=start, end=end)
+
+        def attempt():
+            faults.maybe_inject("page_cache.fetch")
+            return target_fs.cat_file(path, start=start, end=end)
+
+        return RetryPolicy.from_env().run(attempt, op="page_cache.fetch")
 
     # -------------------------------------------------------------- readahead
     def _schedule_readahead(self, target_fs, path: str, key: str, first: int) -> None:
@@ -382,7 +423,7 @@ class DiskPageCache:
             # instead of retrying on every tail read or permanently
             # disabling readahead for it (direct reads are unaffected)
             with self._lock:
-                self._ra_backoff[key] = time.monotonic() + 30.0
+                self._ra_backoff[key] = time.monotonic() + self.readahead_backoff_s
                 if len(self._ra_backoff) > 4096:
                     now = time.monotonic()
                     for k in [k for k, ts in self._ra_backoff.items() if ts <= now]:

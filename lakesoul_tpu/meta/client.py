@@ -12,7 +12,6 @@ current partition head and retries the commit.
 from __future__ import annotations
 
 import logging
-import random
 import re
 import time
 from dataclasses import dataclass, field
@@ -49,6 +48,20 @@ logger = logging.getLogger(__name__)
 _BUCKET_ID_PATTERN = re.compile(r".*_(\d+)(?:\..*)?$")
 
 MAX_COMMIT_RETRIES = 10
+
+
+def _commit_retry_policy():
+    """Seeded-jitter backoff for optimistic-commit conflicts (replaces the
+    old unseeded ``random.uniform`` sleeps — chaos runs now reproduce).
+    Only :class:`CommitConflictError` retries; everything else surfaces."""
+    from lakesoul_tpu.runtime.resilience import RetryPolicy
+
+    return RetryPolicy.from_env(
+        max_attempts=MAX_COMMIT_RETRIES,
+        base_delay_s=0.01,
+        max_delay_s=0.5,
+        classify=lambda e: isinstance(e, CommitConflictError),
+    )
 
 
 def extract_hash_bucket_id(file_path: str) -> int | None:
@@ -245,59 +258,68 @@ class MetaDataClient:
         if meta_info.table_info is None:
             raise MetadataError("table info missing")
         from lakesoul_tpu.obs import registry, span
+        from lakesoul_tpu.runtime import faults
 
-        last_err: Exception | None = None
         started = time.perf_counter()
-        for attempt in range(MAX_COMMIT_RETRIES):
+        table_name = meta_info.table_info.table_name
+        retryable = commit_op not in (CommitOp.COMPACTION, CommitOp.UPDATE)
+
+        def attempt():
+            # kill-mid-commit chaos point: phase 1 (data-commit rows) is
+            # durable, phase 2 (partition version advance) has not run yet
+            faults.maybe_inject("meta.commit.phase2")
             try:
                 with span("meta.commit", op=commit_op.value):
-                    result = self._commit_data_once(meta_info, commit_op)
-                registry().histogram(
-                    "lakesoul_meta_commit_seconds", op=commit_op.value
-                ).observe(time.perf_counter() - started)
-                registry().counter(
-                    "lakesoul_meta_commits_total", op=commit_op.value
-                ).inc()
-                if logger.isEnabledFor(logging.DEBUG):
-                    logger.debug(
-                        "commit %s table=%s partitions=%d attempt=%d in %.1fms",
-                        commit_op.value,
-                        meta_info.table_info.table_name,
-                        len(meta_info.list_partition),
-                        attempt + 1,
-                        (time.perf_counter() - started) * 1e3,
-                    )
-                return result
+                    return self._commit_data_once(meta_info, commit_op)
             except CommitConflictError as e:
-                last_err = e
                 registry().counter("lakesoul_meta_commit_conflicts_total").inc()
-                if commit_op in (CommitOp.COMPACTION, CommitOp.UPDATE):
+                if not retryable:
                     # the snapshot this job produced was computed from a stale
                     # read version; stacking it would lose concurrent writes
                     logger.warning(
                         "commit %s conflict on table=%s: %s (not retryable)",
-                        commit_op.value,
-                        meta_info.table_info.table_name,
-                        e,
+                        commit_op.value, table_name, e,
                     )
-                    raise
-                logger.warning(
-                    "commit %s conflict on table=%s attempt=%d/%d; retrying",
-                    commit_op.value,
-                    meta_info.table_info.table_name,
-                    attempt + 1,
-                    MAX_COMMIT_RETRIES,
+                raise
+
+        def on_retry(attempt_no, exc):
+            logger.warning(
+                "commit %s conflict on table=%s attempt=%d/%d; retrying",
+                commit_op.value, table_name, attempt_no, MAX_COMMIT_RETRIES,
+            )
+
+        try:
+            if not retryable:
+                result = attempt()
+            else:
+                result = _commit_retry_policy().run(
+                    attempt, op="meta.commit", on_retry=on_retry
                 )
-                time.sleep(random.uniform(0.01, 0.05) * (attempt + 1))
-        logger.error(
-            "commit %s failed after %d retries on table=%s",
-            commit_op.value,
-            MAX_COMMIT_RETRIES,
-            meta_info.table_info.table_name,
-        )
-        raise CommitConflictError(
-            f"commit failed after {MAX_COMMIT_RETRIES} retries"
-        ) from last_err
+        except CommitConflictError as e:
+            if not retryable:
+                raise
+            logger.error(
+                "commit %s failed after %d retries on table=%s",
+                commit_op.value, MAX_COMMIT_RETRIES, table_name,
+            )
+            raise CommitConflictError(
+                f"commit failed after {MAX_COMMIT_RETRIES} retries"
+            ) from e
+        registry().histogram(
+            "lakesoul_meta_commit_seconds", op=commit_op.value
+        ).observe(time.perf_counter() - started)
+        registry().counter(
+            "lakesoul_meta_commits_total", op=commit_op.value
+        ).inc()
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug(
+                "commit %s table=%s partitions=%d in %.1fms",
+                commit_op.value,
+                table_name,
+                len(meta_info.list_partition),
+                (time.perf_counter() - started) * 1e3,
+            )
+        return result
 
     def _commit_data_once(self, meta_info: MetaInfo, commit_op: CommitOp) -> None:
         table_info = meta_info.table_info
@@ -494,6 +516,109 @@ class MetaDataClient:
                     delete_file(op.path, storage_options)
                 except Exception:
                     pass  # cleanup is advisory; never fail a successful replay
+
+    # --------------------------------------------------------- crash recovery
+    def recover_incomplete_commits(
+        self,
+        *,
+        table_id: str | None = None,
+        min_age_ms: int = 0,
+        storage_options: dict | None = None,
+    ) -> dict:
+        """Repair commits a killed writer left between the two phases.
+
+        Phase 1 (data-commit rows) is atomic and durable; phase 2 (partition
+        version advance) and the final ``committed`` flag flip each leave a
+        distinct crash signature, and each is repaired to a consistent
+        state — never a partial one:
+
+        - snapshot references the commit but ``committed=0`` (killed between
+          phase 2 and mark_committed): the data is already visible and
+          complete — repair the flag (roll forward).
+        - unreferenced Append/Merge whose staged files all still exist
+          (killed between phases): phase 1 captured the complete file list,
+          so re-run phase 2 and publish it (roll forward).
+        - anything else — staged files missing, or a snapshot-replacing op
+          (Compaction/Update/Delete) whose read-version validation went
+          stale with the crash: delete the staged files and the commit row
+          (roll back); the job re-runs from fresh state.
+
+        ``min_age_ms`` keeps live in-flight writers out of the sweep (the
+        catalog-open hook passes ``LAKESOUL_RECOVER_MIN_AGE_MS``, default
+        1 h; the kill-mid-commit test passes 0).  Returns per-action counts,
+        also published as ``lakesoul_meta_recovered_commits_total{action=}``.
+        """
+        from lakesoul_tpu.io.object_store import delete_file
+        from lakesoul_tpu.io.object_store import exists as file_exists
+        from lakesoul_tpu.obs import registry
+
+        counts = {"flag_repaired": 0, "rolled_forward": 0, "rolled_back": 0}
+        lister = getattr(self.store, "list_uncommitted_commits", None)
+        if lister is None:
+            return counts  # a store without the sweep query has nothing to repair
+        cutoff = now_millis() - max(0, int(min_age_ms))
+        for c in lister(table_id=table_id, older_than_ms=cutoff):
+            info = self.store.get_table_info_by_id(c.table_id)
+            if info is None:
+                # table dropped out from under the commit: only the row is left
+                self.store.delete_data_commit_info(
+                    c.table_id, c.partition_desc, [c.commit_id]
+                )
+                counts["rolled_back"] += 1
+                continue
+            referenced = any(
+                c.commit_id in v.snapshot
+                for v in self.store.get_partition_versions(
+                    c.table_id, c.partition_desc
+                )
+            )
+            if referenced:
+                self.store.mark_committed(c.table_id, c.partition_desc, [c.commit_id])
+                counts["flag_repaired"] += 1
+                continue
+            adds = [op for op in c.file_ops if op.file_op.value == "add"]
+            forwardable = c.commit_op in (CommitOp.APPEND, CommitOp.MERGE) and all(
+                file_exists(op.path, storage_options) for op in adds
+            )
+            if forwardable:
+                meta_info = MetaInfo(
+                    table_info=info,
+                    list_partition=[
+                        PartitionInfo(
+                            table_id=c.table_id,
+                            partition_desc=c.partition_desc,
+                            snapshot=[c.commit_id],
+                        )
+                    ],
+                )
+                try:
+                    self.commit_data(meta_info, c.commit_op)
+                except CommitConflictError:
+                    logger.warning(
+                        "recovery of commit %s on %s keeps losing races;"
+                        " leaving it for the next sweep",
+                        c.commit_id, c.partition_desc,
+                    )
+                    continue
+                self.store.mark_committed(c.table_id, c.partition_desc, [c.commit_id])
+                counts["rolled_forward"] += 1
+            else:
+                for op in adds:
+                    try:
+                        delete_file(op.path, storage_options)
+                    except Exception:
+                        pass  # cleanup is advisory; the row delete is the repair
+                self.store.delete_data_commit_info(
+                    c.table_id, c.partition_desc, [c.commit_id]
+                )
+                counts["rolled_back"] += 1
+        for action, n in counts.items():
+            if n:
+                logger.info("commit recovery: %s ×%d", action, n)
+                registry().counter(
+                    "lakesoul_meta_recovered_commits_total", action=action
+                ).inc(n)
+        return counts
 
     # ------------------------------------------------------------ scan plans
     _CANONICAL_FLAG = DESCS_VERIFIED_KEY

@@ -432,6 +432,28 @@ class SqlMetadataStore(MetadataStore):
         ).fetchone()
         return None if row is None else bool(row[0])
 
+    def list_uncommitted_commits(
+        self, table_id: str | None = None, older_than_ms: int | None = None
+    ) -> list[DataCommitInfo]:
+        """Data commits whose ``committed`` flag never flipped — the debris
+        a writer killed between commit phases leaves behind.  Crash
+        recovery (MetaDataClient.recover_incomplete_commits) rolls each
+        forward or back; ``older_than_ms`` keeps live in-flight writers out
+        of the sweep."""
+        sql = (
+            "SELECT table_id, partition_desc, commit_id, file_ops, commit_op,"
+            " committed, timestamp, domain FROM data_commit_info WHERE committed=0"
+        )
+        params: list = []
+        if table_id is not None:
+            sql += " AND table_id=?"
+            params.append(table_id)
+        if older_than_ms is not None:
+            sql += " AND timestamp<=?"
+            params.append(older_than_ms)
+        rows = self._exec(self._conn(), sql, tuple(params)).fetchall()
+        return [self._row_to_commit(r) for r in rows]
+
     def delete_data_commit_info(self, table_id: str, partition_desc: str, commit_ids: list[str]) -> None:
         if not commit_ids:
             return

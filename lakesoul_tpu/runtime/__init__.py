@@ -10,7 +10,13 @@ Three pieces (see ARCHITECTURE.md "Runtime"):
   queues, backpressure, deterministic output order, exception propagation,
   cooperative cancellation, and per-run deadlines.
 - :mod:`lakesoul_tpu.runtime.faults` — ``LAKESOUL_FAULTS=stage:p`` fault
-  injection into any stage for robustness tests.
+  injection into any pipeline stage or object-store call for robustness
+  tests (kinds: error, flaky, delay, hang, truncate).
+- :mod:`lakesoul_tpu.runtime.resilience` — the shared failure policy:
+  transient/permanent taxonomy, :class:`RetryPolicy` (seeded-jitter
+  backoff + deadlines), :class:`CircuitBreaker`, and
+  :class:`AdmissionController` (bounded in-flight + queue, typed
+  ``OverloadedError`` shedding).
 
 Scan units decode through it in parallel with MOR merge overlapped
 (io/reader.py, catalog.py), the JAX loader prefetches through it
@@ -33,17 +39,27 @@ from lakesoul_tpu.runtime.pool import (
     get_pool,
     shutdown_pool,
 )
+from lakesoul_tpu.runtime.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    RetryPolicy,
+    is_transient,
+)
 
 __all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
     "DeadlineExceeded",
     "FaultInjected",
     "FaultSpec",
     "Pipeline",
     "PipelineCancelled",
     "PipelineIterator",
+    "RetryPolicy",
     "WorkerPool",
     "default_pool_size",
     "get_pool",
+    "is_transient",
     "pipeline",
     "shutdown_pool",
 ]

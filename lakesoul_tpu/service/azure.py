@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from datetime import datetime, timezone
 from urllib.parse import quote
 
+from lakesoul_tpu.runtime.resilience import RetryPolicy
 from lakesoul_tpu.service.s3_upstream import DnsDiscovery, connect_backend
 
 logger = logging.getLogger(__name__)
@@ -114,7 +115,8 @@ class AzureUpstreamConfig:
     port: int | None = None
     connect_timeout_s: float = 3.0
     refresh_interval_s: float = 30.0
-    retry_down_s: float = 10.0
+    # None = shared resilience default (LAKESOUL_RETRY_DOWN_S, 10 s)
+    retry_down_s: float | None = None
 
 
 class AzureUpstream:
@@ -200,10 +202,19 @@ class AzureUpstream:
         )
         if body_iter is not None:
             retries = 0  # a consumed stream cannot be replayed
-        last_err: Exception | None = None
-        for _ in range(retries + 1):
+
+        # same failover shape as S3Upstream.request: next healthy backend
+        # per attempt, per-backend circuits via the discovery
+        def attempt():
             ip = self.discovery.pick()
-            conn = self._connect(ip)
+            try:
+                # connect INSIDE the reporting scope: a refused/timed-out
+                # TCP connect must open that backend's circuit too
+                conn = self._connect(ip)
+            except OSError as e:
+                self.discovery.report_failure(ip)
+                logger.warning("azure upstream connect to %s failed: %s", ip, e)
+                raise
             try:
                 conn.request(
                     method,
@@ -213,10 +224,24 @@ class AzureUpstream:
                 )
                 resp = conn.getresponse()
                 resp._proxy_conn = conn  # keep alive while streaming
-                return resp.status, dict(resp.getheaders()), resp
             except OSError as e:
                 conn.close()
                 self.discovery.report_failure(ip)
-                last_err = e
-                logger.warning("azure upstream %s %s via %s failed: %s", method, key, ip, e)
-        raise OSError(f"all azure backends failed for {method} {key}: {last_err}")
+                logger.warning(
+                    "azure upstream %s %s via %s failed: %s", method, key, ip, e
+                )
+                raise
+            self.discovery.report_success(ip)
+            return resp
+
+        policy = RetryPolicy(
+            max_attempts=retries + 1, base_delay_s=0.0, jitter=0.0,
+            classify=lambda e: isinstance(e, OSError),
+        )
+        try:
+            resp = policy.run(attempt, op="proxy.upstream")
+        except OSError as e:
+            raise OSError(
+                f"all azure backends failed for {method} {key}: {e}"
+            ) from e
+        return resp.status, dict(resp.getheaders()), resp

@@ -24,6 +24,7 @@ response headers)."""
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import threading
 
@@ -31,9 +32,10 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from lakesoul_tpu.errors import LakeSoulError, RBACError
+from lakesoul_tpu.errors import LakeSoulError, OverloadedError, RBACError
 from lakesoul_tpu.io.filters import Filter
 from lakesoul_tpu.obs import StreamMetrics, sanitize_trace_id, span
+from lakesoul_tpu.runtime.resilience import AdmissionController
 from lakesoul_tpu.service.jwt import Claims, JwtServer, UserRegistry
 from lakesoul_tpu.service.rbac import RbacVerifier
 
@@ -126,6 +128,47 @@ class _AuthMiddleware(flight.ServerMiddleware):
         return {}
 
 
+class _StreamSlot:
+    """Admission-slot ownership token for a lazily-delivered stream.
+
+    ``do_get`` acquires the slot, but the expensive work of the JSON scan
+    path runs inside the ``GeneratorStream`` AFTER the handler returns — so
+    releasing on return would let any number of streams decode concurrently
+    and the admission bound would cover only the cheap planning prefix.
+    Instead the handler calls :meth:`transfer` as it hands the lazy stream
+    back and the stream's generator calls :meth:`release` when delivery
+    finishes (or the client disconnects); eager handlers (flight_sql's
+    materialized results) never transfer and ``do_get`` releases on return.
+    ``release`` is idempotent — the generator and any error path may both
+    reach it."""
+
+    def __init__(self, admission):
+        self._admission = admission
+        self._guard = threading.Lock()
+        self._released = False
+        self.transferred = False
+
+    def transfer(self) -> None:
+        self.transferred = True
+
+    def release(self) -> None:
+        with self._guard:
+            if self._released:
+                return
+            self._released = True
+        self._admission.release()
+
+    def __del__(self):
+        # backstop: a transferred slot whose stream was dropped before the
+        # generator ever STARTED (client vanished pre-first-batch) has no
+        # finally to run — free the slot when the stream is collected
+        if self.transferred:
+            try:
+                self.release()
+            except Exception:
+                pass
+
+
 class LakeSoulFlightServer(flight.FlightServerBase):
     def __init__(
         self,
@@ -133,12 +176,24 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         location: str = "grpc://127.0.0.1:0",
         *,
         jwt_secret: str | None = None,
+        max_inflight: int | None = None,
+        max_queue: int | None = None,
     ):
         self.catalog = catalog
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
         self.metrics = StreamMetrics()
+        # bounded in-flight + queue for EVERY data-plane handler
+        # (do_get/do_put/do_action): beyond both bounds clients get Flight
+        # UNAVAILABLE instead of an unbounded server-side backlog
+        # (LAKESOUL_ADMISSION_MAX_INFLIGHT / _MAX_QUEUE when args None)
+        self.admission = AdmissionController(
+            "flight", max_inflight=max_inflight, max_queue=max_queue
+        )
+        # per-handler-thread slot token: do_get hands its admission slot to
+        # the lazy stream it returns (see _StreamSlot)
+        self._stream_slots = threading.local()
         super().__init__(
             location,
             middleware={
@@ -146,6 +201,23 @@ class LakeSoulFlightServer(flight.FlightServerBase):
                 "trace": _TraceMiddlewareFactory(),
             },
         )
+
+    # ------------------------------------------------------------- admission
+    def _current_stream_slot(self):
+        return getattr(self._stream_slots, "current", None)
+
+    @contextlib.contextmanager
+    def _admitted(self):
+        """Admission-gate a handler: a typed shed (OverloadedError) becomes
+        Flight UNAVAILABLE so well-behaved clients back off and retry."""
+        try:
+            self.admission.acquire()
+        except OverloadedError as e:
+            raise flight.FlightUnavailableError(str(e)) from e
+        try:
+            yield
+        finally:
+            self.admission.release()
 
     # ----------------------------------------------------------------- trace
     def _span(self, context, name: str, **attrs):
@@ -228,6 +300,25 @@ class LakeSoulFlightServer(flight.FlightServerBase):
 
     # ----------------------------------------------------------------- DoGet
     def do_get(self, context, ticket):
+        # slot ownership may be TRANSFERRED to the returned stream (lazy
+        # scan delivery must stay inside the admission bound); released
+        # here only when the handler kept it (eager results, errors)
+        try:
+            self.admission.acquire()
+        except OverloadedError as e:
+            raise flight.FlightUnavailableError(str(e)) from e
+        slot = _StreamSlot(self.admission)
+        self._stream_slots.current = slot
+        try:
+            return self._do_get(context, ticket)
+        finally:
+            self._stream_slots.current = None
+            if not slot.transferred:
+                slot.release()
+
+    def _do_get(self, context, ticket):
+        """Ungated handler body — subclasses override THIS (the admission
+        gate wraps once at the public entry, never twice)."""
         with self._span(context, "flight.do_get") as sp:
             return self._do_get_json(context, ticket, sp.trace_id)
 
@@ -253,6 +344,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
 
         metrics = self.metrics
         metrics.add(active_get_streams=1, total_get_streams=1)
+        slot = self._current_stream_slot()
 
         def gen():
             # the stream outlives the do_get call: its own DETACHED span
@@ -268,15 +360,24 @@ class LakeSoulFlightServer(flight.FlightServerBase):
                         yield batch
             finally:
                 metrics.add(active_get_streams=-1)
+                if slot is not None:
+                    slot.release()
 
         # stream lazily with the table schema (projection-aware)
         out_schema = table.schema
         if req.get("columns"):
             out_schema = pa.schema([out_schema.field(c) for c in req["columns"]])
-        return flight.GeneratorStream(out_schema, gen())
+        stream = flight.GeneratorStream(out_schema, gen())
+        if slot is not None:
+            slot.transfer()
+        return stream
 
     # ----------------------------------------------------------------- DoPut
     def do_put(self, context, descriptor, reader, writer):
+        with self._admitted():
+            return self._do_put(context, descriptor, reader, writer)
+
+    def _do_put(self, context, descriptor, reader, writer):
         with self._span(context, "flight.do_put"):
             return self._do_put_json(context, descriptor, reader, writer)
 
@@ -325,6 +426,10 @@ class LakeSoulFlightServer(flight.FlightServerBase):
 
     # --------------------------------------------------------------- actions
     def do_action(self, context, action):
+        with self._admitted():
+            return self._do_action(context, action)
+
+    def _do_action(self, context, action):
         with self._span(context, "flight.do_action", action=action.type):
             return self._do_action_json(context, action)
 

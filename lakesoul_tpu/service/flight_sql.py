@@ -650,10 +650,11 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         result = self._descriptor_result(context, name, msg)
         return flight.SchemaResult(result.schema)
 
-    def do_get(self, context, ticket):
+    def _do_get(self, context, ticket):
+        # admission is taken once by the base do_get; this is the ungated body
         name, msg = _unpack(ticket.ticket)
         if name is None:
-            return super().do_get(context, ticket)
+            return super()._do_get(context, ticket)
         with self._span(context, "flightsql.do_get", command=name):
             if name == "TicketStatementQuery":
                 result = self._take_result(msg.statement_handle)
@@ -667,12 +668,12 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             )
             return flight.RecordBatchStream(result)
 
-    def do_put(self, context, descriptor, reader, writer):
+    def _do_put(self, context, descriptor, reader, writer):
         name, msg = (None, None)
         if descriptor.command:
             name, msg = _unpack(descriptor.command)
         if name is None:
-            return super().do_put(context, descriptor, reader, writer)
+            return super()._do_put(context, descriptor, reader, writer)
         with self._span(context, "flightsql.do_put", command=name):
             return self._do_put_sql(context, name, msg, reader, writer)
 
@@ -879,7 +880,7 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
         return rows
 
     # --------------------------------------------------------------- actions
-    def do_action(self, context, action):
+    def _do_action(self, context, action):
         if action.type == "BeginTransaction":
             return self._begin_transaction()
         if action.type == "EndTransaction":
@@ -901,7 +902,7 @@ class LakeSoulFlightSqlServer(LakeSoulFlightServer):
             if msg is not None:
                 self._prepared.pop(msg.prepared_statement_handle, None)
             return []
-        return super().do_action(context, action)
+        return super()._do_action(context, action)
 
     def _create_prepared(self, context, msg):
         from lakesoul_tpu.sql.parser import Select, SqlError, parse as parse_sql

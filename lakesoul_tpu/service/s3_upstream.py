@@ -22,6 +22,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from lakesoul_tpu.runtime.resilience import CircuitBreaker, RetryPolicy
 from lakesoul_tpu.service import sigv4
 
 logger = logging.getLogger("lakesoul_tpu.service.s3_upstream")
@@ -83,9 +84,10 @@ class S3UpstreamConfig:
     secret_key: str
     region: str = "us-east-1"
     session_token: str | None = None
-    # discovery knobs
+    # discovery knobs; retry_down_s None = shared resilience default
+    # (LAKESOUL_RETRY_DOWN_S, 10 s)
     refresh_interval_s: float = 30.0
-    retry_down_s: float = 10.0
+    retry_down_s: float | None = None
     connect_timeout_s: float = 5.0
     port: int | None = None  # derived from endpoint when None
 
@@ -95,8 +97,13 @@ class DnsDiscovery:
 
     ``resolver(host, port) -> list[ip]`` and ``health_check(ip, port) ->
     bool`` are injectable; defaults use getaddrinfo and a TCP connect.
-    Failed backends are marked down for ``retry_down_s`` (report_failure),
-    and the resolution refreshes every ``refresh_interval_s``."""
+    Per-backend failure handling is a :class:`CircuitBreaker` each
+    (replacing the hand-rolled down-marking): one failure opens the
+    backend's circuit for ``retry_down_s`` (``LAKESOUL_RETRY_DOWN_S`` when
+    None), after which it half-opens for a probe; a reported success
+    closes it.  The host-level worst state is published as
+    ``lakesoul_circuit_state{circuit=<host>}``.  Resolution refreshes
+    every ``refresh_interval_s``."""
 
     def __init__(
         self,
@@ -106,24 +113,64 @@ class DnsDiscovery:
         resolver=None,
         health_check=None,
         refresh_interval_s: float = 30.0,
-        retry_down_s: float = 10.0,
+        retry_down_s: float | None = None,
         connect_timeout_s: float = 5.0,
         clock=time.monotonic,
     ):
+        from lakesoul_tpu.runtime.resilience import default_retry_down_s
+
         self.host = host
         self.port = port
         self._resolver = resolver or self._dns_resolve
         self._health = health_check  # None: health = TCP connect on refresh
         self._refresh_s = refresh_interval_s
-        self._retry_down_s = retry_down_s
+        self._retry_down_s = (
+            default_retry_down_s() if retry_down_s is None else float(retry_down_s)
+        )
         self._timeout = connect_timeout_s
         self._clock = clock
         self._lock = threading.Lock()
         self._backends: list[str] = []
-        self._down_until: dict[str, float] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
         self._rr = 0
         self._last_refresh = float("-inf")
         self._refreshing = False
+
+    def _breaker(self, ip: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(ip)
+            if b is None:
+                # name=None: per-IP labels would be unbounded cardinality —
+                # the host-level gauge is published by _publish_state
+                b = self._breakers[ip] = CircuitBreaker(
+                    failure_threshold=1,
+                    reset_timeout_s=self._retry_down_s,
+                    clock=self._clock,
+                )
+            return b
+
+    def _publish_state(self) -> None:
+        from lakesoul_tpu.obs import registry
+
+        with self._lock:
+            worst = max(
+                (b.state for b in self._breakers.values()),
+                default=CircuitBreaker.CLOSED,
+            )
+        registry().gauge("lakesoul_circuit_state", circuit=self.host).set(worst)
+
+    @property
+    def _down_until(self) -> dict[str, float]:
+        """Compat view of the old down-marking table: ip → clock value when
+        its OPEN circuit starts probing again."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        out = {}
+        for ip, b in breakers.items():
+            until = b.open_until()
+            if until is not None:
+                out[ip] = until
+        return out
 
     def _dns_resolve(self, host: str, port: int) -> list[str]:
         infos = socket.getaddrinfo(host, port, type=socket.SOCK_STREAM)
@@ -192,23 +239,38 @@ class DnsDiscovery:
                 break
             time.sleep(0.02)
         with self._lock:
-            now = self._clock()
-            candidates = [
-                ip for ip in self._backends if self._down_until.get(ip, 0) <= now
-            ]
-            if not candidates and self._backends:
-                # everything marked down: fail open on the full set rather
-                # than refusing service
-                candidates = self._backends
-            if not candidates:
-                raise OSError(f"no backends for {self.host}")
+            backends = list(self._backends)
+            breakers = dict(self._breakers)
+        # breaker state transitions are clock-driven; OPEN circuits sit
+        # out, HALF_OPEN ones rejoin the rotation as probes
+        candidates = [
+            ip
+            for ip in backends
+            if (b := breakers.get(ip)) is None or b.state != CircuitBreaker.OPEN
+        ]
+        if not candidates and backends:
+            # everything circuit-broken: fail open on the full set rather
+            # than refusing service
+            candidates = backends
+        if not candidates:
+            raise OSError(f"no backends for {self.host}")
+        with self._lock:
             self._rr = (self._rr + 1) % len(candidates)
             return candidates[self._rr]
 
     def report_failure(self, ip: str) -> None:
+        self._breaker(ip).record_failure()
+        self._publish_state()
+        logger.warning("backend %s circuit opened for %.0fs", ip, self._retry_down_s)
+
+    def report_success(self, ip: str) -> None:
+        """Close the backend's circuit after a successful request (a
+        half-open probe that worked rejoins the pool for good)."""
         with self._lock:
-            self._down_until[ip] = self._clock() + self._retry_down_s
-        logger.warning("backend %s marked down for %.0fs", ip, self._retry_down_s)
+            b = self._breakers.get(ip)
+        if b is not None and b.state != CircuitBreaker.CLOSED:
+            b.record_success()
+            self._publish_state()
 
     def backends(self) -> list[str]:
         self._maybe_refresh()
@@ -297,10 +359,21 @@ class S3Upstream:
                 raise ValueError("body_iter requires content_length")
             headers["Content-Length"] = str(content_length)
             retries = 0  # a consumed stream cannot be replayed
-        last_err: Exception | None = None
-        for _ in range(retries + 1):
+
+        # failover via the shared policy: each attempt picks the next
+        # healthy backend (no backoff — a DIFFERENT backend is the remedy),
+        # failures open that backend's circuit, success closes it
+        def attempt():
             ip = self.discovery.pick()
-            conn = self._connect(ip)
+            try:
+                # connect INSIDE the reporting scope: refused/timed-out TCP
+                # connects are the most common backend-down mode and must
+                # open that backend's circuit like any request failure
+                conn = self._connect(ip)
+            except OSError as e:
+                self.discovery.report_failure(ip)
+                logger.warning("upstream connect to %s failed: %s", ip, e)
+                raise
             try:
                 wire_path = f"{path}?{sigv4.canonical_query(query)}" if query else path
                 conn.request(
@@ -310,10 +383,22 @@ class S3Upstream:
                 )
                 resp = conn.getresponse()
                 resp._proxy_conn = conn  # keep alive while streaming
-                return resp.status, dict(resp.getheaders()), resp
             except OSError as e:
                 conn.close()
                 self.discovery.report_failure(ip)
-                last_err = e
                 logger.warning("upstream %s %s via %s failed: %s", method, key, ip, e)
-        raise OSError(f"all upstream backends failed for {method} {key}: {last_err}")
+                raise
+            self.discovery.report_success(ip)
+            return resp
+
+        policy = RetryPolicy(
+            max_attempts=retries + 1, base_delay_s=0.0, jitter=0.0,
+            classify=lambda e: isinstance(e, OSError),
+        )
+        try:
+            resp = policy.run(attempt, op="proxy.upstream")
+        except OSError as e:
+            raise OSError(
+                f"all upstream backends failed for {method} {key}: {e}"
+            ) from e
+        return resp.status, dict(resp.getheaders()), resp

@@ -107,62 +107,67 @@ class CheckpointedWriter:
         waves is healed by the replay.  Returns partitions committed."""
         files_by_partition = self._staged_files_by_partition()
         from lakesoul_tpu.errors import CommitConflictError
+        from lakesoul_tpu.runtime.resilience import RetryPolicy
 
         client = self.table.catalog.client
         info = self.table.info
         opts = self.table.io_config().object_store_options
-        last_conflict: Exception | None = None
-        for _ in range(5):
+
+        # a concurrent writer advancing a partition between our head read
+        # and the commit raises CommitConflictError; each attempt re-reads
+        # fresh heads and re-applies the whole replace
+        def attempt() -> int:
             heads = {
                 h.partition_desc: h
                 for h in client._select_partitions(info, None)
             }
-            try:
-                committed = 0
-                if files_by_partition:
-                    committed += len(client.commit_data_files(
-                        info,
-                        files_by_partition,
-                        CommitOp.UPDATE,
-                        commit_id_by_partition={
-                            desc: checkpoint_commit_id(info.table_id, desc, checkpoint_id)
-                            for desc in files_by_partition
-                        },
-                        read_partition_info=[
-                            heads[d] for d in files_by_partition if d in heads
-                        ],
-                        storage_options=opts,
-                    ))
-                stale = [
-                    d for d, h in heads.items()
-                    if d not in files_by_partition and h.snapshot
-                ]
-                if stale:
-                    committed += len(client.commit_data_files(
-                        info,
-                        {d: [] for d in stale},
-                        CommitOp.DELETE,
-                        commit_id_by_partition={
-                            d: checkpoint_commit_id(
-                                info.table_id, d, f"{checkpoint_id}:truncate"
-                            )
-                            for d in stale
-                        },
-                        # conflict detection on the DELETE wave too: a
-                        # concurrent writer advancing one of these
-                        # partitions between our head read and this commit
-                        # must raise CommitConflictError (and re-run the
-                        # replace against fresh heads) instead of being
-                        # silently wiped by the truncate
-                        read_partition_info=[heads[d] for d in stale],
-                        storage_options=opts,
-                    ))
-                return committed
-            except CommitConflictError as e:
-                # a concurrent writer advanced a partition between our head
-                # read and the commit — re-read and re-apply the replace
-                last_conflict = e
-        raise last_conflict  # type: ignore[misc]
+            committed = 0
+            if files_by_partition:
+                committed += len(client.commit_data_files(
+                    info,
+                    files_by_partition,
+                    CommitOp.UPDATE,
+                    commit_id_by_partition={
+                        desc: checkpoint_commit_id(info.table_id, desc, checkpoint_id)
+                        for desc in files_by_partition
+                    },
+                    read_partition_info=[
+                        heads[d] for d in files_by_partition if d in heads
+                    ],
+                    storage_options=opts,
+                ))
+            stale = [
+                d for d, h in heads.items()
+                if d not in files_by_partition and h.snapshot
+            ]
+            if stale:
+                committed += len(client.commit_data_files(
+                    info,
+                    {d: [] for d in stale},
+                    CommitOp.DELETE,
+                    commit_id_by_partition={
+                        d: checkpoint_commit_id(
+                            info.table_id, d, f"{checkpoint_id}:truncate"
+                        )
+                        for d in stale
+                    },
+                    # conflict detection on the DELETE wave too: a
+                    # concurrent writer advancing one of these
+                    # partitions between our head read and this commit
+                    # must raise CommitConflictError (and re-run the
+                    # replace against fresh heads) instead of being
+                    # silently wiped by the truncate
+                    read_partition_info=[heads[d] for d in stale],
+                    storage_options=opts,
+                ))
+            return committed
+
+        return RetryPolicy.from_env(
+            max_attempts=5,
+            base_delay_s=0.01,
+            max_delay_s=0.25,
+            classify=lambda e: isinstance(e, CommitConflictError),
+        ).run(attempt, op="cdc.checkpoint_replace")
 
     def adopt_staged(self, other: "CheckpointedWriter | None") -> None:
         """Take over another checkpointed writer's staged-but-uncommitted

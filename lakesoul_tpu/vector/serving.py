@@ -17,6 +17,16 @@ side) — this endpoint is the TPU-native replacement for that role.
     fut = ep.submit(q); ids, d = fut.result()   # async
     ep.stats()                         # requests / batches / mean batch size
     ep.close()
+
+Overload: the pending queue is bounded (``max_pending``, default
+4 × ``max_batch``); beyond it :meth:`submit` raises a typed
+:class:`~lakesoul_tpu.errors.OverloadedError` immediately — memory stays
+bounded under a client stampede and callers get a retryable signal (the
+Flight gateway maps it to UNAVAILABLE).  Per-request latency
+(submit → result) lands in the shared obs registry as the
+``lakesoul_ann_request_seconds`` histogram next to
+``lakesoul_ann_requests_total`` / ``lakesoul_ann_rejected_total``, so
+p50/p99 under load are one registry snapshot away.
 """
 
 from __future__ import annotations
@@ -27,6 +37,8 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from lakesoul_tpu.errors import OverloadedError
+from lakesoul_tpu.obs import registry
 from lakesoul_tpu.vector.index import SearchParams
 
 
@@ -40,6 +52,7 @@ class AnnEndpoint:
         *,
         max_batch: int = 256,
         max_wait_ms: float = 2.0,
+        max_pending: int | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -47,19 +60,29 @@ class AnnEndpoint:
         self.params = params or SearchParams()
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        self.max_pending = (
+            4 * max_batch if max_pending is None else max(1, int(max_pending))
+        )
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._pending: list[tuple[np.ndarray, Future]] = []
+        self._pending: list[tuple[np.ndarray, Future, float]] = []
         self._closed = False
         self._n_requests = 0
+        self._n_rejected = 0
         self._n_batches = 0
         self._n_batched_requests = 0
+        reg = registry()
+        self._c_requests = reg.counter("lakesoul_ann_requests_total")
+        self._c_rejected = reg.counter("lakesoul_ann_rejected_total")
+        self._h_latency = reg.histogram("lakesoul_ann_request_seconds")
+        self._g_pending = reg.gauge("lakesoul_ann_pending")
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     # ------------------------------------------------------------------ API
     def submit(self, query: np.ndarray) -> Future:
-        """Enqueue one query; the Future resolves to (ids, dists)."""
+        """Enqueue one query; the Future resolves to (ids, dists).  Raises
+        :class:`OverloadedError` when the bounded pending queue is full."""
         q = np.asarray(query, dtype=np.float32)
         if q.ndim != 1:
             raise ValueError("submit() takes a single [d] query")
@@ -72,8 +95,17 @@ class AnnEndpoint:
         with self._wake:
             if self._closed:
                 raise RuntimeError("endpoint is closed")
-            self._pending.append((q, fut))
+            if len(self._pending) >= self.max_pending:
+                self._n_rejected += 1
+                self._c_rejected.inc()
+                raise OverloadedError(
+                    f"ann endpoint overloaded ({len(self._pending)} queued,"
+                    f" bound {self.max_pending}); retry later"
+                )
+            self._pending.append((q, fut, time.monotonic()))
             self._n_requests += 1
+            self._c_requests.inc()
+            self._g_pending.inc()
             self._wake.notify()
         return fut
 
@@ -85,6 +117,9 @@ class AnnEndpoint:
         with self._lock:
             return {
                 "requests": self._n_requests,
+                "rejected": self._n_rejected,
+                "pending": len(self._pending),
+                "max_pending": self.max_pending,
                 "batches": self._n_batches,
                 "mean_batch": (
                     self._n_batched_requests / self._n_batches if self._n_batches else 0.0
@@ -121,6 +156,7 @@ class AnnEndpoint:
                 self._wake.wait(remaining)
             batch = self._pending[: self.max_batch]
             del self._pending[: self.max_batch]
+            self._g_pending.dec(len(batch))
             return batch
 
     def _run(self) -> None:
@@ -131,10 +167,10 @@ class AnnEndpoint:
             # everything below is fenced: the worker must survive ANY per-
             # batch failure (a dead worker would hang every future request)
             try:
-                queries = np.stack([q for q, _ in batch])
+                queries = np.stack([q for q, _, _ in batch])
                 ids, dists = self.index.batch_search(queries, self.params)
             except Exception as e:  # fan the failure out to every waiter
-                for _, fut in batch:
+                for _, fut, _ in batch:
                     try:
                         fut.set_exception(e)
                     except Exception:  # cancelled/raced: nobody is waiting
@@ -143,7 +179,9 @@ class AnnEndpoint:
             with self._lock:
                 self._n_batches += 1
                 self._n_batched_requests += len(batch)
-            for i, (_, fut) in enumerate(batch):
+            done = time.monotonic()
+            for i, (_, fut, submitted) in enumerate(batch):
+                self._h_latency.observe(done - submitted)
                 try:
                     fut.set_result((ids[i], dists[i]))
                 except Exception:  # cancelled between check and set: ignore

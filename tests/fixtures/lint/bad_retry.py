@@ -1,0 +1,61 @@
+"""Seeded ad-hoc-retry violations: the hand-rolled retry dialects the
+resilience layer (runtime/resilience.py) replaced — a for-range loop that
+swallows the error to go around again, and sleep-based backoff inside it."""
+
+import time
+
+
+def commit_with_handrolled_retry(do_commit):
+    last = None
+    for attempt in range(5):  # SEED: ad-hoc-retry (retry loop)
+        try:
+            return do_commit()
+        except OSError as e:
+            last = e
+            time.sleep(0.01 * (attempt + 1))  # SEED: ad-hoc-retry (sleep backoff)
+    raise last
+
+
+def fixed_attempts_swallowing(compact):
+    for _ in range(3):  # SEED: ad-hoc-retry (retry loop)
+        try:
+            compact()
+            return True
+        except ValueError:
+            pass
+    return False
+
+
+def reraising_handler_is_fine(fetch):
+    for _ in range(2):  # allowed: the handler always re-raises
+        try:
+            return fetch()
+        except ValueError:
+            raise
+
+
+def reraising_handler_with_sleep_is_fine(probe):
+    # a bounded poll: the handler re-raises, so the loop never retries an
+    # error — the sleep is a poll cadence, not hand-rolled backoff
+    for _ in range(50):  # allowed: no exception swallowing
+        try:
+            if probe():
+                return True
+        except ValueError:
+            raise
+        time.sleep(0.01)  # allowed: not inside a retry loop
+    return False
+
+
+def while_poll_is_fine(ready):
+    # condition polls are not retry loops (no exception swallowing)
+    while not ready():
+        time.sleep(0.01)
+    return True
+
+
+def plain_range_loop_is_fine(items):
+    total = 0
+    for i in range(len(items)):  # allowed: no try/except at all
+        total += items[i]
+    return total

@@ -87,6 +87,37 @@ def test_stage_nondeterminism_rule():
     assert lint_fixture("bad_stage.py") == []
 
 
+def test_ad_hoc_retry_rule_line_exact():
+    """The 17th rule: for-range retry loops (swallowed exceptions) and
+    sleep-based backoff are flagged line-exactly; re-raising handlers,
+    while-polls, and plain range loops stay silent."""
+    found = [f for f in lint_fixture("bad_retry.py") if f.rule == "ad-hoc-retry"]
+    assert len(found) == 3, found
+    assert_seed_lines(found, "bad_retry.py", "ad-hoc-retry")
+    messages = sorted(f.message for f in found)
+    assert sum(m.startswith("for-range loop") for m in messages) == 2
+    assert sum(m.startswith("sleep-based backoff") for m in messages) == 1
+
+
+def test_ad_hoc_retry_rule_exempts_resilience_module(tmp_path):
+    """The one legal retry loop lives in runtime/resilience.py — the same
+    shape there must not be flagged."""
+    mod = tmp_path / "runtime"
+    mod.mkdir()
+    target = mod / "resilience.py"
+    target.write_text(
+        "import time\n"
+        "def run(fn):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except OSError:\n"
+        "            time.sleep(0.01)\n"
+    )
+    found, _ = run([target], root=tmp_path)
+    assert [f for f in found if f.rule == "ad-hoc-retry"] == []
+
+
 def test_unclosed_reader_rule_flags_each_leak_tier_only():
     found = [f for f in lint_fixture("bad_resources.py") if f.rule == "unclosed-reader"]
     src = (LINT / "bad_resources.py").read_text().splitlines()
@@ -284,7 +315,7 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 16 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 17 and "rbac-gate-reachability" in rule_ids
     assert "pallas-blockspec" in rule_ids
     for r in driver["rules"]:
         assert r["shortDescription"]["text"]

@@ -1,0 +1,762 @@
+"""Chaos suite for the resilience layer (runtime/resilience.py).
+
+Proves the failure-mode guarantees the subsystem exists for:
+
+- RetryPolicy: deterministic seeded backoff, taxonomy-driven classification,
+  total deadline, obs counters.
+- CircuitBreaker: closed/open/half-open transitions on an injected clock.
+- AdmissionController: bounded in-flight + queue, typed OverloadedError.
+- Scans under p=0.3 injected transient object-store faults return
+  byte-identical batches vs a clean run (retries absorb the chaos).
+- A writer killed mid-commit (between metadata phase 1 and phase 2) leaves
+  no partial state visible, and the next catalog open rolls the commit
+  forward (staged files intact) or back (staged files lost).
+- 64 concurrent ANN clients against a full admission queue get typed
+  rejections with bounded queue depth and p50/p99 latency in the obs
+  registry; the Flight gateway maps the shed to UNAVAILABLE.
+- FaultSpec parsing edge cases and clear()-vs-env semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.errors import (
+    CircuitOpenError,
+    ConfigError,
+    OverloadedError,
+    RBACError,
+)
+from lakesoul_tpu.meta.client import MetaDataClient
+from lakesoul_tpu.obs import registry
+from lakesoul_tpu.runtime import faults
+from lakesoul_tpu.runtime.faults import FaultInjected, FaultSpec
+from lakesoul_tpu.runtime.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    RetryPolicy,
+    is_transient,
+)
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name_with_labels: str) -> float:
+    return registry().snapshot().get(name_with_labels, 0)
+
+
+# ------------------------------------------------------------------ taxonomy
+
+
+class TestTaxonomy:
+    def test_transient_families(self):
+        assert is_transient(ConnectionError("blip"))
+        assert is_transient(TimeoutError())
+        assert is_transient(OSError("socket reset"))
+        assert is_transient(FaultInjected("chaos"))
+        assert is_transient(OverloadedError("shed"))
+
+    def test_permanent_families(self):
+        assert not is_transient(FileNotFoundError("gone"))
+        assert not is_transient(PermissionError("denied"))
+        assert not is_transient(ValueError("bad input"))
+        assert not is_transient(ConfigError("bad knob"))
+        assert not is_transient(RBACError("no"))
+        # retrying through an open breaker would defeat the breaker
+        assert not is_transient(CircuitOpenError("open"))
+
+
+# --------------------------------------------------------------- RetryPolicy
+
+
+class TestRetryPolicy:
+    def test_delays_are_deterministic_per_seed(self):
+        a = RetryPolicy(max_attempts=5, seed=7).delays()
+        b = RetryPolicy(max_attempts=5, seed=7).delays()
+        c = RetryPolicy(max_attempts=5, seed=8).delays()
+        assert a == b
+        assert a != c
+        assert len(a) == 4
+        # exponential shape under the jitter envelope
+        base = RetryPolicy(max_attempts=5, seed=7)
+        for i, d in enumerate(a):
+            lo = min(base.max_delay_s, base.base_delay_s * base.multiplier**i)
+            assert lo <= d <= lo * (1 + base.jitter)
+
+    def test_transient_retries_then_succeeds(self):
+        calls = []
+
+        def flappy():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        before = _counter('lakesoul_retry_attempts_total{op="t.flappy"}')
+        out = RetryPolicy(max_attempts=5, base_delay_s=0.0, jitter=0.0).run(
+            flappy, op="t.flappy"
+        )
+        assert out == "ok" and len(calls) == 3
+        assert _counter('lakesoul_retry_attempts_total{op="t.flappy"}') == before + 2
+
+    def test_permanent_error_raises_immediately(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=5, base_delay_s=0.0).run(broken, op="t.perm")
+        assert len(calls) == 1
+
+    def test_exhaustion_raises_last_and_counts(self):
+        before = _counter('lakesoul_retry_exhausted_total{op="t.exhaust"}')
+
+        def dead():
+            raise ConnectionError("still down")
+
+        with pytest.raises(ConnectionError, match="still down"):
+            RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0).run(
+                dead, op="t.exhaust"
+            )
+        assert _counter('lakesoul_retry_exhausted_total{op="t.exhaust"}') == before + 1
+
+    def test_total_deadline_cuts_backoff_short(self):
+        sleeps = []
+
+        def dead():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            RetryPolicy(
+                max_attempts=10, base_delay_s=5.0, jitter=0.0, total_deadline_s=0.01
+            ).run(dead, op="t.deadline", sleep=sleeps.append)
+        assert sleeps == []  # the first 5 s backoff would cross the deadline
+
+    def test_attempt_timeout_reaches_callable(self):
+        seen = []
+
+        def probe(timeout=None):
+            seen.append(timeout)
+            return "ok"
+
+        RetryPolicy(max_attempts=2, attempt_timeout_s=1.5).run(probe, op="t.budget")
+        assert seen == [1.5]
+
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("LAKESOUL_RETRY_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("LAKESOUL_RETRY_BASE_S", "0.25")
+        monkeypatch.setenv("LAKESOUL_RETRY_SEED", "42")
+        p = RetryPolicy.from_env()
+        assert p.max_attempts == 7 and p.base_delay_s == 0.25 and p.seed == 42
+        q = RetryPolicy.from_env(max_attempts=2)
+        assert q.max_attempts == 2 and q.base_delay_s == 0.25
+
+    def test_default_seed_decorrelates_threads(self, monkeypatch):
+        # unset env seed → competing retriers must NOT share a backoff
+        # schedule (two writers losing the same commit race would otherwise
+        # collide again on every attempt), while each thread's own schedule
+        # stays deterministic
+        monkeypatch.delenv("LAKESOUL_RETRY_SEED", raising=False)
+        policy = RetryPolicy.from_env(max_attempts=6)
+        assert policy.seed is None
+        schedules: dict[int, tuple] = {}
+        # both threads must be ALIVE simultaneously: thread idents are
+        # reused after death, and a reused ident would legitimately share
+        # the schedule
+        barrier = threading.Barrier(2)
+
+        def grab(k):
+            barrier.wait()
+            schedules[k] = (tuple(policy.delays()), tuple(policy.delays()))
+
+        threads = [threading.Thread(target=grab, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        a, b = schedules[0], schedules[1]
+        assert a[0] == a[1] and b[0] == b[1]  # per-thread deterministic
+        assert a[0] != b[0]  # decorrelated across threads
+
+
+# ------------------------------------------------------------ CircuitBreaker
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        now = [0.0]
+        b = CircuitBreaker(
+            "t.breaker", failure_threshold=2, reset_timeout_s=10.0,
+            clock=lambda: now[0],
+        )
+        assert b.state == CircuitBreaker.CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == CircuitBreaker.CLOSED  # below threshold
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        assert not b.allow()
+        assert b.open_until() == pytest.approx(10.0)
+        with pytest.raises(CircuitOpenError):
+            b.call(lambda: "nope")
+        # reset timeout passes → half-open admits one probe
+        now[0] = 11.0
+        assert b.state == CircuitBreaker.HALF_OPEN
+        assert b.allow()        # the probe slot
+        assert not b.allow()    # concurrent second probe is rejected
+        b.record_success()
+        assert b.state == CircuitBreaker.CLOSED
+        # a half-open probe FAILURE re-opens for another timeout
+        b.record_failure()
+        b.record_failure()
+        now[0] = 22.0
+        assert b.state == CircuitBreaker.HALF_OPEN
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+
+    def test_state_gauge_published(self):
+        b = CircuitBreaker("t.gauge", failure_threshold=1, reset_timeout_s=99.0)
+        b.record_failure()
+        assert _counter('lakesoul_circuit_state{circuit="t.gauge"}') == 1
+        b.record_success()
+        assert _counter('lakesoul_circuit_state{circuit="t.gauge"}') == 0
+
+
+# ------------------------------------------- AdmissionController (unit level)
+
+
+class TestAdmissionController:
+    def test_rejects_beyond_queue_and_recovers(self):
+        gate = AdmissionController(
+            "t.gate", max_inflight=1, max_queue=1, queue_timeout_s=5.0
+        )
+        gate.acquire()  # slot taken
+        queued_in = threading.Event()
+        admitted = threading.Event()
+
+        def queued_caller():
+            queued_in.set()
+            with gate.admit():
+                admitted.set()
+
+        t = threading.Thread(target=queued_caller)
+        t.start()
+        queued_in.wait(2.0)
+        deadline = time.monotonic() + 2.0
+        while gate.snapshot()["waiting"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert gate.snapshot()["waiting"] == 1
+        # queue full: the next caller is shed with a typed error, now
+        before = _counter('lakesoul_admission_rejected_total{gate="t.gate"}')
+        with pytest.raises(OverloadedError):
+            gate.acquire()
+        assert _counter('lakesoul_admission_rejected_total{gate="t.gate"}') == before + 1
+        # releasing the slot admits the queued caller
+        gate.release()
+        assert admitted.wait(2.0)
+        t.join(2.0)
+        snap = gate.snapshot()
+        assert snap["inflight"] == 0 and snap["waiting"] == 0
+
+    def test_queue_wait_timeout_is_typed(self):
+        gate = AdmissionController(
+            "t.gate2", max_inflight=1, max_queue=4, queue_timeout_s=0.05
+        )
+        gate.acquire()
+        started = time.monotonic()
+        with pytest.raises(OverloadedError, match="queued"):
+            gate.acquire()
+        assert time.monotonic() - started < 2.0
+        gate.release()
+
+
+# ------------------------------------------------------- FaultSpec edge cases
+
+
+class TestFaultSpecParsing:
+    def test_new_kinds_parse(self):
+        assert FaultSpec.parse("s:0.5:flaky").kind == "flaky"
+        hang = FaultSpec.parse("s:1:hang")
+        assert hang.kind == "hang" and hang.seconds == 5.0
+        trunc = FaultSpec.parse("s:1:truncate:0.25")
+        assert trunc.kind == "truncate" and trunc.seconds == 0.25
+        assert FaultSpec.parse("s:1:truncate").seconds == 0.5
+
+    def test_bad_probability(self):
+        with pytest.raises(ValueError, match="not a number"):
+            FaultSpec.parse("s:abc")
+        with pytest.raises(ValueError, match="bad fault spec"):
+            FaultSpec.parse("s:1.5")
+        with pytest.raises(ValueError, match="bad fault spec"):
+            FaultSpec.parse("s:-0.1")
+
+    def test_unknown_kind(self):
+        with pytest.raises(ValueError, match="fault kind"):
+            FaultSpec.parse("s:0.5:explode")
+
+    def test_empty_stage(self):
+        with pytest.raises(ValueError, match="bad fault spec"):
+            FaultSpec.parse(":0.5")
+
+    def test_missing_probability(self):
+        with pytest.raises(ValueError, match="must be stage:probability"):
+            FaultSpec.parse("stageonly")
+
+    def test_bad_seconds_and_truncate_fraction(self):
+        with pytest.raises(ValueError, match="not a number"):
+            FaultSpec.parse("s:1:delay:soon")
+        with pytest.raises(ValueError, match="keep-fraction"):
+            FaultSpec.parse("s:1:truncate:1.5")
+
+    def test_clear_does_not_resurrect_env_specs(self, monkeypatch):
+        monkeypatch.setenv("LAKESOUL_FAULTS", "envstage:1.0")
+        monkeypatch.setattr(faults, "_ENV_LOADED", False)
+        monkeypatch.setattr(faults, "_SPECS", [])
+        monkeypatch.setattr(faults, "_ENABLED", False)
+        assert [s.stage for s in faults.active()] == ["envstage"]
+        faults.clear()
+        # the env var is still set, but a cleared config stays cleared
+        assert faults.active() == []
+        faults.maybe_inject("envstage")  # must not raise
+
+    def test_truncate_only_acts_on_bytes(self):
+        faults.install("chop:1.0:truncate:0.5")
+        faults.maybe_inject("chop")  # control-flow path: no effect
+        assert faults.filter_bytes("chop", b"12345678") == b"1234"
+        assert faults.filter_bytes("other", b"12345678") == b"12345678"
+
+
+# -------------------------------------------------- chaos: object-store scans
+
+
+class TestChaosScan:
+    @pytest.fixture()
+    def mem_table(self, tmp_path, monkeypatch):
+        # generous attempts so p=0.3 per-call chaos is absorbed with margin;
+        # tiny backoff keeps the test fast
+        monkeypatch.setenv("LAKESOUL_RETRY_MAX_ATTEMPTS", "10")
+        monkeypatch.setenv("LAKESOUL_RETRY_BASE_S", "0.001")
+        monkeypatch.setenv("LAKESOUL_RETRY_CAP_S", "0.005")
+        catalog = LakeSoulCatalog(
+            "memory://chaos-wh", db_path=str(tmp_path / "meta.db")
+        )
+        t = catalog.create_table("chaos", SCHEMA)
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            t.write_arrow(pa.table({
+                "id": np.arange(i * 1000, (i + 1) * 1000),
+                "v": rng.normal(size=1000),
+            }, schema=SCHEMA))
+        return t
+
+    def test_scan_under_transient_faults_is_byte_identical(self, mem_table):
+        clean = list(mem_table.scan().batch_size(2048).to_batches())
+        assert sum(len(b) for b in clean) == 6000
+        before_attempts = _counter(
+            'lakesoul_retry_attempts_total{op="object_store.open"}'
+        ) + _counter('lakesoul_retry_attempts_total{op="object_store.info"}')
+        faults.install("object_store.open:0.3:flaky")
+        faults.install("object_store.info:0.3:flaky")
+        faulted = list(mem_table.scan().batch_size(2048).to_batches())
+        assert len(faulted) == len(clean)
+        for a, b in zip(clean, faulted):
+            assert a.equals(b)  # byte-identical despite injected chaos
+        after_attempts = _counter(
+            'lakesoul_retry_attempts_total{op="object_store.open"}'
+        ) + _counter('lakesoul_retry_attempts_total{op="object_store.info"}')
+        assert after_attempts > before_attempts  # the chaos really fired
+
+    def test_truncated_reads_detected_and_exhausted(self, mem_table, monkeypatch):
+        monkeypatch.setenv("LAKESOUL_RETRY_MAX_ATTEMPTS", "2")
+        from lakesoul_tpu.io.object_store import filesystem_for
+
+        fs, p = filesystem_for("memory://chaos-wh/blob.bin")
+        fs.pipe_file(p, b"x" * 1024)
+        assert fs.cat_file(p) == b"x" * 1024
+        faults.install("object_store.cat_file:1.0:truncate:0.5")
+        # every attempt comes back short → detected (never returned) and,
+        # with the fault permanent, surfaced as the transient it models
+        with pytest.raises(ConnectionError, match="short read"):
+            fs.cat_file(p)
+
+    def test_flaky_cat_file_absorbed(self, mem_table):
+        from lakesoul_tpu.io.object_store import filesystem_for
+
+        fs, p = filesystem_for("memory://chaos-wh/blob2.bin")
+        fs.pipe_file(p, b"payload")
+        faults.install("object_store.cat_file:0.5:flaky")
+        for _ in range(8):
+            assert fs.cat_file(p) == b"payload"
+
+    def test_real_short_read_detected_and_retried(self, mem_table):
+        # a body cut mid-flight (not injected: the backend itself returns
+        # short for a range fully inside the object) must be detected by
+        # length and absorbed by a retry, never returned to the decoder
+        import fsspec
+
+        from lakesoul_tpu.io.object_store import ResilientFileSystem
+        from lakesoul_tpu.runtime.resilience import RetryPolicy
+
+        mem = fsspec.filesystem("memory")
+        mem.pipe_file("/sr/blob", b"x" * 1024)
+
+        class _CutOnce:
+            def __init__(self, inner):
+                self.inner = inner
+                self.cuts = 0
+
+            def cat_file(self, path, start=None, end=None, **kw):
+                out = self.inner.cat_file(path, start=start, end=end, **kw)
+                if self.cuts == 0:
+                    self.cuts += 1
+                    return out[: len(out) // 2]  # dropped connection mid-body
+                return out
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        fs = ResilientFileSystem(
+            _CutOnce(mem), RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        )
+        assert fs.cat_file("/sr/blob", start=0, end=512) == b"x" * 512
+        assert fs.target.cuts == 1  # the short body really happened
+        # a range overrunning EOF is legitimately short — no false positive
+        assert fs.cat_file("/sr/blob", start=1000, end=2048) == b"x" * 24
+        mem.rm("/sr", recursive=True)
+
+    def test_page_cache_fetch_fault_absorbed_in_stacked_config(
+        self, tmp_path, monkeypatch
+    ):
+        # `page_cache.fetch` chaos must be policy-absorbed in BOTH cache
+        # constructions: raw target (unit tests) and the production stack
+        # where CachedReadFileSystem sits above a ResilientFileSystem
+        import fsspec
+
+        from lakesoul_tpu.io.object_store import ResilientFileSystem
+        from lakesoul_tpu.io.page_cache import DiskPageCache
+
+        monkeypatch.setenv("LAKESOUL_RETRY_MAX_ATTEMPTS", "10")
+        monkeypatch.setenv("LAKESOUL_RETRY_BASE_S", "0.001")
+        monkeypatch.setenv("LAKESOUL_RETRY_CAP_S", "0.005")
+        mem = fsspec.filesystem("memory")
+        data = bytes(range(256)) * 512  # 128 KiB
+        mem.pipe_file("/rz/blob", data)
+        try:
+            faults.install("page_cache.fetch:0.4:flaky")
+            raw = DiskPageCache(str(tmp_path / "raw"), page_bytes=16 << 10)
+            assert raw.read_range(mem, "/rz/blob", 0, len(data)) == data
+            stacked_fs = ResilientFileSystem(mem, RetryPolicy.from_env())
+            stacked = DiskPageCache(str(tmp_path / "st"), page_bytes=16 << 10)
+            assert (
+                stacked.read_range(stacked_fs, "/rz/blob", 0, len(data)) == data
+            )
+        finally:
+            mem.rm("/rz", recursive=True)
+
+
+# -------------------------------------------- chaos: kill-subprocess-mid-commit
+
+_CHILD_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    import numpy as np
+    import pyarrow as pa
+    from lakesoul_tpu import LakeSoulCatalog
+
+    wh, db = sys.argv[1], sys.argv[2]
+    catalog = LakeSoulCatalog(wh, db_path=db)
+    t = catalog.table("t")
+    t.write_arrow(pa.table({
+        "id": np.arange(100, 110, dtype=np.int64),
+        "v": np.full(10, 7.0),
+    }))
+    print("COMMITTED", flush=True)   # never reached: phase 2 hangs
+    """
+)
+
+
+class TestKillMidCommit:
+    def _spawn_and_kill_mid_commit(self, tmp_path, wh, db):
+        """Run a writer child that hangs between commit phase 1 and phase 2,
+        wait until its phase-1 rows are durable, then SIGKILL it."""
+        script = tmp_path / "child_writer.py"
+        script.write_text(_CHILD_SCRIPT)
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": str(os.path.dirname(os.path.dirname(__file__))),
+            # hang INSIDE commit_data, after phase 1 inserted the commit rows
+            "LAKESOUL_FAULTS": "meta.commit.phase2:1:hang:120",
+        })
+        proc = subprocess.Popen(
+            [sys.executable, str(script), wh, db],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        try:
+            probe = MetaDataClient(db_path=db)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if probe.store.list_uncommitted_commits():
+                    break
+                if proc.poll() is not None:
+                    out, err = proc.communicate()
+                    raise AssertionError(
+                        f"child exited early: {out!r} {err!r}"
+                    )
+                time.sleep(0.05)
+            else:
+                raise AssertionError("child never reached phase 1")
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(10.0)
+
+    def test_kill_mid_commit_rolls_forward_on_next_open(
+        self, tmp_path, monkeypatch
+    ):
+        wh = str(tmp_path / "wh")
+        db = str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table("t", SCHEMA)
+        t.write_arrow(pa.table({
+            "id": np.arange(10, dtype=np.int64), "v": np.zeros(10),
+        }, schema=SCHEMA))
+
+        self._spawn_and_kill_mid_commit(tmp_path, wh, db)
+
+        # consistency BEFORE recovery: the half-commit is invisible — scans
+        # see exactly the pre-crash rows, never a partial batch
+        fresh = MetaDataClient(db_path=db)
+        dangling = fresh.store.list_uncommitted_commits()
+        assert len(dangling) == 1
+        plan_files = [
+            f
+            for u in fresh.get_scan_plan_partitions("t")
+            for f in u.data_files
+        ]
+        staged = [op.path for c in dangling for op in c.file_ops]
+        assert staged and not set(staged) & set(plan_files)
+
+        # next open (sweep age 0) detects the interrupted commit and rolls
+        # it FORWARD — the staged files are intact and become visible
+        monkeypatch.setenv("LAKESOUL_RECOVER_MIN_AGE_MS", "0")
+        reopened = LakeSoulCatalog(wh, db_path=db)
+        recovered = reopened.table("t").to_arrow()
+        ids = sorted(recovered.column("id").to_pylist())
+        assert ids == list(range(10)) + list(range(100, 110))
+        assert reopened.client.store.list_uncommitted_commits() == []
+
+    def test_kill_mid_commit_rolls_back_when_staged_files_lost(
+        self, tmp_path, monkeypatch
+    ):
+        wh = str(tmp_path / "wh")
+        db = str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table("t", SCHEMA)
+        t.write_arrow(pa.table({
+            "id": np.arange(10, dtype=np.int64), "v": np.zeros(10),
+        }, schema=SCHEMA))
+
+        self._spawn_and_kill_mid_commit(tmp_path, wh, db)
+
+        fresh = MetaDataClient(db_path=db)
+        dangling = fresh.store.list_uncommitted_commits()
+        assert len(dangling) == 1
+        for c in dangling:
+            for op in c.file_ops:
+                os.remove(op.path)  # the staged data is gone for good
+        counts = fresh.recover_incomplete_commits(min_age_ms=0)
+        assert counts["rolled_back"] == 1 and counts["rolled_forward"] == 0
+        assert fresh.store.list_uncommitted_commits() == []
+        # the table still serves exactly its pre-crash content
+        reopened = LakeSoulCatalog(wh, db_path=db)
+        ids = sorted(reopened.table("t").to_arrow().column("id").to_pylist())
+        assert ids == list(range(10))
+
+    def test_flag_only_crash_is_repaired(self, tmp_path):
+        """Crash signature 3: phase 2 ran but the committed flag never
+        flipped — recovery repairs the flag without re-committing."""
+        db = str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(str(tmp_path / "wh"), db_path=db)
+        t = catalog.create_table("t", SCHEMA)
+        t.write_arrow(pa.table({
+            "id": np.arange(5, dtype=np.int64), "v": np.zeros(5),
+        }, schema=SCHEMA))
+        client = catalog.client
+        # simulate the crash window by un-flipping the flag
+        with client.store._txn() as conn:
+            client.store._exec(conn, "UPDATE data_commit_info SET committed=0")
+        counts = client.recover_incomplete_commits(min_age_ms=0)
+        assert counts["flag_repaired"] == 1
+        assert client.store.list_uncommitted_commits() == []
+        assert t.to_arrow().num_rows == 5
+
+
+# ------------------------------------------- overload: 64 concurrent clients
+
+
+def _histogram_percentile(series: dict, q: float) -> float:
+    """Percentile estimate from a registry histogram snapshot
+    ({buckets: {bound: cumulative}, count, sum})."""
+    count = series["count"]
+    assert count > 0
+    rank = q * count
+    for bound, cum in sorted(series["buckets"].items()):
+        if cum >= rank:
+            return bound
+    return float("inf")
+
+
+class _SlowIndex:
+    """Stand-in ANN index: fixed per-batch latency, deterministic result."""
+
+    class config:
+        dim = 4
+
+    def batch_search(self, queries, params):
+        time.sleep(0.02)
+        n = len(queries)
+        return np.tile(np.arange(3), (n, 1)), np.zeros((n, 3), dtype=np.float32)
+
+
+class TestOverload:
+    def test_64_concurrent_clients_bounded_queue_typed_rejections(self):
+        from lakesoul_tpu.vector.serving import AnnEndpoint
+
+        before = registry().snapshot().get(
+            "lakesoul_ann_request_seconds", {"count": 0}
+        )["count"]
+        ep = AnnEndpoint(
+            _SlowIndex(), max_batch=4, max_wait_ms=1.0, max_pending=8
+        )
+        results = {"ok": 0, "shed": 0}
+        res_guard = threading.Lock()
+        start_gate = threading.Event()
+
+        def client():
+            start_gate.wait()
+            try:
+                fut = ep.submit(np.zeros(4, dtype=np.float32))
+                ids, dists = fut.result(timeout=30.0)
+                assert list(ids) == [0, 1, 2]
+                with res_guard:
+                    results["ok"] += 1
+            except OverloadedError:
+                with res_guard:
+                    results["shed"] += 1
+
+        threads = [threading.Thread(target=client) for _ in range(64)]
+        for t in threads:
+            t.start()
+        start_gate.set()
+        for t in threads:
+            t.join(60.0)
+        try:
+            stats = ep.stats()
+            # every client got a definitive answer: result or typed shed —
+            # and the queue never grew past its bound (no unbounded backlog)
+            assert results["ok"] + results["shed"] == 64
+            assert results["shed"] > 0, stats
+            assert results["ok"] > 0, stats
+            assert stats["rejected"] == results["shed"]
+            assert stats["pending"] <= stats["max_pending"] == 8
+            # p50/p99 latency live in the shared obs registry
+            series = registry().snapshot()["lakesoul_ann_request_seconds"]
+            assert series["count"] - before == results["ok"]
+            p50 = _histogram_percentile(series, 0.5)
+            p99 = _histogram_percentile(series, 0.99)
+            assert 0 < p50 <= p99 < float("inf")
+        finally:
+            ep.close()
+
+    def test_do_get_stream_keeps_admission_slot_until_delivery_done(
+        self, tmp_path
+    ):
+        # the JSON scan path returns a LAZY GeneratorStream: the expensive
+        # decode/merge work runs during delivery, after do_get returns — so
+        # the admission slot must ride along with the stream, not be
+        # released at handler exit (or N streams would decode concurrently
+        # past any max_inflight)
+        import gc
+        import json as _json
+
+        import pyarrow.flight as flight
+
+        from lakesoul_tpu.service.flight import LakeSoulFlightServer
+
+        catalog = LakeSoulCatalog(
+            str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db")
+        )
+        t = catalog.create_table("t", SCHEMA)
+        t.write_arrow(
+            pa.table({"id": np.arange(64), "v": np.zeros(64)}, schema=SCHEMA)
+        )
+        server = LakeSoulFlightServer(
+            catalog, "grpc://127.0.0.1:0", max_inflight=1, max_queue=0
+        )
+
+        class _Ctx:
+            def get_middleware(self, name):
+                return None
+
+        ticket = flight.Ticket(_json.dumps({"table": "t"}).encode())
+        try:
+            stream = server.do_get(_Ctx(), ticket)
+            assert stream is not None
+            # handler returned but delivery has not run: slot still held
+            assert server.admission.snapshot()["inflight"] == 1
+            with pytest.raises(flight.FlightUnavailableError):
+                server.do_get(_Ctx(), ticket)
+            # client disconnect before/while streaming: dropping the stream
+            # must free the slot (generator finally, or the GC backstop for
+            # a never-started generator)
+            del stream
+            gc.collect()
+            assert server.admission.snapshot()["inflight"] == 0
+        finally:
+            server.shutdown()
+
+    def test_flight_gateway_maps_overload_to_unavailable(self, tmp_path):
+        import pyarrow.flight as flight
+
+        from lakesoul_tpu.service.flight import (
+            LakeSoulFlightClient,
+            LakeSoulFlightServer,
+        )
+
+        catalog = LakeSoulCatalog(
+            str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db")
+        )
+        catalog.create_table("t", SCHEMA)
+        server = LakeSoulFlightServer(
+            catalog, "grpc://127.0.0.1:0", max_inflight=1, max_queue=0
+        )
+        try:
+            client = LakeSoulFlightClient(f"grpc://127.0.0.1:{server.port}")
+            # saturate the single slot → the wire answer is UNAVAILABLE
+            server.admission.acquire()
+            with pytest.raises(flight.FlightUnavailableError):
+                client.action("metrics")
+            server.admission.release()
+            # slot free again: the same call succeeds
+            assert client.action("metrics")
+        finally:
+            server.shutdown()
