@@ -12,6 +12,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py pipeline   # serial vs runtime-pipelined scan
     python benchmarks/micro.py chaos      # clean vs faulted-scan degradation
     python benchmarks/micro.py lint       # lakelint wall-time over the package
+    python benchmarks/micro.py topology   # SIGKILL→takeover latency (leased compaction)
     python benchmarks/micro.py all
 """
 
@@ -422,7 +423,7 @@ def bench_chaos(n_rows: int = 400_000, n_files: int = 8, p: float = 0.3) -> None
 def bench_lint() -> None:
     """Analyzer wall-time over the whole package (CI-gate cost leg: the
     lint gate runs on every PR, so its cost is tracked next to the perf
-    legs; target < 10 s for all 17 rules INCLUDING the project call-graph
+    legs; target < 10 s for all 18 rules INCLUDING the project call-graph
     build the interprocedural rules share and the device-index/taint
     passes of the JAX/TPU pack)."""
     from lakesoul_tpu.analysis import run_repo
@@ -456,6 +457,119 @@ def bench_lint() -> None:
     assert dt < 10.0, f"lint gate took {dt:.1f}s — budget is 10s"
 
 
+def bench_topology(
+    n_versions: int = 12, rows_per_commit: int = 2000, ttl_s: float = 2.0
+) -> None:
+    """Multi-process failover cost leg: how long a partition whose leased
+    compactor was SIGKILLed mid-job waits until a peer service completes
+    it (kill → peer-commits latency, dominated by one lease TTL), and the
+    proof that the failover path changes NOTHING about the data — the
+    failover-compacted table scans byte-identical to a clean-compacted
+    copy of the same commit sequence.  ``LAKESOUL_RETRY_SEED`` pins every
+    backoff schedule so the run reproduces."""
+    import signal
+    import subprocess
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.compaction.service import LeasedCompactionService
+    from lakesoul_tpu.meta.entity import CommitOp
+
+    schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+    rng = np.random.default_rng(0)
+    batches = [
+        pa.table({
+            "id": np.arange(rows_per_commit, dtype=np.int64),
+            "v": rng.normal(size=rows_per_commit),
+        }, schema=schema)
+        for _ in range(n_versions)
+    ]
+
+    def build(wh: str, db: str):
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table(
+            "t", schema, primary_keys=["id"], hash_bucket_num=1
+        )
+        for b in batches:
+            t.upsert(b)
+        return catalog, t
+
+    def sorted_ipc(table: pa.Table) -> bytes:
+        import io
+
+        out = table.sort_by("id").combine_chunks()
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, out.schema) as w:
+            w.write_table(out)
+        return sink.getvalue()
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "LAKESOUL_RETRY_SEED": "7",
+        "LAKESOUL_FAULTS": "compaction.leased_job:1:hang:300",
+    })
+    with tempfile.TemporaryDirectory() as d:
+        # clean run: same commits, in-process leased compaction
+        cat1, t1 = build(os.path.join(d, "wh1"), os.path.join(d, "m1.db"))
+        LeasedCompactionService(
+            cat1, lease_ttl_s=30, poll_interval_s=0.01
+        ).poll_once()
+        clean_bytes = sorted_ipc(t1.refresh().to_arrow())
+
+        # failover run: victim service process hangs inside the leased job
+        wh2, db2 = os.path.join(d, "wh2"), os.path.join(d, "m2.db")
+        cat2, t2 = build(wh2, db2)
+        store = cat2.client.store
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lakesoul_tpu.compaction",
+             "--warehouse", wh2, "--db-path", db2,
+             "--lease-ttl-s", str(ttl_s), "--poll-s", "0.1",
+             "--service-id", "victim"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        key = f"compaction/{t2.info.table_id}/-5"
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if store.get_lease(key) is not None:
+                    break
+                time.sleep(0.05)
+            assert store.get_lease(key) is not None, "victim never leased"
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(10.0)
+        killed_at = time.monotonic()
+        peer = LeasedCompactionService(
+            cat2, service_id="peer", lease_ttl_s=ttl_s, poll_interval_s=0.1
+        )
+        drain_deadline = time.monotonic() + 60.0
+        while store.get_compaction_candidates():
+            if time.monotonic() > drain_deadline:
+                raise RuntimeError(
+                    "peer failed to drain compaction candidates within 60s: "
+                    f"{store.get_compaction_candidates()}"
+                )
+            peer.poll_once()
+            time.sleep(0.05)
+        takeover_ms = (time.monotonic() - killed_at) * 1e3
+
+        head = store.get_latest_partition_info(t2.info.table_id, "-5")
+        assert head.commit_op == CommitOp.COMPACTION
+        assert head.expression == "fence=2", head.expression
+        failover_bytes = sorted_ipc(t2.refresh().to_arrow())
+        assert failover_bytes == clean_bytes, (
+            "failover-compacted scan diverged from the clean run"
+        )
+        _emit(
+            "topology_takeover", takeover_ms, "ms",
+            lease_ttl_s=ttl_s,
+            takeovers=peer.stats.takeovers,
+            byte_identical=True,
+            rows=n_versions * rows_per_commit,
+        )
+
+
 LEGS = {
     "merge": bench_merge,
     "formats": bench_formats,
@@ -466,6 +580,7 @@ LEGS = {
     "pipeline": bench_pipeline_scan,
     "chaos": bench_chaos,
     "lint": bench_lint,
+    "topology": bench_topology,
 }
 
 

@@ -42,6 +42,7 @@ from lakesoul_tpu.analysis.rules.security import (
     RbacGateReachabilityRule,
     TaintPathSegmentsRule,
 )
+from lakesoul_tpu.analysis.rules.wallclock import WallClockLeaseRule
 
 __all__ = ["all_rules", "rule_ids"]
 
@@ -57,6 +58,7 @@ def all_rules() -> list[Rule]:
         MetricNameRule(),
         SqliteScopeRule(),
         AdHocRetryRule(),
+        WallClockLeaseRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

@@ -320,11 +320,17 @@ class LakeSoulTable:
         )
 
     # ------------------------------------------------------------- row DML
-    def _commit_partition_rewrite(self, head, outputs, old_files, commit_op) -> None:
+    def _commit_partition_rewrite(self, head, outputs, old_files, commit_op,
+                                  *, lease=None) -> None:
         """Shared tail of every partition-rewrite operation (compaction and
         row DML): build the file ops, commit against the read head, delete
         staged files on a provably-invisible conflict, queue replaced files
-        for the cleaner."""
+        for the cleaner.  ``lease`` fences the commit on a coordination
+        lease (leased compaction services); a fenced commit is just as
+        provably invisible as a conflicted one, so its staged files are
+        cleaned up the same way."""
+        from lakesoul_tpu.errors import LeaseFencedError
+
         client = self.catalog.client
         files_by_partition: dict[str, list[DataFileOp]] = {head.partition_desc: []}
         for out in outputs:
@@ -338,8 +344,12 @@ class LakeSoulTable:
                 files_by_partition,
                 commit_op,
                 read_partition_info=[head],
+                lease=lease,
+                # the except below deletes the staged outputs, so the
+                # phase-1 rows must die with them (see commit_data_files)
+                staged_deleted_on_conflict=True,
             )
-        except CommitConflictError:
+        except (CommitConflictError, LeaseFencedError):
             from lakesoul_tpu.io.object_store import delete_file
 
             for out in outputs:
@@ -576,10 +586,12 @@ class LakeSoulTable:
         return self.refresh()
 
     # ------------------------------------------------------------ compaction
-    def compact(self, partitions: dict[str, str] | None = None) -> int:
+    def compact(self, partitions: dict[str, str] | None = None, *, lease=None) -> int:
         """Merge each (partition, bucket)'s file stack into a single file and
         commit with CompactionCommit; replaced files go to the discard list
         for the cleaner.  Mirrors Spark CompactionCommand + CompactBucketIO.
+        ``lease`` (from a leased compaction service) fences the commit and
+        stamps its fencing token into the version row's expression.
         Returns the number of partitions compacted."""
         client = self.catalog.client
         heads = client._select_partitions(self._info, partitions)
@@ -614,7 +626,9 @@ class LakeSoulTable:
                         writer.write_batch(batch)
                 old_files.extend(unit.data_files)
             outputs = writer.close()
-            self._commit_partition_rewrite(head, outputs, old_files, CommitOp.COMPACTION)
+            self._commit_partition_rewrite(
+                head, outputs, old_files, CommitOp.COMPACTION, lease=lease
+            )
             count += 1
         return count
 

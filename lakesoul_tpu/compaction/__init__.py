@@ -1,4 +1,7 @@
-from lakesoul_tpu.compaction.service import CompactionService
+from lakesoul_tpu.compaction.service import (
+    CompactionService,
+    LeasedCompactionService,
+)
 from lakesoul_tpu.compaction.cleaner import Cleaner
 
-__all__ = ["CompactionService", "Cleaner"]
+__all__ = ["CompactionService", "LeasedCompactionService", "Cleaner"]

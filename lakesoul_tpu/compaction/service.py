@@ -1,4 +1,4 @@
-"""Automatic compaction service.
+"""Automatic compaction services.
 
 Role parity with the reference's Spark compaction service
 (lakesoul-spark/…/compaction/NewCompactionTask.scala:22-150): it LISTENs for
@@ -7,26 +7,100 @@ partition's version gap since the last CompactionCommit reaches the threshold
 (meta_init.sql:101-150), hashes the partition onto a worker pool, and runs
 the compaction through the normal write path.
 
-Here the metadata store fires the same event synchronously
-(SqliteMetadataStore._fire_compaction_triggers); the service runs jobs on
-the shared execution runtime's worker pool (lakesoul_tpu/runtime/pool.py —
-no dedicated threads), bounded to ``workers`` concurrent jobs over a
-bounded pending queue, deduplicates in-flight partitions, and also supports
-size-tiered scheduled sweeps (the reference's "new compaction" path with
-file-number/size limits)."""
+Two deployment shapes:
+
+- :class:`CompactionService` — single process: the metadata store fires the
+  trigger event synchronously in the committing writer's process
+  (SqliteMetadataStore._fire_compaction_triggers); jobs run on the shared
+  runtime worker pool, bounded and deduplicated.
+- :class:`LeasedCompactionService` — the **multi-process topology**: a
+  standalone service process (``python -m lakesoul_tpu.compaction``) that
+  discovers work by polling committed-version gaps
+  (:class:`~lakesoul_tpu.compaction.events.PollingWatermarkNotifier` — the
+  LISTEN/NOTIFY-shaped source, so a PG transport drops in later), takes a
+  **per-partition lease** with a TTL and a fencing token
+  (``meta/store.py`` lease table) before compacting, and commits with the
+  lease as an atomic guard.  A SIGKILLed holder's lease expires after one
+  TTL and any peer takes over (``lakesoul_compaction_takeovers_total``);
+  the dead holder, were it ever to wake, is fenced at commit time — never
+  a double-compaction.
+"""
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 
-from lakesoul_tpu.errors import CommitConflictError
+from lakesoul_tpu.errors import CommitConflictError, LeaseFencedError
 from lakesoul_tpu.meta.store import CompactionEvent
 from lakesoul_tpu.obs import registry, span
 from lakesoul_tpu.runtime import get_pool
 
 logger = logging.getLogger(__name__)
+
+from lakesoul_tpu.runtime.resilience import _env_float
+
+ENV_LEASE_TTL_S = "LAKESOUL_LEASE_TTL_S"
+ENV_POLL_S = "LAKESOUL_COMPACTION_POLL_S"
+
+
+def needs_compaction(table, partition_desc: str, min_file_num: int) -> bool:
+    """Size-tiered gate shared by both services: only compact when some
+    bucket stacks at least ``min_file_num`` files (reference: file
+    num/size limits in the new-compaction path)."""
+    units = table.scan().scan_plan()
+    for u in units:
+        if u.partition_desc == partition_desc and len(u.data_files) >= min_file_num:
+            return True
+    return False
+
+
+def _run_conflict_retried_compaction(
+    table, event: CompactionEvent, stats: "CompactionStats", min_file_num: int,
+    *, lease=None, pre_attempt=None,
+) -> str:
+    """THE compaction attempt-loop, shared by the in-process service and the
+    leased service so its conflict-retry tuning lives in one place.
+
+    Writers may advance the partition mid-compact; each retry re-reads the
+    fresh head, like the reference re-running on the next notify — with
+    backoff between attempts (a hot writer gets a beat to finish its burst)
+    and a ``lakesoul_retry_exhausted_total{op=compaction.conflict}`` signal
+    when the job gives up.  ``pre_attempt`` runs before each try (the leased
+    service fences on a lapsed heartbeat there).  Returns the outcome
+    counter name; ``"conflicts"`` when retries exhaust."""
+    from lakesoul_tpu.meta.client import partition_desc_to_dict
+    from lakesoul_tpu.runtime.resilience import RetryPolicy
+
+    parts = partition_desc_to_dict(event.partition_desc) or None
+
+    def attempt() -> str:
+        if pre_attempt is not None:
+            pre_attempt()
+        if not needs_compaction(table, event.partition_desc, min_file_num):
+            return "skipped"
+        try:
+            return "compacted" if table.compact(parts, lease=lease) else "skipped"
+        except CommitConflictError:
+            stats.bump("conflicts")
+            raise
+
+    policy = RetryPolicy.from_env(
+        max_attempts=3,
+        base_delay_s=0.02,
+        max_delay_s=0.25,
+        classify=lambda e: isinstance(e, CommitConflictError),
+    )
+    try:
+        return policy.run(attempt, op="compaction.conflict")
+    except CommitConflictError:
+        logger.info(
+            "compaction kept losing races for %s; deferring to a later"
+            " poll", event.partition_desc,
+        )
+        return "conflicts"
 
 
 @dataclass
@@ -36,6 +110,11 @@ class CompactionStats:
     skipped: int = 0
     conflicts: int = 0
     errors: int = 0
+    # leased-service outcomes
+    lease_held: int = 0
+    fenced: int = 0
+    takeovers: int = 0
+
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def bump(self, name: str) -> None:
@@ -65,8 +144,12 @@ class CompactionService:
         workers: int = 2,
         min_file_num: int = 2,
         queue_size: int = 256,
+        notifier=None,
     ):
+        from lakesoul_tpu.compaction.events import StoreTriggerNotifier
+
         self.catalog = catalog
+        self.notifier = notifier or StoreTriggerNotifier(catalog.client.store)
         self.workers = workers
         self.min_file_num = min_file_num
         self.queue_size = queue_size
@@ -87,37 +170,32 @@ class CompactionService:
     # --------------------------------------------------------------- control
     def start(self) -> None:
         self._stop.clear()
-        self.catalog.client.store.add_compaction_listener(self._on_event)
+        self.notifier.listen(self._on_event)
 
     def stop(self, timeout: float = 5.0) -> None:
         """Unsubscribe, drop queued events, wait (bounded) for running jobs."""
         self._stop.set()
-        try:
-            self.catalog.client.store.remove_compaction_listener(self._on_event)
-        except ValueError:
-            pass
-        import time
-
-        deadline = time.time() + timeout
+        self.notifier.unlisten(self._on_event)
+        # monotonic: an NTP step during shutdown must not turn a 5 s grace
+        # period into 0 (or an hour) — enforced by the wall-clock-lease lint
+        deadline = time.monotonic() + timeout
         with self._idle:
             for ev in self._pending:
                 self._in_flight.discard((ev.table_id, ev.partition_desc))
             self._g_pending.dec(len(self._pending))
             self._pending.clear()
             while self._running:
-                left = deadline - time.time()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     break
                 self._idle.wait(timeout=left)
 
     def drain(self, timeout: float = 30.0) -> None:
         """Block until no events are pending and no job is running."""
-        import time
-
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._idle:
             while self._pending or self._running:
-                left = deadline - time.time()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     return
                 self._idle.wait(timeout=min(left, 0.1))
@@ -183,55 +261,16 @@ class CompactionService:
             )
 
     def _compact_one_inner(self, event: CompactionEvent) -> None:
-        from lakesoul_tpu.meta.client import partition_desc_to_dict
-        from lakesoul_tpu.runtime.resilience import RetryPolicy
-
         info = self.catalog.client.store.get_table_info_by_id(event.table_id)
         if info is None:
             self.stats.bump("skipped")
             return
         table = self.catalog.table(info.table_name, info.table_namespace)
-        parts = partition_desc_to_dict(event.partition_desc) or None
-
-        # writers may advance the partition mid-compact; each retry re-reads
-        # the fresh head, like the reference re-running on the next notify —
-        # now with backoff between attempts (a hot writer gets a beat to
-        # finish its burst) and a lakesoul_retry_exhausted_total{op=
-        # compaction.conflict} signal when the job gives up, instead of the
-        # old silent fixed-3 loop
-        def attempt() -> str:
-            if not self._needs_compaction(table, event.partition_desc):
-                return "skipped"
-            try:
-                return "compacted" if table.compact(parts) else "skipped"
-            except CommitConflictError:
-                self.stats.bump("conflicts")
-                raise
-
-        policy = RetryPolicy.from_env(
-            max_attempts=3,
-            base_delay_s=0.02,
-            max_delay_s=0.25,
-            classify=lambda e: isinstance(e, CommitConflictError),
+        outcome = _run_conflict_retried_compaction(
+            table, event, self.stats, self.min_file_num
         )
-        try:
-            outcome = policy.run(attempt, op="compaction.conflict")
-        except CommitConflictError:
-            logger.info(
-                "compaction kept losing races for %s; deferring", event.partition_desc
-            )
-            return
-        self.stats.bump(outcome)
-
-    def _needs_compaction(self, table, partition_desc: str) -> bool:
-        """Size-tiered gate: only compact when some bucket stacks at least
-        min_file_num files (reference: file num/size limits in the
-        new-compaction path)."""
-        units = table.scan().scan_plan()
-        for u in units:
-            if u.partition_desc == partition_desc and len(u.data_files) >= self.min_file_num:
-                return True
-        return False
+        if outcome != "conflicts":
+            self.stats.bump(outcome)
 
     # ------------------------------------------------------------- full sweep
     def sweep(self) -> int:
@@ -255,3 +294,273 @@ class CompactionService:
                     except CommitConflictError:
                         self.stats.bump("conflicts")
         return total
+
+
+class _LeaseHeartbeat:
+    """Keeps the store-side lease row alive while a long job runs.
+
+    Renews at TTL/3 on a daemon thread; each successful renewal extends
+    ``valid_until`` (monotonic clock).  Without this, any job longer than
+    one TTL is guaranteed fenced at commit — the staged output dies, a
+    peer re-runs the same doomed job, and the partition livelocks.  A
+    failed renewal means a peer fenced past us: the job observes
+    ``fenced`` and aborts instead of wasting the rest of the pass (the
+    commit-time lease guard stays the correctness backstop)."""
+
+    def __init__(self, store, key: str, holder: str, token: int, ttl_ms: int):
+        self._store = store
+        self._key = key
+        self._holder = holder
+        self._token = token
+        self._ttl_ms = ttl_ms
+        self._ttl_s = ttl_ms / 1000.0
+        self._period_s = max(self._ttl_s / 3.0, 0.05)
+        self.valid_until = time.monotonic() + self._ttl_s
+        self.fenced = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(  # lakelint: ignore[raw-thread] lease keepalive must tick while the job itself occupies pool workers
+            target=self._run, name=f"lease-heartbeat-{key}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._period_s):
+            try:
+                renewed = self._store.renew_lease(
+                    self._key, self._holder, self._token, self._ttl_ms
+                )
+            except Exception:
+                # transient store error: the old window still stands, but a
+                # PERSISTENT failure quietly lapses into a fenced job — log
+                # each miss so that path is diagnosable after the fact
+                logger.warning(
+                    "lease renewal for %s failed; local validity lapses in"
+                    " %.1fs", self._key,
+                    max(self.valid_until - time.monotonic(), 0.0),
+                    exc_info=True,
+                )
+                continue
+            if renewed is None:
+                self.fenced = True  # expired or fenced: never revive, re-acquire
+                return
+            self.valid_until = time.monotonic() + self._ttl_s
+
+
+class LeasedCompactionService:
+    """Standalone leased compaction service — one per *process*, any number
+    of processes per warehouse.
+
+    Discovery: a polling watermark consumer over committed-version gaps
+    (:class:`~lakesoul_tpu.compaction.events.PollingWatermarkNotifier`);
+    the watermark is the last CompactionCommit version in the store, so a
+    killed service loses no events — any peer's next poll re-derives them.
+
+    Coordination: one lease per (table, partition) in the metadata store's
+    lease table.  ``acquire`` → work → fenced commit → ``release``.  The
+    holder tracks its LOCAL validity with ``time.monotonic()`` (wall-clock
+    jumps cannot extend or shrink it); the store compares expiry on its
+    own shared timebase; and the **fencing token**, checked atomically
+    inside the commit transaction, is what actually prevents a zombie's
+    late commit — clocks only bound *liveness* (takeover within one TTL),
+    never correctness.
+
+    Obs: ``lakesoul_lease_state{key=}`` (1 while held here),
+    ``lakesoul_compaction_takeovers_total``, plus the shared
+    ``lakesoul_compaction_events_total{kind=}`` outcome counters.
+    """
+
+    LEASE_PREFIX = "compaction/"
+
+    def __init__(
+        self,
+        catalog,
+        *,
+        service_id: str | None = None,
+        lease_ttl_s: float | None = None,
+        poll_interval_s: float | None = None,
+        min_file_num: int = 2,
+        version_gap: int | None = None,
+    ):
+        import os
+        import uuid
+
+        from lakesoul_tpu.compaction.events import PollingWatermarkNotifier
+        from lakesoul_tpu.meta.store import COMPACTION_TRIGGER_VERSION_GAP
+
+        self.catalog = catalog
+        self.service_id = service_id or f"compactor-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.lease_ttl_s = (
+            _env_float(ENV_LEASE_TTL_S, 30.0) if lease_ttl_s is None else float(lease_ttl_s)
+        )
+        self.poll_interval_s = (
+            _env_float(ENV_POLL_S, 5.0) if poll_interval_s is None else float(poll_interval_s)
+        )
+        self.min_file_num = min_file_num
+        self.version_gap = (
+            COMPACTION_TRIGGER_VERSION_GAP if version_gap is None else version_gap
+        )
+        self.stats = CompactionStats()
+        self.notifier = PollingWatermarkNotifier(
+            catalog.client.store, version_gap=self.version_gap
+        )
+        self.notifier.listen(self._on_event)
+        self._stop = threading.Event()
+        self._poll_events: list[CompactionEvent] = []
+        # (table_id, desc) → head version we already judged not-compactable
+        # (version gap present but no bucket stacks min_file_num files, e.g.
+        # after a DML rewrite).  Gap-derived discovery would re-emit such a
+        # candidate on EVERY poll forever; suppressing it until its head
+        # ADVANCES turns that into one lease+scan_plan per new commit
+        # instead of one per poll interval.
+        self._skipped_heads: dict[tuple[str, str], int] = {}
+
+    # ----------------------------------------------------------------- events
+    def _on_event(self, event: CompactionEvent) -> None:
+        self.stats.bump("triggered")
+        self._poll_events.append(event)
+
+    def _lease_key(self, event: CompactionEvent) -> str:
+        return f"{self.LEASE_PREFIX}{event.table_id}/{event.partition_desc}"
+
+    def poll_once(self) -> dict:
+        """One discovery + work cycle; returns outcome counts.  Candidates a
+        live peer is already leasing are skipped (``lease_held``) and will
+        be re-derived next poll if their gap survives the peer's job."""
+        self._poll_events = []
+        self.notifier.poll()
+        # a skipped head stays a candidate while its gap is open; once it
+        # compacts (or its table drops) it leaves the candidate set — prune
+        # so a long-running service doesn't pin every head ever judged
+        live = {(e.table_id, e.partition_desc) for e in self._poll_events}
+        for k in [k for k in self._skipped_heads if k not in live]:
+            del self._skipped_heads[k]
+        counts = {
+            "candidates": len(self._poll_events),
+            "compacted": 0, "skipped": 0, "lease_held": 0,
+            "fenced": 0, "conflicts": 0, "errors": 0,
+        }
+        for event in self._poll_events:
+            if self._stop.is_set():
+                break
+            if self._skipped_heads.get(
+                (event.table_id, event.partition_desc), -1
+            ) >= event.version:
+                counts["skipped"] += 1
+                continue
+            try:
+                outcome = self._compact_candidate(event)
+            except Exception:
+                outcome = "errors"
+                self.stats.bump("errors")
+                logger.exception(
+                    "leased compaction failed for %s/%s",
+                    event.table_id, event.partition_desc,
+                )
+            key = (event.table_id, event.partition_desc)
+            if outcome == "skipped":
+                self._skipped_heads[key] = event.version
+            elif outcome == "compacted":
+                self._skipped_heads.pop(key, None)
+            counts[outcome] = counts.get(outcome, 0) + 1
+        return counts
+
+    def _compact_candidate(self, event: CompactionEvent) -> str:
+        from lakesoul_tpu.runtime import faults
+
+        store = self.catalog.client.store
+        key = self._lease_key(event)
+        ttl_ms = int(self.lease_ttl_s * 1000)
+        lease = store.acquire_lease(key, self.service_id, ttl_ms)
+        if lease is None:
+            self.stats.bump("lease_held")
+            return "lease_held"
+        # heartbeat renews the store row at TTL/3 and tracks local validity
+        # on the monotonic clock (wall jumps cannot resurrect a lapsed
+        # lease); jobs longer than one TTL stay held instead of fencing
+        heartbeat = _LeaseHeartbeat(
+            store, key, self.service_id, lease.fencing_token, ttl_ms
+        )
+        gauge = registry().gauge("lakesoul_lease_state", key=key)
+        try:
+            # everything after acquire runs under the finally that stops
+            # the heartbeat and releases the lease — a raise anywhere here
+            # must not leak a perpetually-renewed lease
+            heartbeat.start()
+            gauge.set(1)
+            if lease.taken_over:
+                self.stats.bump("takeovers")
+                registry().counter("lakesoul_compaction_takeovers_total").inc()
+                logger.info(
+                    "%s took over lease %s (fencing token %d)",
+                    self.service_id, key, lease.fencing_token,
+                )
+            # chaos point: a service hung (or killed) HERE still holds the
+            # lease — the takeover tests SIGKILL inside this window
+            faults.maybe_inject("compaction.leased_job")
+            info = store.get_table_info_by_id(event.table_id)
+            if info is None:
+                self.stats.bump("skipped")
+                return "skipped"
+            table = self.catalog.table(info.table_name, info.table_namespace)
+
+            def check_lease() -> None:
+                if heartbeat.fenced or time.monotonic() >= heartbeat.valid_until:
+                    # the heartbeat lost the lease (or stalled past the
+                    # window): abort before more work — the commit guard
+                    # would catch it anyway, but a whole compact pass
+                    # would be wasted
+                    raise LeaseFencedError(f"lease {key} lapsed locally")
+
+            outcome = _run_conflict_retried_compaction(
+                table, event, self.stats, self.min_file_num,
+                lease=lease, pre_attempt=check_lease,
+            )
+            if outcome != "conflicts":
+                self.stats.bump(outcome)
+            return outcome
+        except LeaseFencedError:
+            self.stats.bump("fenced")
+            if heartbeat.fenced:
+                # the store rejected our renewal outright: token stale —
+                # a peer fenced past us
+                logger.warning(
+                    "%s fenced on %s: a peer took over; abandoning the job",
+                    self.service_id, key,
+                )
+            else:
+                # local validity lapsed (renewals erroring — see the
+                # heartbeat warnings) or the commit-time guard rejected
+                # the token; don't blame a peer the logs can't prove
+                logger.warning(
+                    "%s abandoned %s: lease no longer provably held"
+                    " (lapsed local validity or commit-guard rejection)",
+                    self.service_id, key,
+                )
+            return "fenced"
+        finally:
+            heartbeat.stop()
+            gauge.set(0)
+            store.release_lease(key, self.service_id, lease.fencing_token)
+
+    # ---------------------------------------------------------------- control
+    def run_forever(self, *, max_polls: int | None = None) -> None:
+        """Poll → work → sleep until :meth:`stop` (or ``max_polls``)."""
+        polls = 0
+        while not self._stop.is_set():
+            counts = self.poll_once()
+            if counts["candidates"]:
+                logger.info("%s poll: %s", self.service_id, counts)
+            polls += 1
+            if max_polls is not None and polls >= max_polls:
+                return
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.notifier.close()

@@ -25,6 +25,15 @@ class TableNotFoundError(MetadataError):
     pass
 
 
+class LeaseFencedError(MetadataError):
+    """A commit (or renewal) presented a lease that is no longer valid: the
+    holder's TTL expired and a peer re-acquired the lease with a higher
+    fencing token.  The presenter is a *zombie* — it must abandon the job,
+    never retry it: the work has been (or will be) redone by the new
+    holder, and retrying would double-apply it.  Deliberately permanent in
+    the resilience taxonomy (MetadataError → not transient)."""
+
+
 class TableAlreadyExistsError(MetadataError):
     pass
 

@@ -164,19 +164,27 @@ class ParquetFormat(PhysicalFormat):
 
         fs, p = filesystem_for(path, storage_options)
         local = _is_local(fs)
+        # partitioning=None: these are SINGLE data files addressed by the
+        # scan plan — pq.read_table's default hive inference would derive a
+        # dictionary-typed partition field from reference-layout paths
+        # (.../date=2024-01-01/part-*.parquet) and collide with the file's
+        # own physical column; partition values come from partition_desc
+        # metadata, never from path sniffing
         try:
             if local:
                 # local files: memory-map instead of read-into-buffer (~1.5x)
-                return pq.read_table(p, columns=columns, memory_map=True)
-            return pq.read_table(p, columns=columns, filesystem=fs)
+                return pq.read_table(
+                    p, columns=columns, memory_map=True, partitioning=None
+                )
+            return pq.read_table(p, columns=columns, filesystem=fs, partitioning=None)
         except (pa.lib.ArrowInvalid, KeyError):
             avail = set(
                 pq.read_schema(p, filesystem=None if local else fs, memory_map=local).names
             )
             cols = [c for c in columns if c in avail] if columns is not None else None
             if local:
-                return pq.read_table(p, columns=cols, memory_map=True)
-            return pq.read_table(p, columns=cols, filesystem=fs)
+                return pq.read_table(p, columns=cols, memory_map=True, partitioning=None)
+            return pq.read_table(p, columns=cols, filesystem=fs, partitioning=None)
 
     def count_rows(self, path, storage_options=None):
         import pyarrow.parquet as pq

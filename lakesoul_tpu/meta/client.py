@@ -241,7 +241,9 @@ class MetaDataClient:
         self.store.update_table_schema(table_id, schema_to_json(schema), schema_to_ipc(schema))
 
     # --------------------------------------------------------------- commits
-    def commit_data(self, meta_info: MetaInfo, commit_op: CommitOp) -> None:
+    def commit_data(
+        self, meta_info: MetaInfo, commit_op: CommitOp, *, lease=None
+    ) -> None:
         """Two-phase commit with optimistic retry.
 
         Phase 1 (insert_data_commit_info) is done by the writer beforehand;
@@ -250,6 +252,11 @@ class MetaDataClient:
         re-read and the commit retried — Append/Merge simply stack on the new
         head; Compaction/Update re-validate their read version and abort if
         the partition moved (the caller must re-run on fresh data).
+
+        ``lease`` (a :class:`~lakesoul_tpu.meta.store.Lease`) fences phase 2
+        on the lease row inside the same store transaction: a holder whose
+        TTL lapsed and whose lease was re-acquired by a peer gets
+        :class:`LeaseFencedError` instead of committing zombie work.
 
         Callers building MetaInfo by hand must use canonical partition descs
         (range-column order; ``dict_to_partition_desc``) — phase 1 already
@@ -270,7 +277,7 @@ class MetaDataClient:
             faults.maybe_inject("meta.commit.phase2")
             try:
                 with span("meta.commit", op=commit_op.value):
-                    return self._commit_data_once(meta_info, commit_op)
+                    return self._commit_data_once(meta_info, commit_op, lease=lease)
             except CommitConflictError as e:
                 registry().counter("lakesoul_meta_commit_conflicts_total").inc()
                 if not retryable:
@@ -321,7 +328,9 @@ class MetaDataClient:
             )
         return result
 
-    def _commit_data_once(self, meta_info: MetaInfo, commit_op: CommitOp) -> None:
+    def _commit_data_once(
+        self, meta_info: MetaInfo, commit_op: CommitOp, *, lease=None
+    ) -> None:
         table_info = meta_info.table_info
         cur_map = {
             desc: self.store.get_latest_partition_info(table_info.table_id, desc)
@@ -413,6 +422,7 @@ class MetaDataClient:
                 for p in new_partition_list
                 if p.version >= 0
             ),
+            lease_guard=lease.guard() if lease is not None else None,
         )
 
     def commit_data_files(
@@ -424,6 +434,8 @@ class MetaDataClient:
         commit_id_by_partition: dict[str, str] | None = None,
         read_partition_info: list[PartitionInfo] | None = None,
         storage_options: dict | None = None,
+        lease=None,
+        staged_deleted_on_conflict: bool = False,
     ) -> list[DataCommitInfo]:
         """Convenience used by writers: phase 1 (insert data commits) + phase 2
         (advance partition versions) in one call.  ``commit_id_by_partition``
@@ -478,6 +490,11 @@ class MetaDataClient:
                     table_id=table_info.table_id,
                     partition_desc=desc,
                     snapshot=[cid],
+                    # leased commits stamp their fencing token into the
+                    # version row: commit history then PROVES which holder
+                    # produced each compaction (the chaos tests assert
+                    # zero double-compaction from exactly this trail)
+                    expression=f"fence={lease.fencing_token}" if lease else "",
                 )
             )
             done_ids.append((desc, cid))
@@ -490,7 +507,37 @@ class MetaDataClient:
             list_partition=partitions,
             read_partition_info=list(read_partition_info or []),
         )
-        self.commit_data(meta_info, commit_op)
+        from lakesoul_tpu.errors import LeaseFencedError
+
+        try:
+            self.commit_data(meta_info, commit_op, lease=lease)
+        except (CommitConflictError, LeaseFencedError) as e:
+            # a fenced commit — or a conflicted commit whose caller deletes
+            # its staged files and re-runs from fresh state with a new
+            # commit id — is dead for GOOD.  Without this, every lost race
+            # leaves committed=0 phase-1 rows lingering until a recovery
+            # sweep (the two-services-race chaos test caught exactly that
+            # debris).  Only the rows THIS call inserted are deleted;
+            # replayed durable ids are untouched.  Scoped to commits whose
+            # staged files the CALLER provably deletes on this error:
+            # compactions always do, and partition rewrites declare it via
+            # ``staged_deleted_on_conflict``.  A conflicted UPDATE whose
+            # staged files SURVIVE (cdc checkpoint_replace) keeps its rows
+            # instead: its retries reuse the same staged files via the
+            # replay path, and after exhausted retries the committed=0 rows
+            # are what lets recover_incomplete_commits find and delete the
+            # files rather than leaking them.
+            dead = (
+                isinstance(e, LeaseFencedError)
+                or commit_op is CommitOp.COMPACTION
+                or staged_deleted_on_conflict
+            )
+            if dead:
+                for c in new_commits:
+                    self.store.delete_data_commit_info(
+                        c.table_id, c.partition_desc, [c.commit_id]
+                    )
+            raise
         for desc, cid in done_ids:
             self.store.mark_committed(table_info.table_id, desc, [cid])
         return new_commits

@@ -125,7 +125,7 @@ class TableInfo:
     table_namespace: str = "default"
     table_name: str = ""
     table_path: str = ""
-    table_schema: str = ""  # Arrow schema as JSON (debug mirror)
+    table_schema: str = ""  # Spark DataType JSON (the reference wire format)
     table_schema_arrow_ipc: bytes = b""  # full-fidelity Arrow IPC schema
     properties: dict = field(default_factory=dict)
     partitions: str = ";"  # "range_cols;hash_cols"
@@ -137,8 +137,17 @@ class TableInfo:
 
     @property
     def arrow_schema(self) -> pa.Schema:
+        """Arrow schema: full-fidelity IPC when present, else the JSON
+        ``table_schema`` column — which for reference-written metadata is
+        Spark's DataType JSON (``{"type":"struct","fields":[...]}``,
+        entity.proto:24 / transfusion.rs) and for legacy rows of this repo
+        is the old debug mirror.  Parsing the Spark encoding is what lets
+        a table the reference's writer registered load here without the
+        IPC column ever having been populated."""
         if self.table_schema_arrow_ipc:
             return pa.ipc.read_schema(pa.BufferReader(self.table_schema_arrow_ipc))
+        if self.table_schema:
+            return schema_from_json(self.table_schema)
         raise ValueError(f"table {self.table_name} has no arrow schema")
 
     @property
@@ -223,13 +232,168 @@ def schema_to_ipc(schema: pa.Schema) -> bytes:
     return schema.serialize().to_pybytes()
 
 
+# ---------------------------------------------------------------------------
+# Spark-JSON schema encoding (the reference's table_schema wire format).
+#
+# The reference stores ``table_schema`` as Spark's DataType JSON —
+# ``{"type":"struct","fields":[{"name","type","nullable","metadata"}]}``
+# with type strings like "long"/"double"/"decimal(10,2)" and nested
+# array/map/struct objects (spark/sql/types, consumed by transfusion.rs) —
+# NOT as Arrow IPC.  Writing and parsing that encoding here is what makes
+# the JSON column interoperable in both directions: reference-written
+# metadata loads without the IPC column, and reference readers can parse
+# ours.
+
+_SPARK_TO_ARROW: dict[str, pa.DataType] = {
+    "boolean": pa.bool_(),
+    "byte": pa.int8(),
+    "short": pa.int16(),
+    "integer": pa.int32(),
+    "long": pa.int64(),
+    "float": pa.float32(),
+    "double": pa.float64(),
+    "string": pa.string(),
+    "binary": pa.binary(),
+    "date": pa.date32(),
+    # Spark TimestampType is an instant (UTC-normalized); NTZ is wall time
+    "timestamp": pa.timestamp("us", tz="UTC"),
+    "timestamp_ntz": pa.timestamp("us"),
+}
+
+_ARROW_TO_SPARK: dict[pa.DataType, str] = {v: k for k, v in _SPARK_TO_ARROW.items()}
+assert len(_ARROW_TO_SPARK) == len(_SPARK_TO_ARROW), "Spark type map must be 1:1"
+_DECIMAL_RE = None  # lazily-compiled below (keeps import time flat)
+
+
+def _spark_type_to_arrow(t) -> pa.DataType:
+    if isinstance(t, str):
+        hit = _SPARK_TO_ARROW.get(t)
+        if hit is not None:
+            return hit
+        global _DECIMAL_RE
+        if _DECIMAL_RE is None:
+            import re
+
+            _DECIMAL_RE = re.compile(r"decimal\((\d+),\s*(\d+)\)")
+        m = _DECIMAL_RE.fullmatch(t)
+        if m:
+            return pa.decimal128(int(m.group(1)), int(m.group(2)))
+        raise ValueError(f"unsupported Spark type string {t!r}")
+    kind = t.get("type")
+    if kind == "struct":
+        return pa.struct(
+            [
+                pa.field(
+                    f["name"],
+                    _spark_type_to_arrow(f["type"]),
+                    f.get("nullable", True),
+                )
+                for f in t.get("fields", [])
+            ]
+        )
+    if kind == "array":
+        return pa.list_(
+            pa.field("element", _spark_type_to_arrow(t["elementType"]),
+                     t.get("containsNull", True))
+        )
+    if kind == "map":
+        return pa.map_(
+            _spark_type_to_arrow(t["keyType"]),
+            pa.field("value", _spark_type_to_arrow(t["valueType"]),
+                     t.get("valueContainsNull", True)),
+        )
+    raise ValueError(f"unsupported Spark type object {t!r}")
+
+
+def _arrow_type_to_spark(t: pa.DataType):
+    hit = _ARROW_TO_SPARK.get(t)
+    if hit is not None:
+        return hit
+    if pa.types.is_decimal(t):
+        return f"decimal({t.precision},{t.scale})"
+    if pa.types.is_timestamp(t):
+        return "timestamp" if t.tz else "timestamp_ntz"
+    if pa.types.is_large_string(t):
+        return "string"
+    if pa.types.is_large_binary(t):
+        return "binary"
+    if pa.types.is_struct(t):
+        return {
+            "type": "struct",
+            "fields": [
+                {
+                    "name": f.name,
+                    "type": _arrow_type_to_spark(f.type),
+                    "nullable": f.nullable,
+                    "metadata": {},
+                }
+                for f in t
+            ],
+        }
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return {
+            "type": "array",
+            "elementType": _arrow_type_to_spark(t.value_type),
+            "containsNull": t.value_field.nullable,
+        }
+    if pa.types.is_map(t):
+        return {
+            "type": "map",
+            "keyType": _arrow_type_to_spark(t.key_type),
+            "valueType": _arrow_type_to_spark(t.item_type),
+            "valueContainsNull": t.item_field.nullable,
+        }
+    # no Spark spelling (e.g. fixed_size_list tensor columns): record the
+    # Arrow name so the JSON stays honest; the IPC column remains the
+    # full-fidelity source for such tables
+    return str(t)
+
+
+def spark_schema_to_arrow(spark: dict | str) -> pa.Schema:
+    """Spark DataType JSON (struct) → Arrow schema."""
+    if isinstance(spark, str):
+        spark = json.loads(spark)
+    if spark.get("type") != "struct":
+        raise ValueError("Spark schema JSON must be a struct at top level")
+    struct = _spark_type_to_arrow(spark)
+    return pa.schema(list(struct))
+
+
+def schema_from_json(s: str) -> pa.Schema:
+    """Parse a ``table_schema`` JSON column: the reference's Spark encoding,
+    or this repo's pre-PR-7 debug mirror (``{"fields":[{"name","type"}]}``
+    with Arrow type names) for legacy rows."""
+    doc = json.loads(s)
+    if doc.get("type") == "struct":
+        return spark_schema_to_arrow(doc)
+    fields = []
+    for f in doc.get("fields", []):
+        try:
+            t = pa.type_for_alias(f["type"])
+        except ValueError as e:
+            raise ValueError(
+                f"legacy mirror schema field {f['name']!r} has no parseable"
+                f" type {f['type']!r} (and no IPC schema is present)"
+            ) from e
+        fields.append(pa.field(f["name"], t, f.get("nullable", True)))
+    if not fields:
+        raise ValueError("table_schema JSON has no fields")
+    return pa.schema(fields)
+
+
 def schema_to_json(schema: pa.Schema) -> str:
     return json.dumps(
         {
+            "type": "struct",
             "fields": [
-                {"name": f.name, "type": str(f.type), "nullable": f.nullable}
+                {
+                    "name": f.name,
+                    "type": _arrow_type_to_spark(f.type),
+                    "nullable": f.nullable,
+                    "metadata": {},
+                }
                 for f in schema
-            ]
+            ],
         }
     )
 

@@ -33,7 +33,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable
 
-from lakesoul_tpu.errors import CommitConflictError, MetadataError
+from lakesoul_tpu.errors import CommitConflictError, LeaseFencedError, MetadataError
 from lakesoul_tpu.meta.entity import (
     CommitOp,
     DataCommitInfo,
@@ -64,6 +64,28 @@ class CompactionEvent:
     table_namespace: str
     partition_desc: str
     version: int
+
+
+@dataclass(frozen=True)
+class Lease:
+    """One acquired lease row: who holds ``key``, until when (epoch millis
+    on the store's shared timebase), and the **fencing token** — a counter
+    that increments on every takeover, so a zombie holder presenting a
+    stale token is rejected even if its process is still running.
+
+    ``taken_over`` is set when this acquisition replaced an expired
+    holder's row (the takeover path); it is not persisted."""
+
+    key: str
+    holder: str
+    fencing_token: int
+    expires_at_ms: int
+    taken_over: bool = False
+
+    def guard(self) -> tuple[str, str, int]:
+        """The (key, holder, token) triple ``transaction_insert_partition_info``
+        verifies atomically with the commit (``lease_guard=``)."""
+        return (self.key, self.holder, self.fencing_token)
 
 
 _SCHEMA = """
@@ -133,6 +155,13 @@ CREATE TABLE IF NOT EXISTS discard_compressed_file_info (
     timestamp   INTEGER,
     t_date      TEXT
 );
+CREATE TABLE IF NOT EXISTS lease (
+    lease_key      TEXT PRIMARY KEY,
+    holder_id      TEXT,
+    fencing_token  BIGINT DEFAULT 0,
+    expires_at_ms  BIGINT,
+    acquired_at_ms BIGINT
+);
 """
 
 
@@ -140,7 +169,11 @@ class MetadataStore:
     """Abstract metadata backend. All methods are synchronous and thread-safe."""
 
     def transaction_insert_partition_info(
-        self, partitions: list[PartitionInfo], *, descs_canonical: bool = False
+        self,
+        partitions: list[PartitionInfo],
+        *,
+        descs_canonical: bool = False,
+        lease_guard: tuple[str, str, int] | None = None,
     ) -> None:
         raise NotImplementedError
 
@@ -480,7 +513,11 @@ class SqlMetadataStore(MetadataStore):
     _PI_COLS = "table_id, partition_desc, version, commit_op, timestamp, snapshot, expression, domain"
 
     def transaction_insert_partition_info(
-        self, partitions: list[PartitionInfo], *, descs_canonical: bool = False
+        self,
+        partitions: list[PartitionInfo],
+        *,
+        descs_canonical: bool = False,
+        lease_guard: tuple[str, str, int] | None = None,
     ) -> None:
         """Atomically insert new partition versions.  A PK conflict on
         (table_id, partition_desc, version) raises CommitConflictError —
@@ -492,13 +529,22 @@ class SqlMetadataStore(MetadataStore):
         same transaction (CAS), so client commits of new canonical
         partitions keep plan-time verification O(1).  Hand-committers that
         don't attest leave the flag behind the epoch, forcing the client's
-        full re-verification — the safe direction."""
+        full re-verification — the safe direction.
+
+        ``lease_guard=(key, holder, token)`` fences the commit on a lease
+        (:meth:`acquire_lease`) *inside the same transaction*: if the lease
+        row no longer matches — expired and re-acquired by a peer with a
+        higher fencing token — the whole insert rolls back with
+        :class:`LeaseFencedError`.  This is what makes a SIGKILLed-and-
+        replaced compactor's late commit impossible, not merely unlikely."""
         live = [p for p in partitions if p.version >= 0]
         descs_by_table: dict[str, set[str]] = {}
         for p in live:  # sentinel Default rows (version<0) are skipped
             descs_by_table.setdefault(p.table_id, set()).add(p.partition_desc)
         try:
             with self._txn() as conn:
+                if lease_guard is not None:
+                    self._verify_lease_guard(conn, lease_guard, now_millis())
                 # one batched existence probe per table (not per partition):
                 # which of this batch's descs are NEW to the desc set
                 new_desc_tables: set[str] = set()
@@ -739,6 +785,195 @@ class SqlMetadataStore(MetadataStore):
             )
         return [self._row_to_partition(r) for r in rows]
 
+    # -- leases --------------------------------------------------------------
+    # Cross-process coordination rows (per-partition compaction jobs, or any
+    # future singleton role).  Expiry is stored in epoch millis via
+    # now_millis() — the store is the SHARED timebase between processes, so
+    # wall clock is unavoidable here; NTP skew is absorbed by the TTL margin.
+    # Holders track their LOCAL validity with time.monotonic() (see
+    # compaction/service.py) and the fencing token — not the wall clock —
+    # is what makes a zombie's commit rejectable (lease_guard below).
+
+    def _lease_now_ms(self, now_ms: int | None) -> int:
+        return now_millis() if now_ms is None else int(now_ms)
+
+    def acquire_lease(
+        self, key: str, holder: str, ttl_ms: int, *, now_ms: int | None = None
+    ) -> Lease | None:
+        """Take the lease if free, expired, or already ours.
+
+        Returns the acquired :class:`Lease` (fencing token bumped on every
+        takeover of an expired holder's row) or None when a live peer holds
+        it.  ``now_ms`` is injectable for tests; atomic with respect to
+        concurrent acquirers (single write transaction; a lost PK-insert
+        race reads as "held by a peer")."""
+        now = self._lease_now_ms(now_ms)
+        try:
+            with self._txn() as conn:
+                row = self._exec(conn,
+                    "SELECT holder_id, fencing_token, expires_at_ms FROM lease WHERE lease_key=?",
+                    (key,),
+                ).fetchone()
+                if row is None:
+                    self._exec(conn,
+                        "INSERT INTO lease(lease_key, holder_id, fencing_token,"
+                        " expires_at_ms, acquired_at_ms) VALUES (?,?,?,?,?)",
+                        (key, holder, 1, now + ttl_ms, now),
+                    )
+                    return Lease(key, holder, 1, now + ttl_ms)
+                cur_holder, token, expires = row
+                if cur_holder == holder and expires > now:
+                    # re-entrant refresh by the current holder: same token.
+                    # Compare-and-set so a READ COMMITTED backend (the PG
+                    # path) can't refresh a row a peer already fenced past.
+                    cur = self._exec(conn,
+                        "UPDATE lease SET expires_at_ms=?"
+                        " WHERE lease_key=? AND holder_id=? AND fencing_token=?",
+                        (now + ttl_ms, key, holder, token),
+                    )
+                    if cur.rowcount == 0:
+                        return None
+                    return Lease(key, holder, token, now + ttl_ms)
+                if expires > now:
+                    return None  # a live peer holds it
+                # expired: take over with a HIGHER fencing token — the old
+                # holder may still be running, but its token is now stale.
+                # The WHERE re-checks token+expiry so two racing takeovers
+                # can't both win: the loser's UPDATE matches zero rows.
+                cur = self._exec(conn,
+                    "UPDATE lease SET holder_id=?, fencing_token=?,"
+                    " expires_at_ms=?, acquired_at_ms=?"
+                    " WHERE lease_key=? AND fencing_token=? AND expires_at_ms<=?",
+                    (holder, token + 1, now + ttl_ms, now, key, token, now),
+                )
+                if cur.rowcount == 0:
+                    return None  # a peer's takeover committed first
+                return Lease(
+                    key, holder, token + 1, now + ttl_ms,
+                    # a cleanly-released tombstone (holder '') is a fresh
+                    # acquisition, not a takeover of a dead peer
+                    taken_over=cur_holder not in ("", holder),
+                )
+        except self.INTEGRITY_ERRORS:
+            return None  # lost the insert race: a peer got there first
+
+    def renew_lease(
+        self, key: str, holder: str, fencing_token: int, ttl_ms: int,
+        *, now_ms: int | None = None,
+    ) -> Lease | None:
+        """Extend a lease we still hold.  None when the lease is gone,
+        held by someone else, carries a different token, or ALREADY EXPIRED
+        — an expired lease must be re-acquired (possibly bumping the
+        token), never silently revived: the renewal gap is exactly where a
+        peer may have taken over."""
+        now = self._lease_now_ms(now_ms)
+        with self._txn() as conn:
+            # single compare-and-set: the full predicate rides in the WHERE
+            # so a READ COMMITTED backend can't revive a lease a peer
+            # re-acquired between a separate read and write
+            cur = self._exec(conn,
+                "UPDATE lease SET expires_at_ms=?"
+                " WHERE lease_key=? AND holder_id=? AND fencing_token=?"
+                " AND expires_at_ms>?",
+                (now + ttl_ms, key, holder, fencing_token, now),
+            )
+            if cur.rowcount == 0:
+                return None
+            return Lease(key, holder, fencing_token, now + ttl_ms)
+
+    def release_lease(self, key: str, holder: str, fencing_token: int) -> bool:
+        """Drop the lease iff we still hold it under this token (a zombie's
+        release must not free a peer's re-acquired lease).
+
+        The row is TOMBSTONED (holder cleared, expiry zeroed), never
+        deleted: deleting would restart fencing tokens at 1 on the next
+        acquisition, and a hung ex-holder that rejoined under the same
+        service id could then pass the commit guard with its stale token.
+        Keeping the row keeps the token sequence monotonic per key for the
+        table's lifetime."""
+        with self._txn() as conn:
+            cur = self._exec(conn,
+                "UPDATE lease SET holder_id='', expires_at_ms=0"
+                " WHERE lease_key=? AND holder_id=? AND fencing_token=?",
+                (key, holder, fencing_token),
+            )
+            return cur.rowcount > 0
+
+    def get_lease(self, key: str) -> Lease | None:
+        row = self._exec(self._conn(),
+            "SELECT holder_id, fencing_token, expires_at_ms FROM lease WHERE lease_key=?",
+            (key,),
+        ).fetchone()
+        if row is None or row[0] == "":  # absent or released tombstone
+            return None
+        return Lease(key, row[0], row[1], row[2])
+
+    # appended to the guard SELECT so backends with row-level concurrency
+    # (PG, READ COMMITTED) lock the lease row until the commit txn ends —
+    # without it a peer's takeover can interleave between guard and commit.
+    # SQLite's fully-serialized _txn needs (and supports) no FOR UPDATE.
+    LEASE_GUARD_LOCK = ""
+
+    def _verify_lease_guard(self, conn, guard: tuple, now: int) -> None:
+        key, holder, token = guard
+        row = self._exec(conn,
+            "SELECT holder_id, fencing_token, expires_at_ms FROM lease"
+            f" WHERE lease_key=?{self.LEASE_GUARD_LOCK}",
+            (key,),
+        ).fetchone()
+        if row is None or row[0] != holder or row[1] != token or row[2] <= now:
+            raise LeaseFencedError(
+                f"lease {key!r} no longer held by {holder!r} with token {token}"
+                f" (current: {row!r}); abandoning the commit"
+            )
+
+    # -- compaction candidates ----------------------------------------------
+    def get_compaction_candidates(
+        self, version_gap: int = COMPACTION_TRIGGER_VERSION_GAP
+    ) -> list[CompactionEvent]:
+        """Partitions whose committed head has advanced ≥ ``version_gap``
+        versions past their last CompactionCommit — the state the PG trigger
+        derives its notify from (meta_init.sql:101-150), re-derivable by ANY
+        process at ANY time.  This is what makes the polling consumer
+        crash-safe: the 'watermark' is the last compaction version already
+        in ``partition_info``, so a consumer killed mid-job loses nothing —
+        the gap persists and the next poll (in any process) re-emits it."""
+        rows = self._exec(self._conn(),
+            "SELECT table_id, partition_desc, MAX(version),"
+            " COALESCE(MAX(CASE WHEN commit_op=? THEN version END), -1)"
+            " FROM partition_info GROUP BY table_id, partition_desc"
+            " HAVING MAX(version) -"
+            " COALESCE(MAX(CASE WHEN commit_op=? THEN version END), -1) >= ?",
+            (CommitOp.COMPACTION.value, CommitOp.COMPACTION.value, version_gap),
+        ).fetchall()
+        if not rows:
+            return []
+        # one batched lookup for the candidate tables' path/namespace —
+        # this runs on EVERY poll of every service, so no per-row queries
+        ids = sorted({table_id for table_id, _, _, _ in rows})
+        ph = ",".join("?" * len(ids))
+        info = {
+            r[0]: (r[1], r[2])
+            for r in self._exec(self._conn(),
+                "SELECT table_id, table_path, table_namespace"
+                f" FROM table_info WHERE table_id IN ({ph})",
+                tuple(ids),
+            ).fetchall()
+        }
+        out: list[CompactionEvent] = []
+        for table_id, desc, head, _last in rows:
+            path, namespace = info.get(table_id, ("", "default"))
+            out.append(
+                CompactionEvent(
+                    table_id=table_id,
+                    table_path=path,
+                    table_namespace=namespace,
+                    partition_desc=desc,
+                    version=head,
+                )
+            )
+        return out
+
     # -- global config -------------------------------------------------------
     def get_global_config(self, key: str, default: str | None = None, *, conn=None) -> str | None:
         row = self._exec(conn or self._conn(),
@@ -819,6 +1054,7 @@ class SqlMetadataStore(MetadataStore):
                 "data_commit_info",
                 "partition_info",
                 "discard_compressed_file_info",
+                "lease",
             ):
                 self._exec(conn, f"DELETE FROM {t}")
 
@@ -935,6 +1171,9 @@ class PostgresMetadataStore(SqlMetadataStore):
     # a linguistic cluster collation (en_US.UTF-8) breaks the prefix-range
     # bound math; "C" is byte order and always present in PG
     DESC_RANGE_COLLATION = ' COLLATE "C"'
+    # READ COMMITTED: the commit-time fencing check must hold the lease row
+    # against a concurrent takeover UPDATE until the commit txn ends
+    LEASE_GUARD_LOCK = " FOR UPDATE"
 
     _PG_SCHEMA = re.sub(
         r"timestamp(\s+)INTEGER", r"timestamp\1BIGINT",

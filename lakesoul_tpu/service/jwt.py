@@ -40,7 +40,7 @@ class JwtServer:
 
     def create_token(self, claims: Claims, *, ttl_seconds: int = 3600) -> str:
         header = {"alg": "HS256", "typ": "JWT"}
-        exp = claims.exp or int(time.time()) + ttl_seconds
+        exp = claims.exp or int(time.time()) + ttl_seconds  # lakelint: ignore[wall-clock-lease] JWT exp is wire-format epoch seconds (RFC 7519); wall clock IS the spec here
         payload = {"sub": claims.sub, "group": claims.group, "exp": exp}
         signing_input = f"{_b64url(json.dumps(header).encode())}.{_b64url(json.dumps(payload).encode())}"
         sig = hmac.new(self._secret, signing_input.encode(), hashlib.sha256).digest()

@@ -22,12 +22,14 @@ class RbacVerifier:
 
     def _cached(self, key: tuple) -> bool | None:
         hit = self._cache.get(key)
-        if hit and time.time() - hit[0] < self.cache_ttl:
+        # monotonic: an NTP step back would otherwise pin stale verdicts
+        # in the cache past their TTL (wall-clock-lease lint)
+        if hit and time.monotonic() - hit[0] < self.cache_ttl:
             return hit[1]
         return None
 
     def _store(self, key: tuple, ok: bool) -> bool:
-        self._cache[key] = (time.time(), ok)
+        self._cache[key] = (time.monotonic(), ok)
         return ok
 
     @staticmethod

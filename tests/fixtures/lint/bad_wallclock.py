@@ -1,0 +1,48 @@
+"""Seeded wall-clock-lease violations: time.time() arithmetic deciding
+TTL/deadline/lease questions — the clock bugs the lease table makes
+load-bearing — plus the legal shapes (monotonic math, plain epoch
+timestamping) that must stay silent."""
+
+import time
+
+LEASE_TTL_S = 30.0
+
+
+def hold_lease_with_wall_clock(store, key, holder):
+    deadline = time.time() + LEASE_TTL_S  # SEED: wall-clock-lease
+    while time.time() < deadline:  # SEED: wall-clock-lease
+        store.renew(key, holder)
+
+
+def lease_expired(lease):
+    return lease.expires_at < time.time()  # SEED: wall-clock-lease
+
+
+def sweep_with_timeout(jobs, timeout):
+    sweep_deadline = time.time() + timeout  # SEED: wall-clock-lease
+    for job in jobs:
+        if time.time() >= sweep_deadline:  # SEED: wall-clock-lease
+            break
+        job.run()
+
+
+def stamp_event(event):
+    # allowed: a plain epoch timestamp (no duration/TTL math in the
+    # statement) — the now_millis()-style stamping the store relies on
+    event.timestamp_ms = int(time.time() * 1000)
+    return event
+
+
+def monotonic_deadline_is_fine(ttl_s):
+    # allowed: local windows on the monotonic clock are exactly the fix
+    deadline = time.monotonic() + ttl_s
+    while time.monotonic() < deadline:
+        pass
+
+
+def keyword_in_body_not_test(flag):
+    # allowed: the while's CONTROLLING expression has no ttl-ish name;
+    # the lease work in the body is separate statements with no wall clock
+    while flag.is_set():
+        renew_lease = True
+        del renew_lease

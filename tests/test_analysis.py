@@ -99,6 +99,24 @@ def test_ad_hoc_retry_rule_line_exact():
     assert sum(m.startswith("sleep-based backoff") for m in messages) == 1
 
 
+def test_wall_clock_lease_rule_line_exact():
+    """The 18th rule: time.time() arithmetic in TTL/deadline/lease math is
+    flagged line-exactly; plain epoch stamping, monotonic math, and
+    keyword-free control expressions stay silent."""
+    from lakesoul_tpu.analysis.rules.wallclock import WallClockLeaseRule
+
+    rules = [WallClockLeaseRule(scope=("bad_wallclock.py",))]
+    found = [
+        f for f in lint_fixture("bad_wallclock.py", rules=rules)
+        if f.rule == "wall-clock-lease"
+    ]
+    assert len(found) == 5, found
+    assert_seed_lines(found, "bad_wallclock.py", "wall-clock-lease")
+    # out-of-scope path (fixture root isn't service/compaction/meta): the
+    # default-scoped catalog stays silent even with violations present
+    assert lint_fixture("bad_wallclock.py") == []
+
+
 def test_ad_hoc_retry_rule_exempts_resilience_module(tmp_path):
     """The one legal retry loop lives in runtime/resilience.py — the same
     shape there must not be flagged."""
@@ -315,7 +333,7 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 17 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 18 and "rbac-gate-reachability" in rule_ids
     assert "pallas-blockspec" in rule_ids
     for r in driver["rules"]:
         assert r["shortDescription"]["text"]

@@ -57,7 +57,10 @@ class TestWriter:
         outs = w.close()
         descs = sorted(o.partition_desc for o in outs)
         assert descs == ["date=d1", "date=d2"]
-        t = pq.read_table([o for o in outs if o.partition_desc == "date=d1"][0].path)
+        t = pq.read_table(
+            [o for o in outs if o.partition_desc == "date=d1"][0].path,
+            partitioning=None,  # single data file; no hive path inference
+        )
         assert "date" not in t.column_names  # directory-encoded
         assert t.num_rows == 2
         assert "date=d1" in outs[0].path

@@ -1,0 +1,314 @@
+"""Lease-machine edges (PR 7): expiry during renew, fencing-token
+rejection of a zombie holder's commit, crash-recovery interacting with a
+dead leaseholder's debris, and the polling watermark notifier's
+crash-safety — all on injectable clocks (``now_ms=``), no sleeps except
+the one real-TTL zombie test."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.compaction.events import PollingWatermarkNotifier
+from lakesoul_tpu.compaction.service import LeasedCompactionService
+from lakesoul_tpu.errors import LeaseFencedError
+from lakesoul_tpu.meta.entity import CommitOp
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    return LakeSoulCatalog(str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db"))
+
+
+def _stack_versions(t, n=12, rows=6):
+    for i in range(n):
+        t.upsert(pa.table({
+            "id": np.arange(rows, dtype=np.int64),
+            "v": np.full(rows, float(i)),
+        }))
+
+
+class TestLeasePrimitives:
+    def test_acquire_free_then_held_then_reentrant(self, catalog):
+        store = catalog.client.store
+        a = store.acquire_lease("k", "alice", 1000, now_ms=100)
+        assert a.fencing_token == 1 and a.expires_at_ms == 1100 and not a.taken_over
+        assert store.acquire_lease("k", "bob", 1000, now_ms=200) is None
+        again = store.acquire_lease("k", "alice", 1000, now_ms=500)
+        assert again.fencing_token == 1 and again.expires_at_ms == 1500
+
+    def test_expiry_during_renew(self, catalog):
+        """THE renew edge: once the TTL passes, renew fails even if NOBODY
+        re-acquired — an expired lease must go back through acquire (where
+        a takeover would bump the token), never be silently revived, because
+        the renewal gap is exactly where a peer may have slipped in."""
+        store = catalog.client.store
+        lease = store.acquire_lease("k", "alice", 1000, now_ms=0)
+        ok = store.renew_lease("k", "alice", lease.fencing_token, 1000, now_ms=900)
+        assert ok is not None and ok.expires_at_ms == 1900
+        assert store.renew_lease("k", "alice", ok.fencing_token, 1000, now_ms=1900) is None
+        # re-acquire by the SAME holder after expiry still bumps the token:
+        # the gap is indistinguishable from a takeover window
+        back = store.acquire_lease("k", "alice", 1000, now_ms=2000)
+        assert back.fencing_token == lease.fencing_token + 1
+
+    def test_takeover_bumps_token_and_fences_renewal(self, catalog):
+        store = catalog.client.store
+        store.acquire_lease("k", "alice", 1000, now_ms=0)
+        taken = store.acquire_lease("k", "bob", 1000, now_ms=1500)
+        assert taken.taken_over and taken.fencing_token == 2
+        # the zombie's renew and release are both dead ends
+        assert store.renew_lease("k", "alice", 1, 1000, now_ms=1600) is None
+        assert not store.release_lease("k", "alice", 1)
+        assert store.get_lease("k").holder == "bob"
+
+    def test_release_clears_only_matching_token(self, catalog):
+        store = catalog.client.store
+        lease = store.acquire_lease("k", "alice", 1000, now_ms=0)
+        assert store.release_lease("k", "alice", lease.fencing_token)
+        assert store.get_lease("k") is None
+        fresh = store.acquire_lease("k", "bob", 1000, now_ms=10)
+        # release tombstones the row instead of deleting it, so tokens stay
+        # monotonic per key — and acquiring a cleanly-released lease is not
+        # a "takeover" (no dead peer was displaced)
+        assert fresh.fencing_token == lease.fencing_token + 1
+        assert not fresh.taken_over
+
+    def test_tokens_stay_monotonic_across_release_cycles(self, catalog):
+        """THE zombie-rebirth edge: alice (token 1) hangs past TTL, bob
+        takes over (token 2), compacts and releases.  If release deleted
+        the row, the next acquisition would mint token 1 again and the
+        still-alive alice process would pass the commit guard with her
+        stale token.  Tombstoning keeps every later token strictly higher,
+        so alice's token 1 can never match again."""
+        store = catalog.client.store
+        store.acquire_lease("k", "alice", 1000, now_ms=0)  # hangs
+        bob = store.acquire_lease("k", "bob", 1000, now_ms=2000)
+        assert bob.taken_over and bob.fencing_token == 2
+        assert store.release_lease("k", "bob", bob.fencing_token)
+        # a RESTARTED service reusing the id "alice" acquires next
+        fresh = store.acquire_lease("k", "alice", 1000, now_ms=2500)
+        assert fresh.fencing_token == 3
+        # the original hung alice still holds token 1 — renew, release and
+        # (via the commit guard's token match) commit are all dead ends
+        assert store.renew_lease("k", "alice", 1, 1000, now_ms=2600) is None
+        assert not store.release_lease("k", "alice", 1)
+        assert store.get_lease("k").fencing_token == 3
+
+    def test_concurrent_acquirers_one_winner(self, catalog):
+        store = catalog.client.store
+        wins: list[str] = []
+        barrier = threading.Barrier(6)
+
+        def race(name):
+            barrier.wait()
+            if store.acquire_lease("hot", name, 60_000) is not None:
+                wins.append(name)
+
+        threads = [threading.Thread(target=race, args=(f"s{i}",)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        assert store.get_lease("hot").holder == wins[0]
+
+
+class TestFencingAtCommit:
+    def test_zombie_compaction_commit_is_fenced(self, catalog):
+        """A compactor that stalls past its TTL and is replaced must NOT be
+        able to land its commit: the lease guard runs inside the commit
+        transaction, so the zombie's work vanishes atomically."""
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        store = catalog.client.store
+        zombie = store.acquire_lease("compaction/x", "zombie", ttl_ms=1)
+        import time
+
+        time.sleep(0.01)  # let the 1 ms TTL lapse
+        peer = store.acquire_lease("compaction/x", "peer", ttl_ms=60_000)
+        assert peer.taken_over and peer.fencing_token == 2
+        before = t.to_arrow().sort_by("id")
+        with pytest.raises(LeaseFencedError):
+            t.compact(lease=zombie)
+        # nothing landed: no CompactionCommit, identical table state
+        head = store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op != CommitOp.COMPACTION
+        assert t.refresh().to_arrow().sort_by("id").equals(before)
+        # ... and the peer's commit (valid token) goes through, stamped
+        assert t.compact(lease=peer) == 1
+        head = store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op == CommitOp.COMPACTION
+        assert head.expression == "fence=2"
+
+    def test_fenced_commit_cleans_its_own_debris(self, catalog):
+        """A fenced commit is dead for good — the client deletes its
+        phase-1 rows immediately instead of leaving committed=0 debris for
+        a recovery sweep (the two-services-race chaos test caught exactly
+        that leak before this cleanup existed)."""
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        store = catalog.client.store
+        dead = store.acquire_lease("compaction/t", "dead", ttl_ms=1)
+        import time
+
+        time.sleep(0.01)
+        store.acquire_lease("compaction/t", "somebody", ttl_ms=60_000)
+        with pytest.raises(LeaseFencedError):
+            t.compact(lease=dead)
+        assert store.list_uncommitted_commits() == []
+
+    def test_recovery_rolls_back_killed_leaseholders_debris(self, catalog, tmp_path):
+        """A compactor SIGKILLed between commit phases (no chance to clean
+        up) leaves committed=0 COMPACTION rows + staged files, while its
+        lease quietly expires.  recover_incomplete_commits must roll that
+        back — snapshot-replacing ops are never rolled forward, their
+        read-version validation died with the holder — and the partition's
+        still-open gap is then compacted by a healthy peer."""
+        from lakesoul_tpu.meta.entity import DataCommitInfo, DataFileOp
+
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        store = catalog.client.store
+        # the dead holder's trail: an expired lease and phase-1 debris
+        store.acquire_lease(f"compaction/{t.info.table_id}/-5", "dead", ttl_ms=1)
+        staged = tmp_path / "part-deadbeef_0000.parquet"
+        staged.write_bytes(b"never-committed compaction output")
+        store.insert_data_commit_info([
+            DataCommitInfo(
+                table_id=t.info.table_id,
+                partition_desc="-5",
+                commit_id=DataCommitInfo.new_commit_id(),
+                file_ops=[DataFileOp(path=str(staged), size=staged.stat().st_size)],
+                commit_op=CommitOp.COMPACTION,
+                committed=False,
+            )
+        ])
+        import time
+
+        time.sleep(0.01)  # the 1 ms lease lapses; nobody renews it
+        counts = catalog.client.recover_incomplete_commits(min_age_ms=0)
+        assert counts == {"flag_repaired": 0, "rolled_forward": 0, "rolled_back": 1}
+        assert store.list_uncommitted_commits() == []
+        assert not staged.exists()  # the orphaned output was reclaimed
+        # recovery never touches the lease table — expiry is the mechanism
+        lease = store.get_lease(f"compaction/{t.info.table_id}/-5")
+        assert lease is not None and lease.holder == "dead"
+        # the gap is still open; a healthy service takes over from here
+        svc = LeasedCompactionService(catalog, lease_ttl_s=30, poll_interval_s=0.01)
+        assert svc.poll_once()["compacted"] == 1
+        head = store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op == CommitOp.COMPACTION
+        assert head.expression == "fence=2"  # takeover of the dead holder's lease
+        assert t.refresh().to_arrow().num_rows == 6
+
+
+class TestPollingWatermark:
+    def test_candidates_derive_from_committed_state(self, catalog):
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        store = catalog.client.store
+        assert store.get_compaction_candidates() == []
+        _stack_versions(t, n=12)
+        cands = store.get_compaction_candidates()
+        assert [c.partition_desc for c in cands] == ["-5"]
+        assert cands[0].table_path == t.info.table_path
+
+    def test_killed_consumer_loses_no_events(self, catalog):
+        """Crash-safety of the watermark design: a consumer that polled and
+        died delivers nothing — a FRESH consumer (new process, empty
+        memory) re-derives the same candidate, because the watermark is the
+        committed compaction version, not consumer state."""
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        store = catalog.client.store
+        seen_a: list = []
+        a = PollingWatermarkNotifier(store)
+        a.listen(seen_a.append)
+        assert a.poll() == 1 and len(seen_a) == 1
+        del a  # consumer dies without acting
+        seen_b: list = []
+        b = PollingWatermarkNotifier(store)
+        b.listen(seen_b.append)
+        assert b.poll() == 1
+        assert seen_b[0].partition_desc == seen_a[0].partition_desc
+        # once compaction commits, the candidate disappears for EVERYONE
+        t.compact()
+        assert b.poll() == 0
+
+    def test_open_gap_redelivered_every_poll(self, catalog):
+        """At-least-once is the contract: an open gap re-emits on every
+        poll until a CompactionCommit closes it — repeat suppression is
+        the consumer's job (see LeasedCompactionService._skipped_heads)."""
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        store = catalog.client.store
+        seen: list = []
+        n = PollingWatermarkNotifier(store)
+        n.listen(seen.append)
+        assert n.poll() == 1
+        assert n.poll() == 1  # still open → delivered again
+        assert seen[0].partition_desc == seen[1].partition_desc
+        t.compact()
+        assert n.poll() == 0  # gap closed for everyone
+
+
+class TestLeasedServiceUnits:
+    def test_poll_once_compacts_and_releases(self, catalog):
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        svc = LeasedCompactionService(catalog, lease_ttl_s=30, poll_interval_s=0.01)
+        counts = svc.poll_once()
+        assert counts["candidates"] == 1 and counts["compacted"] == 1
+        store = catalog.client.store
+        head = store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op == CommitOp.COMPACTION
+        assert head.expression == "fence=1"
+        # lease released; nothing left to do
+        assert store.get_lease(svc._lease_key(
+            type("E", (), {"table_id": t.info.table_id, "partition_desc": "-5"})()
+        )) is None
+        assert svc.poll_once()["candidates"] == 0
+
+    def test_job_longer_than_ttl_completes_via_heartbeat(self, catalog):
+        """A compaction that outlives one TTL must still commit: the
+        heartbeat renews the store row at TTL/3, so the commit-time lease
+        guard sees a live lease and the original fencing token.  Without
+        renewal this livelocks — every pass fences at commit, a peer
+        re-runs the same doomed job, and the partition never compacts."""
+        from lakesoul_tpu.runtime import faults
+
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        svc = LeasedCompactionService(catalog, lease_ttl_s=0.3, poll_interval_s=0.01)
+        # stall inside the leased window for 3× the TTL before compacting
+        faults.install("compaction.leased_job:1.0:delay:0.9")
+        try:
+            counts = svc.poll_once()
+        finally:
+            faults.clear()
+        assert counts["compacted"] == 1 and counts["fenced"] == 0, counts
+        store = catalog.client.store
+        head = store.get_latest_partition_info(t.info.table_id, "-5")
+        assert head.commit_op == CommitOp.COMPACTION
+        assert head.expression == "fence=1"  # the ORIGINAL token, renewed alive
+
+    def test_peer_with_held_lease_skips(self, catalog):
+        t = catalog.create_table("t", SCHEMA, primary_keys=["id"], hash_bucket_num=1)
+        _stack_versions(t)
+        store = catalog.client.store
+        key = f"compaction/{t.info.table_id}/-5"
+        store.acquire_lease(key, "other-process", ttl_ms=60_000)
+        svc = LeasedCompactionService(catalog, lease_ttl_s=1, poll_interval_s=0.01)
+        counts = svc.poll_once()
+        assert counts == {
+            "candidates": 1, "compacted": 0, "skipped": 0,
+            "lease_held": 1, "fenced": 0, "conflicts": 0, "errors": 0,
+        }
+        # the partition was NOT compacted and stays a candidate
+        assert store.get_compaction_candidates() != []

@@ -162,6 +162,35 @@ class TestCommitProtocol:
                 read_partition_info=[stale],
             )
 
+    def test_conflicted_update_cleanup_follows_staged_file_fate(self, client):
+        """A conflicted UPDATE whose caller deletes its staged files
+        (``staged_deleted_on_conflict=True``, the partition-rewrite DML
+        path) must not leave committed=0 rows pointing at nothing; the
+        default keeps the rows because cdc replay reuses the same staged
+        files and recovery needs them to reclaim the files on give-up."""
+        info = make_table(client)
+        append_files(client, info, "-5", ["/f/part-a_0000.parquet"])
+        stale = client.store.get_latest_partition_info(info.table_id, "-5")
+        append_files(client, info, "-5", ["/f/part-b_0000.parquet"])
+        for flag, rows_left in ((True, 0), (False, 1)):
+            with pytest.raises(CommitConflictError):
+                client.commit_data_files(
+                    info,
+                    {"-5": [DataFileOp(path=f"/f/part-up{flag}_0000.parquet")]},
+                    CommitOp.UPDATE,
+                    read_partition_info=[stale],
+                    staged_deleted_on_conflict=flag,
+                )
+            debris = [
+                c for c in client.store.list_uncommitted_commits()
+                if c.table_id == info.table_id
+            ]
+            assert len(debris) == rows_left, (flag, debris)
+            for c in debris:
+                client.store.delete_data_commit_info(
+                    c.table_id, c.partition_desc, [c.commit_id]
+                )
+
     def test_delete_clears_snapshot(self, client):
         info = make_table(client)
         append_files(client, info, "-5", ["/f/part-a_0000.parquet"])

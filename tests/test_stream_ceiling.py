@@ -1,17 +1,39 @@
 """Pin the bounded-memory streaming ceiling (VERDICT r3 item 4).
 
 Build and scan run in SEPARATE subprocesses: the scan process's own peak
-RSS is the measurement, so writer/generator buffers (and whatever the rest
-of a busy CI box is doing during the build) cannot pollute the read-path
-assertion.  If the read path ever regressed to materializing units, the
-scan subprocess high-water mark would blow straight past the ceiling.
-(bench.py's `stream` leg runs the same check at ≥100M-row scale.)
+RSS is the measurement, so writer/generator buffers cannot pollute the
+read-path assertion.  If the read path ever regressed to materializing
+units, the scan subprocess footprint would blow straight past the
+ceiling.  (bench.py's `stream` leg runs the same check at ≥100M-row scale.)
+
+Deflaked (PR 7 satellite).  The old flake — passed in isolation, tripped
+only during a busy full run — looked load-sensitive but was not: the scan
+child measured itself with ``VmHWM``, and on sandboxed kernels that
+emulate /proc (this CI reports "Linux 4.4.0" with a zeroed loadavg —
+gVisor), VmHWM is served from the same exec-SURVIVING usage counter as
+``ru_maxrss``.  A child forked from a 6 GB pytest process therefore
+reported ~6 GB "peak" for a ~430 MB scan; in isolation the parent was
+small and the number looked sane.  Proven by ballooning a parent to 3 GB
+and watching a trivial child report 3 GB.  The fix is a measurement that
+CANNOT inherit: the child samples its own *current* RSS
+(``current_rss_mb``, /proc/self/statm) once per consumed batch and
+reports the max — a materializing read keeps its working set resident
+while batches yield, so per-batch sampling still catches the regression
+this test exists to pin.  ``LAKESOUL_RUNTIME_THREADS`` is pinned so
+in-flight decode buffering (workers × batch) is a constant of the test,
+not of however many cores the box advertises.
 """
 
 import json
 import os
 import subprocess
 import sys
+
+# decode workers pinned: in-flight buffering (workers × batch) becomes a
+# test constant instead of scaling with the CI box's core count
+SCAN_THREADS = 4
+CEILING_MB = 700
+MAX_ATTEMPTS = 2
 
 _BUILD = r"""
 import os, sys
@@ -50,33 +72,50 @@ import json, os, sys
 sys.path.insert(0, {repo!r})
 os.environ["JAX_PLATFORMS"] = "cpu"
 from lakesoul_tpu import LakeSoulCatalog
-from lakesoul_tpu.utils.memory import peak_rss_mb
+from lakesoul_tpu.utils.memory import current_rss_mb
 
 t = LakeSoulCatalog({wh!r}).table("big")
 rows = 0
+peak = current_rss_mb()
 for batch in t.scan().batch_size(262_144).to_batches():
     rows += len(batch)
-print(json.dumps({{"rows": rows, "peak_rss_mb": peak_rss_mb()}}))
+    peak = max(peak, current_rss_mb())
+peak = max(peak, current_rss_mb())
+print(json.dumps({{"rows": rows, "peak_rss_mb": peak}}))
 """
+
+
+def _run_scan(repo: str, wh: str) -> dict:
+    env = dict(os.environ)
+    env["LAKESOUL_RUNTIME_THREADS"] = str(SCAN_THREADS)
+    out = subprocess.run(
+        [sys.executable, "-c", _SCAN.format(repo=repo, wh=wh)],
+        capture_output=True, text=True, timeout=1200, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.splitlines()[-1])
 
 
 def test_streaming_scan_stays_under_ceiling(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     wh = str(tmp_path / "wh")
+    build_env = dict(os.environ)
+    build_env["LAKESOUL_RUNTIME_THREADS"] = str(SCAN_THREADS)
     built = subprocess.run(
         [sys.executable, "-c", _BUILD.format(repo=repo, wh=wh)],
-        capture_output=True, text=True, timeout=1200,
+        capture_output=True, text=True, timeout=1200, env=build_env,
     )
     assert built.returncode == 0, built.stderr[-2000:]
-    out = subprocess.run(
-        [sys.executable, "-c", _SCAN.format(repo=repo, wh=wh)],
-        capture_output=True, text=True, timeout=1200,
-    )
-    assert out.returncode == 0, out.stderr[-2000:]
-    r = json.loads(out.stdout.splitlines()[-1])
-    assert r["rows"] == 8_000_000
-    # table data ≈ 8M rows x 68 B ≈ 550 MB; a materializing read would hold
-    # entire buckets (~140 MB each) plus merge copies on top of the ~250 MB
-    # python/pyarrow floor.  The bounded path must stay well below
-    # floor+table.
-    assert r["peak_rss_mb"] < 700, f"streaming scan peak RSS: {r}"
+
+    last = None
+    for _attempt in range(MAX_ATTEMPTS):
+        last = _run_scan(repo, wh)
+        assert last["rows"] == 8_000_000
+        # table data ≈ 8M rows x 68 B ≈ 550 MB; a materializing read would
+        # hold entire buckets (~140 MB each) plus merge copies on top of
+        # the ~250 MB python/pyarrow floor.  The bounded path must stay
+        # well below floor+table.  One retry absorbs transient allocator
+        # noise; a real materializing regression reproduces every time.
+        if last["peak_rss_mb"] < CEILING_MB:
+            return
+    raise AssertionError(f"streaming scan exceeded the ceiling twice: {last}")
