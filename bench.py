@@ -276,8 +276,10 @@ def bench_stream_bounded(t) -> dict:
     fresh subprocess so the high-water mark is this leg's own; measured via
     VmHWM — ru_maxrss survives exec and would report the bench driver's
     peak (utils/memory.py).  No JAX in this leg (pure host path)."""
+    from lakesoul_tpu.obs.stages import stage_seconds
     from lakesoul_tpu.utils.memory import peak_rss_mb as _peak
 
+    stages0 = stage_seconds()
     start = time.perf_counter()
     rows = 0
     for batch in t.scan().batch_size(262_144).to_batches():
@@ -295,6 +297,11 @@ def bench_stream_bounded(t) -> dict:
         "peak_rss_mb": round(peak_rss_mb, 1),
         "budget_mb": STREAM_BUDGET_MB,
         "ceiling_mb": STREAM_RSS_CEILING_MB,
+        # per-stage attribution (lakesoul_scan_stage_seconds delta): the
+        # breakdown every scan-path perf claim is judged against
+        "scan_stages": {
+            k: round(v - stages0[k], 3) for k, v in stage_seconds().items()
+        },
     }
 
 
@@ -1281,10 +1288,21 @@ def run_one_leg(leg: str) -> None:
         return
     catalog = LakeSoulCatalog(warehouse)
     t = catalog.table(f"bench_{N_ROWS}_lsf")
+    from lakesoul_tpu.obs.stages import stage_seconds
+
     if leg == "train_hbm":
         print(json.dumps({"rows_per_s": bench_lakesoul(t, epochs=3, device_cache=True)}))
         return
-    print(json.dumps({"rows_per_s": bench_lakesoul(t, epochs=5)}))
+    stages0 = stage_seconds()
+    value = bench_lakesoul(t, epochs=5)
+    print(json.dumps({
+        "rows_per_s": value,
+        # per-stage attribution over ALL epochs of the leg (ratios are what
+        # matter; the throughput figure is best-of-epochs above)
+        "scan_stages": {
+            k: round(v - stages0[k], 3) for k, v in stage_seconds().items()
+        },
+    }))
 
 
 def main():
@@ -1377,15 +1395,21 @@ def main():
             # served table sits in (ref stance: read throughput = bucket
             # parallelism + aggressive compaction, SURVEY §7)
             t.compact()
-            return _run_leg("train", env=dev_env)["rows_per_s"]
+            return _run_leg("train", env=dev_env)
 
         def headline_fields(out):
-            fields = {"value": round(out, 1)}
+            fields = {"value": round(out["rows_per_s"], 1)}
+            if out.get("scan_stages"):
+                # committed breakdown: every scan-path claim is a number
+                fields["scan_stages"] = out["scan_stages"]
             if baseline_host is not None and baseline_host == baseline_host:
-                fields["vs_baseline_host_decode_only"] = round(out / baseline_host, 3)
+                fields["vs_baseline_host_decode_only"] = round(
+                    out["rows_per_s"] / baseline_host, 3
+                )
             return fields
 
-        value = emit.leg("headline", headline_leg, headline_fields, cost_s=420)
+        headline_out = emit.leg("headline", headline_leg, headline_fields, cost_s=420)
+        value = headline_out["rows_per_s"] if headline_out else None
 
         def baseline_e2e_fields(out):
             if out != out:  # torch missing → NaN: never fake a 1.0 ratio
@@ -1458,6 +1482,7 @@ def main():
                 "stream_peak_rss_mb": out["peak_rss_mb"],
                 "stream_budget_mb": out["budget_mb"],
                 "stream_rss_ceiling_mb": out["ceiling_mb"],
+                "stream_scan_stages": out.get("scan_stages"),
             },
             cost_s=300,
         )

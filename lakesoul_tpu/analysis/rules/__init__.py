@@ -26,6 +26,7 @@ from lakesoul_tpu.analysis.rules.conventions import (
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.perf import HotPathMaterializeRule
 from lakesoul_tpu.analysis.rules.jaxtpu import (
     JitStaticArgShapeRule,
     PallasBlockSpecRule,
@@ -59,6 +60,7 @@ def all_rules() -> list[Rule]:
         SqliteScopeRule(),
         AdHocRetryRule(),
         WallClockLeaseRule(),
+        HotPathMaterializeRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

@@ -27,6 +27,7 @@ so a ``pjit`` step consumes them without resharding.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Iterator
@@ -35,6 +36,7 @@ import numpy as np
 import pyarrow as pa
 
 from lakesoul_tpu.obs import registry
+from lakesoul_tpu.obs.stages import stage_histogram
 from lakesoul_tpu.runtime import pipeline as rt_pipeline
 
 
@@ -199,7 +201,7 @@ def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
     for name in table.column_names:
         col = table.column(name)
         if pa.types.is_fixed_size_list(col.type):
-            arr = col.combine_chunks()
+            arr = col.combine_chunks()  # lakelint: ignore[hot-path-materialize] fallback for windows the zero-copy view path declined (nulls/odd layouts); the fused path never reaches here
             width = col.type.list_size
             flat = arr.flatten().to_numpy(zero_copy_only=False)
             if flat.dtype != object and len(flat) == len(arr) * width:
@@ -225,34 +227,161 @@ def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
     return out
 
 
-class _Rebatcher:
-    """Accumulate arrow batches and emit fixed-size row windows."""
+def _np_column_views(batch: pa.RecordBatch) -> dict[str, np.ndarray] | None:
+    """Zero-copy per-column numpy views of one record batch, or None when any
+    column cannot be viewed without conversion (nulls, strings/objects,
+    bit-packed bools, variable nesting) — the window then falls back to the
+    arrow-table collate path, which handles those exactly as before."""
+    views: dict[str, np.ndarray] = {}
+    for i, name in enumerate(batch.schema.names):
+        col = batch.column(i)
+        t = col.type
+        try:
+            if pa.types.is_fixed_size_list(t):
+                if col.null_count:
+                    return None
+                flat = col.flatten().to_numpy(zero_copy_only=True)
+                views[name] = flat.reshape(len(col), t.list_size)
+            else:
+                if col.null_count:
+                    return None
+                views[name] = col.to_numpy(zero_copy_only=True)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError, ValueError):
+            return None
+    return views
 
-    def __init__(self, batch_size: int):
-        self.batch_size = batch_size
-        self._pending: list[pa.Table] = []
-        self._rows = 0
 
-    def push(self, batch: pa.RecordBatch | pa.Table) -> Iterator[pa.Table]:
-        t = pa.table(batch) if isinstance(batch, pa.RecordBatch) else batch
-        self._pending.append(t)
-        self._rows += len(t)
-        while self._rows >= self.batch_size:
-            yield self._pop(self.batch_size)
+class _Window:
+    """One fixed-size row window over the pending batches, materialization
+    deferred: the window holds zero-copy (batch, views, start, length) parts
+    and either collates STRAIGHT from the numpy views into one output buffer
+    per column (fast path — no intermediate table ever exists) or assembles
+    a table from batch slices for the fallback/custom-collate path."""
 
-    def _pop(self, n: int) -> pa.Table:
-        big = pa.concat_tables(self._pending)
-        out = big.slice(0, n)
-        rest = big.slice(n)
-        self._pending = [rest] if len(rest) else []
-        self._rows = len(rest)
+    __slots__ = ("parts", "nrows", "fast")
+
+    def __init__(self, parts, nrows: int):
+        self.parts = parts  # [(record_batch, views_or_None, start, length)]
+        self.nrows = nrows
+        self.fast = all(v is not None for _, v, _, _ in parts)
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    def to_table(self) -> pa.Table:
+        # zero-copy: slices share the source batch buffers; the table's
+        # chunked columns are exactly what the old concat-based rebatcher
+        # handed to collate
+        return pa.Table.from_batches(
+            [b.slice(s, ln) for b, _, s, ln in self.parts]
+        )
+
+    def collate(self, buffers: "dict[str, np.ndarray] | None") -> dict[str, np.ndarray]:
+        """Fused rebatch+collate: one ``out[pos:pos+len] = view[s:s+len]``
+        memcpy per (column, part) into per-column output buffers —
+        ``buffers`` (a reuse-ring slot) or freshly allocated once.  A window
+        that is a single slice of one batch (the common case: the scan
+        already emits ``batch_size``-row batches, so windows align) doesn't
+        even copy — the numpy views pass straight through, sliced."""
+        if buffers is None and len(self.parts) == 1:
+            b, views, s, ln = self.parts[0]
+            if s == 0 and ln == len(b):
+                return dict(views)
+            return {name: v[s : s + ln] for name, v in views.items()}
+        first_views = self.parts[0][1]
+        out: dict[str, np.ndarray] = {}
+        for name, proto in first_views.items():
+            shape = (self.nrows,) + proto.shape[1:]
+            buf = None if buffers is None else buffers.get(name)
+            if buf is None or buf.shape != shape or buf.dtype != proto.dtype:
+                buf = np.empty(shape, dtype=proto.dtype)
+                if buffers is not None:
+                    buffers[name] = buf
+            pos = 0
+            for _, views, s, ln in self.parts:
+                v = views[name]
+                if v.dtype != proto.dtype:
+                    # batches disagree on dtype (schema drift): numpy would
+                    # cast silently — take the exact table path instead
+                    return _default_collate(self.to_table())
+                buf[pos : pos + ln] = v[s : s + ln]
+                pos += ln
+            out[name] = buf
         return out
 
-    def tail(self) -> pa.Table | None:
+
+class _BufferRing:
+    """Round-robin pool of collate output buffer sets (opt-in via
+    ``LAKESOUL_COLLATE_REUSE=1``): with ``size`` ≥ the number of windows that
+    can be live at once (prefetch queue + device-put pipeline + in-flight),
+    steady-state collate allocates NOTHING — each window overwrites the
+    buffers of a window the consumer has already retired.  Only safe when
+    the consumer copies batches out (e.g. ``device_put`` to a non-host
+    backend) before ``size`` further batches are drawn; the default path
+    allocates fresh buffers per window."""
+
+    def __init__(self, size: int):
+        self._slots: list[dict[str, np.ndarray]] = [{} for _ in range(max(1, size))]
+        self._next = 0
+
+    def next_slot(self) -> dict[str, np.ndarray]:
+        slot = self._slots[self._next]
+        self._next = (self._next + 1) % len(self._slots)
+        return slot
+
+
+class _Rebatcher:
+    """Accumulate arrow batches and emit fixed-size row windows — chunk-aware:
+    pending batches are never concatenated (the old ``pa.concat_tables`` per
+    pop rebuilt a table of everything buffered, per window); a window is a
+    list of zero-copy slice descriptors resolved at collate time."""
+
+    def __init__(self, batch_size: int, *, capture_views: bool = True):
+        self.batch_size = batch_size
+        # a custom collate_fn consumes tables, never views — skip the
+        # per-batch view capture entirely on that path
+        self._capture_views = capture_views
+        self._pending: list[tuple[pa.RecordBatch, dict | None]] = []
+        self._offset = 0  # consumed rows of the FIRST pending batch
+        self._rows = 0
+
+    def push(self, batch: pa.RecordBatch | pa.Table) -> "list[_Window]":
+        if isinstance(batch, pa.Table):
+            incoming = batch.to_batches()
+        else:
+            incoming = [batch]
+        for b in incoming:
+            if len(b) == 0:
+                continue
+            views = _np_column_views(b) if self._capture_views else None
+            self._pending.append((b, views))
+            self._rows += len(b)
+        out = []
+        while self._rows >= self.batch_size:
+            out.append(self._pop(self.batch_size))
+        return out
+
+    def _pop(self, n: int) -> _Window:
+        parts = []
+        need = n
+        while need:
+            b, views = self._pending[0]
+            avail = len(b) - self._offset
+            take = min(avail, need)
+            parts.append((b, views, self._offset, take))
+            need -= take
+            if take == avail:
+                self._pending.pop(0)
+                self._offset = 0
+            else:
+                self._offset += take
+        self._rows -= n
+        return _Window(parts, n)
+
+    def tail(self) -> _Window | None:
         if self._rows == 0:
             return None
-        out = pa.concat_tables(self._pending)
-        self._pending, self._rows = [], 0
+        out = self._pop(self._rows)
         return out
 
 
@@ -314,6 +443,25 @@ class JaxBatchIterator:
         self._stats = LoaderStats()
         self._scan = scan
         self._collate = collate_fn or _default_collate
+        # opt-in collate-buffer reuse ring (see _BufferRing contract); sized
+        # to cover every window that can be live at once.  Never under
+        # cache='device': the resident epoch KEEPS every delivered batch, and
+        # on host-backed jax devices device_put may alias the host buffer —
+        # a wrapped ring would overwrite cached epochs in place.
+        self._ring: _BufferRing | None = None
+        if (
+            collate_fn is None
+            and cache != "device"
+            and os.environ.get("LAKESOUL_COLLATE_REUSE") == "1"
+        ):
+            self._ring = _BufferRing(
+                max(1, prefetch) + max(1, device_prefetch) + 2
+            )
+        # stage-attribution handles, fetched once (the obs hot-path contract)
+        self._h_rebatch = stage_histogram("rebatch")
+        self._h_collate = stage_histogram("collate")
+        self._h_queue = stage_histogram("queue")
+        self._h_device_put = stage_histogram("device_put")
         self._transform = transform
         self._device_put = device_put
         self._sharding = sharding
@@ -346,18 +494,25 @@ class JaxBatchIterator:
         return self._stats.snapshot()
 
     # ------------------------------------------------------------- pipeline
-    def _epoch_windows(self) -> Iterator[pa.Table]:
+    def _epoch_windows(self) -> "Iterator[_Window]":
         """Fixed-size row windows over one epoch's scan (the pipeline
         source).  Resume: the scan's unit order is deterministic, so the
         checkpoint's delivered-row count is a complete position; the scan
         skips whole units via metadata row counts without decoding them and
         decode-discards only the residual prefix of one unit."""
         skip = self._checkpoint.rows_delivered if self._checkpoint else 0
-        rb = _Rebatcher(self._scan._batch_size)
+        rb = _Rebatcher(
+            self._scan._batch_size,
+            capture_views=self._collate is _default_collate,
+        )
+        h = self._h_rebatch
         for arrow_batch in self._scan.to_batches(
             num_threads=self._io_threads, skip_rows=skip
         ):
-            yield from rb.push(arrow_batch)
+            t0 = time.perf_counter()
+            windows = rb.push(arrow_batch)
+            h.observe(time.perf_counter() - t0)
+            yield from windows
         if not self._drop_remainder:
             tail = rb.tail()
             if tail is not None:
@@ -374,10 +529,21 @@ class JaxBatchIterator:
             .run()
         )
 
-    def _host_batch(self, window: pa.Table):
-        batch = self._collate(window)
+    def _host_batch(self, window):
+        t0 = time.perf_counter()
+        if isinstance(window, _Window):
+            if window.fast and self._collate is _default_collate:
+                # fused zero-copy path: views → output buffers, no
+                # intermediate table, no per-column combine_chunks
+                slot = self._ring.next_slot() if self._ring is not None else None
+                batch = window.collate(slot)
+            else:
+                batch = self._collate(window.to_table())
+        else:
+            batch = self._collate(window)
         if self._transform is not None:
             batch = self._transform(batch)
+        self._h_collate.observe(time.perf_counter() - t0)
         return batch
 
     def _fresh_containers(self, batch):
@@ -419,6 +585,7 @@ class JaxBatchIterator:
                     stall = time.perf_counter() - waited
                     # telemetry at the host hand-off: this is the loader's
                     # produced throughput and how long the consumer starved
+                    self._h_queue.observe(stall)
                     self._stats.delivered(item[0], stall, pipe.queue_depth())
                     yield item
             finally:
@@ -446,11 +613,20 @@ class JaxBatchIterator:
 
             import jax
 
-            put = (
+            raw_put = (
                 (lambda b: jax.device_put(b, self._sharding))
                 if self._sharding is not None
                 else jax.device_put
             )
+            h_put = self._h_device_put
+
+            def put(b):
+                # dispatch cost only: the H2D copy itself overlaps the
+                # training step (that's the double buffering's point)
+                t0 = time.perf_counter()
+                r = raw_put(b)
+                h_put.observe(time.perf_counter() - t0)
+                return r
             # double buffering: keep device_prefetch transfers in flight so the
             # H2D copy of batch k+1 overlaps the step on batch k
             fill: list | None = [] if self._cache_mode == "device" else None

@@ -24,11 +24,14 @@ CanCastSchemaBuilder, stream/default_column.rs).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
 from lakesoul_tpu.errors import IOError_
+from lakesoul_tpu.obs.stages import stage_histogram
 
 MERGE_OPERATORS = {
     "UseLast",
@@ -46,7 +49,14 @@ CDC_DELETE = "delete"
 
 def uniform_table(table: pa.Table, target_schema: pa.Schema, defaults: dict | None = None) -> pa.Table:
     """Schema evolution: reorder/cast columns to the target schema, filling
-    missing columns with defaults (or nulls)."""
+    missing columns with defaults (or nulls).
+
+    Identity fast path: a table already carrying the target schema (the
+    steady state — schema evolution is the exception, not the rule) is
+    returned UNTOUCHED, so the fill stage degenerates to one schema compare
+    per batch on compacted/unevolved scans."""
+    if table.schema.equals(target_schema):
+        return table
     defaults = defaults or {}
     n = len(table)
     cols = []
@@ -120,22 +130,27 @@ def merge_sorted_tables(
     wins for UseLast semantics.  Input tables need not be pre-sorted — the
     merge does one stable multi-key sort (ties preserve input order, which
     encodes file version order)."""
-    import time
-
     from lakesoul_tpu.obs import registry
 
     started = time.perf_counter()
+    acc = {"fill": 0.0}
     out = _merge_sorted_tables(
         tables,
         primary_keys,
         merge_operators=merge_operators,
         target_schema=target_schema,
         defaults=defaults,
+        _stage_acc=acc,
     )
-    registry().histogram("lakesoul_io_merge_seconds").observe(
-        time.perf_counter() - started
-    )
+    total = time.perf_counter() - started
+    registry().histogram("lakesoul_io_merge_seconds").observe(total)
     registry().counter("lakesoul_io_merge_rows_total").inc(len(out))
+    # stage attribution: the schema-uniform (cast/null-fill) leg counts as
+    # "fill", everything else — sort/loser-tree/gather — as "merge", so the
+    # two stages stay additive in the scan breakdown
+    if acc["fill"]:
+        stage_histogram("fill").observe(acc["fill"])
+    stage_histogram("merge").observe(max(0.0, total - acc["fill"]))
     return out
 
 
@@ -146,6 +161,7 @@ def _merge_sorted_tables(
     merge_operators: dict[str, str] | None = None,
     target_schema: pa.Schema | None = None,
     defaults: dict | None = None,
+    _stage_acc: dict | None = None,
 ) -> pa.Table:
     merge_operators = merge_operators or {}
     for colname, op in merge_operators.items():
@@ -156,8 +172,15 @@ def _merge_sorted_tables(
 
     if target_schema is None:
         target_schema = tables[0].schema
+    fill0 = time.perf_counter()
     uniformed = [uniform_table(t, target_schema, defaults) for t in tables]
-    big = pa.concat_tables(uniformed).combine_chunks()
+    if _stage_acc is not None:
+        _stage_acc["fill"] = time.perf_counter() - fill0
+    # chunk-list concat only (zero-copy): the fast paths below gather
+    # straight from the concatenated runs' chunks, so the combine_chunks
+    # copy — once the single largest merge-apply cost per window — is
+    # deferred until the argsort fallback actually needs contiguity
+    big = pa.concat_tables(uniformed)
     n = len(big)
     if n == 0:
         return big
@@ -179,6 +202,7 @@ def _merge_sorted_tables(
         if fast is not None:
             return fast
 
+    big = big.combine_chunks()
     # sort by PK columns with an explicit row-order tiebreaker: pyarrow's sort
     # is not documented stable, and ties must keep concat order (= file
     # version order) for "last wins" semantics
@@ -212,20 +236,24 @@ def _merge_sorted_tables(
 
     out_columns = {}
     for colname, op in merge_operators.items():
-        column = big.column(colname).combine_chunks()
-        col_sorted = column.take(pa.array(sort_idx))
         if op == "UseLast":
             continue  # base already has it
+        column = big.column(colname).combine_chunks()
         if op == "UseLastNotNull":
-            valid = np.asarray(col_sorted.is_valid())
+            # gather+fill in ONE pass from the UNSORTED column: the winning
+            # source row per group is sort_idx[last_valid], no-winner groups
+            # get index -1 (→ null) — composing the indices replaces the
+            # full-column take + group-tail take + if_else null-fill trio
+            valid = np.asarray(column.is_valid())[sort_idx]
             last_valid = _segmented_last_valid(valid, group_id, n)[group_end_pos]
             has_value = last_valid >= 0
-            gather = np.where(has_value, last_valid, 0)
-            vals = col_sorted.take(pa.array(gather))
-            if not has_value.all():
-                vals = pc.if_else(pa.array(has_value), vals, pa.nulls(num_groups, column.type))
-            out_columns[colname] = vals
-        elif op in ("SumAll", "SumLast"):
+            src_idx = np.where(
+                has_value, sort_idx[np.where(has_value, last_valid, 0)], -1
+            )
+            out_columns[colname] = _gather_fill(column, src_idx)
+            continue
+        col_sorted = column.take(pa.array(sort_idx))
+        if op in ("SumAll", "SumLast"):
             npvals = np.asarray(col_sorted.fill_null(0))
             valid = np.asarray(col_sorted.is_valid())
             if op == "SumLast":
@@ -287,6 +315,173 @@ def _merge_sorted_tables(
     return base
 
 
+# byte width → same-width unsigned view for the native gather (bit patterns
+# only; the Arrow type on the rebuilt array restores the semantics)
+_WIDTH_DTYPE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _native_gather_array(arr: pa.Array, idx: np.ndarray) -> pa.Array | None:
+    """One column's gather+fill through the native kernels
+    (``ls_gather_fixed`` + ``ls_gather_valid_bits``): rows at ``idx``,
+    negative index → null.  Returns None when the layout isn't a
+    fixed-width primitive (caller falls back to pyarrow)."""
+    from lakesoul_tpu import native
+
+    if not native.available():
+        return None
+    t = arr.type
+    width = _fixed_width_of(t)
+    if width is None:
+        return None
+    dt = _WIDTH_DTYPE[width]
+    bufs = arr.buffers()
+    if len(bufs) != 2 or bufs[1] is None:
+        return None
+    src = np.frombuffer(bufs[1], dtype=dt, count=arr.offset + len(arr))[arr.offset:]
+    n = len(idx)
+    out = native.gather_fixed(src, idx)
+    has_fill = bool(n) and bool(idx.min() < 0)
+    if arr.null_count or has_fill:
+        if arr.null_count:
+            if bufs[0] is None:
+                return None
+            vsrc = np.frombuffer(bufs[0], dtype=np.uint8)
+            vbits, nulls = native.gather_valid_bits(vsrc, arr.offset, idx)
+        else:
+            vbits, nulls = native.gather_valid_bits(None, 0, idx)
+        return pa.Array.from_buffers(
+            t, n, [pa.py_buffer(vbits), pa.py_buffer(out)], null_count=nulls
+        )
+    return pa.Array.from_buffers(t, n, [None, pa.py_buffer(out)], null_count=0)
+
+
+def _single_chunk(col) -> pa.Array | None:
+    if isinstance(col, pa.Array):
+        return col
+    if col.num_chunks == 1:
+        return col.chunk(0)
+    if col.num_chunks == 0:
+        return None
+    combined = col.combine_chunks()
+    return combined if isinstance(combined, pa.Array) else combined.chunk(0)
+
+
+def _gather_fill(col, idx: np.ndarray):
+    """Gather rows at ``idx`` with negative → null: native single pass where
+    the layout allows, else the pyarrow take + if_else null-fill pair."""
+    arr = _single_chunk(col)
+    if arr is not None:
+        out = _native_gather_array(arr, idx)
+        if out is not None:
+            return out
+    has_fill = bool(len(idx)) and bool(idx.min() < 0)
+    if not has_fill:
+        return col.take(pa.array(idx))
+    vals = col.take(pa.array(np.where(idx < 0, 0, idx)))
+    return pc.if_else(pa.array(idx >= 0), vals, pa.nulls(len(idx), col.type))
+
+
+def _fixed_width_of(t: pa.DataType) -> int | None:
+    """Byte width for the native gather, or None for ineligible layouts."""
+    if pa.types.is_dictionary(t):
+        return None
+    try:
+        bit_width = t.bit_width
+    except ValueError:
+        return None  # var-width (string/binary) or nested
+    if bit_width % 8 or pa.types.is_boolean(t) or pa.types.is_nested(t):
+        return None
+    width = bit_width // 8
+    return width if width in _WIDTH_DTYPE else None
+
+
+def take_indices(table: pa.Table, indices: np.ndarray) -> pa.Table:
+    """Merge-apply gather+fill over a whole table (the native entry point
+    the loser-tree fast paths feed): rows at ``indices``, negative index →
+    null cells.  All null-free fixed-width columns — CHUNKED included, so
+    the caller never pays a combine_chunks copy — gather in ONE
+    ``ls_gather_multi_chunked`` call; columns with nulls go through the
+    per-column gather+fill; anything else falls back to pyarrow ``take``.
+    Byte-equivalent to ``table.take(pa.array(indices))`` for non-negative
+    indices (asserted in tests/test_native.py)."""
+    from lakesoul_tpu import native
+
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    n_out = len(indices)
+    if len(table) == 0 or n_out == 0:
+        return table.slice(0, 0)
+
+    arrays: list = [None] * table.num_columns
+    # (col_idx, width, [(chunk_len, data_buffer, chunk_offset)])
+    multi: list[tuple[int, int, list[tuple[int, object, int]]]] = []
+    # fill rows present: the multi-chunk resolution below maps a -1 through
+    # searchsorted into a bogus (chunk, local) pair, so every column must go
+    # through the per-column gather+fill path, which honors negative → null
+    use_native = native.available() and not bool(indices.min() < 0)
+    for i, fld in enumerate(table.schema):
+        col = table.column(i)
+        chunks = col.chunks if isinstance(col, pa.ChunkedArray) else [col]
+        width = _fixed_width_of(fld.type) if use_native else None
+        if width is not None and col.null_count == 0:
+            metas = []
+            for c in chunks:
+                if len(c) == 0:
+                    continue
+                bufs = c.buffers()
+                if len(bufs) != 2 or bufs[1] is None:
+                    metas = None
+                    break
+                metas.append((len(c), bufs[1], c.offset))
+            if metas is not None:
+                multi.append((i, width, metas))
+                continue
+        arrays[i] = _gather_fill(col, indices)
+
+    if multi:
+        # columns almost always share one chunk layout (the runs); resolve
+        # each group's global row ids to (chunk, local) ONCE with a
+        # vectorized searchsorted, then gather every column in one C call
+        groups: dict[tuple, list[tuple[int, int, list]]] = {}
+        for entry in multi:
+            sig = tuple(m[0] for m in entry[2])
+            groups.setdefault(sig, []).append(entry)
+        outs = []
+        for sig, cols in groups.items():
+            if len(sig) == 1:
+                chunk_of = np.zeros(n_out, dtype=np.int32)
+                local = indices
+            else:
+                bounds = np.cumsum(np.array(sig, dtype=np.int64))
+                chunk_of = np.searchsorted(
+                    bounds, indices, side="right"
+                ).astype(np.int32)
+                starts = np.concatenate([[0], bounds[:-1]])
+                local = indices - starts[chunk_of]
+            addrs: list[int] = []
+            counts = np.empty(len(cols), dtype=np.int32)
+            widths = np.empty(len(cols), dtype=np.int64)
+            out_addrs = np.empty(len(cols), dtype=np.uint64)
+            for j, (i, width, metas) in enumerate(cols):
+                for _len, buf, off in metas:
+                    addrs.append(buf.address + off * width)
+                counts[j] = len(metas)
+                widths[j] = width
+                out = np.empty(n_out, dtype=_WIDTH_DTYPE[width])
+                outs.append((i, width, out))
+                out_addrs[j] = out.ctypes.data
+            native.gather_multi_chunked(
+                np.array(addrs, dtype=np.uint64),
+                counts, widths, chunk_of,
+                np.ascontiguousarray(local, dtype=np.int64), out_addrs,
+            )
+        for i, _width, out in outs:
+            arrays[i] = pa.Array.from_buffers(
+                table.schema.field(i).type, n_out,
+                [None, pa.py_buffer(out)], null_count=0,
+            )
+    return pa.table(arrays, schema=table.schema)
+
+
 def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
     """C++ loser-tree merge (native/src/lakesoul_native.cc ls_merge_i64 /
     ls_merge_bytes) when the key column is a null-free int64 or
@@ -308,11 +503,17 @@ def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
         # INT64_MAX is the C++ merge's run-exhausted sentinel
         if len(keys) and keys.max() == np.iinfo(np.int64).max:
             return None
+        # already-merged degeneracy: globally strictly-increasing keys mean
+        # every key is unique and already in merge order (the compacted /
+        # single-sorted-run steady state) — the answer IS the input, no
+        # loser tree, no gather
+        if len(keys) < 2 or np.all(keys[1:] > keys[:-1]):
+            return big
         for a, b in zip(run_offsets[:-1], run_offsets[1:]):
             if b - a > 1 and not np.all(keys[a + 1 : b] >= keys[a : b - 1]):
                 return None  # run not sorted; vectorized path handles it
         order, tail, _groups = native.merge_sorted_runs_i64(keys, run_offsets)
-        return big.take(pa.array(order[tail]))
+        return take_indices(big, order[tail])
 
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t) or pa.types.is_large_binary(t):
         chunk = col.combine_chunks()
@@ -320,6 +521,12 @@ def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
             if chunk.num_chunks != 1:
                 return None
             chunk = chunk.chunk(0)
+        n = len(chunk)
+        if n < 2:
+            return big  # 0/1 rows: trivially merged
+        inc = pc.min(pc.greater(chunk.slice(1), chunk.slice(0, n - 1))).as_py()
+        if inc:  # strictly increasing: unique + merge-ordered already
+            return big
         for a, b in zip(run_offsets[:-1], run_offsets[1:]):
             if b - a > 1:
                 lo = chunk.slice(a, b - a - 1)
@@ -331,7 +538,7 @@ def _native_merge_fast_path(big: pa.Table, uniformed: list[pa.Table], pk: str):
         if data is None:
             return None
         order, tail, _groups = native.merge_sorted_runs_bytes(data, offsets, run_offsets)
-        return big.take(pa.array(order[tail]))
+        return take_indices(big, order[tail])
 
     return None
 
@@ -366,12 +573,14 @@ def _native_merge_composite_fast_path(
 
     lengths = np.array([len(t) for t in uniformed], dtype=np.int64)
     run_offsets = np.concatenate([[0], np.cumsum(lengths)])
+    if _strictly_increasing_bytes(encoded):
+        return big  # unique + merge-ordered already (compacted steady state)
     if not _runs_sorted_bytes(encoded, run_offsets):
         return None
     data = np.ascontiguousarray(encoded).reshape(-1)
     offsets = (np.arange(n + 1, dtype=np.int64) * width)
     order, tail, _groups = native.merge_sorted_runs_bytes(data, offsets, run_offsets)
-    return big.take(pa.array(order[tail]))
+    return take_indices(big, order[tail])
 
 
 def _memcomparable_fixed(col: pa.ChunkedArray) -> np.ndarray | None:
@@ -415,6 +624,22 @@ def _memcomparable_fixed(col: pa.ChunkedArray) -> np.ndarray | None:
         u = vals.astype(np.uint64) ^ (np.uint64(1) << np.uint64(63))
         return u[:, None].view(np.uint8).reshape(len(u), 8)[:, ::-1]
     return None
+
+
+def _strictly_increasing_bytes(encoded: np.ndarray) -> bool:
+    """Consecutive encoded rows strictly increasing bytewise (vectorized):
+    the whole concat is already unique and in merge order."""
+    if len(encoded) < 2:
+        return True
+    a = encoded[:-1]
+    b = encoded[1:]
+    neq = a != b
+    any_neq = neq.any(axis=1)
+    if not any_neq.all():
+        return False  # an equal neighbor pair: duplicate keys
+    first = np.argmax(neq, axis=1)
+    rows = np.arange(len(a))
+    return bool(np.all(b[rows, first] > a[rows, first]))
 
 
 def _runs_sorted_bytes(encoded: np.ndarray, run_offsets: np.ndarray) -> bool:

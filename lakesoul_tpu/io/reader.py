@@ -33,7 +33,23 @@ from lakesoul_tpu.io.filters import Filter, filter_column_names, zone_conjuncts
 from lakesoul_tpu.io.formats import format_for
 from lakesoul_tpu.io.merge import apply_cdc_filter, merge_sorted_tables, uniform_table
 from lakesoul_tpu.obs import registry
+from lakesoul_tpu.obs.stages import stage_histogram
 from lakesoul_tpu.runtime import pipeline as rt_pipeline
+
+
+def timed_decode_iter(it: Iterator) -> Iterator:
+    """Wrap a format reader's batch iterator so every pull is attributed to
+    the ``decode`` scan stage (runs on whatever thread actually decodes —
+    the prefetch pump when the iterator sits behind one)."""
+    h = stage_histogram("decode")
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        h.observe(time.perf_counter() - t0)
+        yield item
 
 
 def _unit_observe(mode: str, rows: int, started: float) -> None:
@@ -151,6 +167,7 @@ def _postprocess(
     # post-merge filter may reference partition columns that the final
     # projection drops)
     if partition_values and schema is not None:
+        fill0 = time.perf_counter()
         n = len(merged)
         arrays, names = [], []
         for fld in schema:
@@ -164,6 +181,7 @@ def _postprocess(
                 arrays.append(arr)
                 names.append(fld.name)
         merged = pa.table(dict(zip(names, arrays)))
+        stage_histogram("fill").observe(time.perf_counter() - fill0)
 
     if cdc_column and drop_cdc_deletes:
         merged = apply_cdc_filter(merged, cdc_column)
@@ -209,6 +227,7 @@ def read_scan_unit(
     )
 
     def _fetch_decode(path: str) -> pa.Table:
+        t0 = time.perf_counter()
         t = _read_one_file(
             path,
             columns=plan.read_columns,
@@ -216,8 +235,11 @@ def read_scan_unit(
             storage_options=storage_options,
             zone_predicates=plan.zone_predicates,
         )
+        stage_histogram("decode").observe(time.perf_counter() - t0)
         if plan.file_schema is not None:
+            t0 = time.perf_counter()
             t = uniform_table(t, plan.file_schema, defaults)
+            stage_histogram("fill").observe(time.perf_counter() - t0)
         return t
 
     if len(files) > 1:
@@ -243,7 +265,7 @@ def read_scan_unit(
             defaults=defaults,
         )
     else:
-        merged = pa.concat_tables(tables) if tables else pa.table({})
+        merged = pa.concat_tables(tables) if tables else pa.table({})  # lakelint: ignore[hot-path-materialize] chunk-list concat, zero-copy: no buffer is copied, downstream slices share the decoded chunks
 
     out = _postprocess(
         merged,
@@ -267,7 +289,11 @@ def read_scan_unit(
 
 
 def _stream_batch_rows(
-    file_schema: pa.Schema | None, n_files: int, memory_budget_bytes: int
+    file_schema: pa.Schema | None,
+    n_files: int,
+    memory_budget_bytes: int,
+    *,
+    fast_merge: bool = True,
 ) -> int:
     """Per-stream load size so that n_files buffered stream batches plus one
     merge window stay within the budget."""
@@ -286,9 +312,49 @@ def _stream_batch_rows(
                 width += 32  # var-width (string/binary) estimate
         width = max(width, 8)
     # budget splits across: per-stream buffers (n_files), the concat window
-    # (~n_files worth) and the merge's sort scratch (~2x window)
-    rows = memory_budget_bytes // max(1, 4 * n_files * width)
+    # (~n_files worth, zero-copy chunk refs into the buffers) and the merge
+    # scratch.  On the native fast path the scratch is one gather output
+    # (the run chunks are gathered directly — no combine_chunks, no
+    # argsort), so a window costs ~1x itself; the argsort fallback still
+    # pays combine + sort indices (~2x), so it keeps the old divisor.
+    divisor = 3 if fast_merge else 4
+    rows = memory_budget_bytes // max(1, divisor * n_files * width)
     return max(MIN_STREAM_BATCH_ROWS, min(DEFAULT_STREAM_BATCH_ROWS, int(rows)))
+
+
+def _pk_native_capable(
+    file_schema: pa.Schema | None, primary_keys: list[str]
+) -> bool:
+    """Whether the native loser-tree fast path can take these PKs (the
+    window-budget sizing must assume the argsort fallback otherwise).
+    Mirrors the runtime eligibility in io/merge.py conservatively: single
+    int64/string keys merge directly, fixed-width ints/bools/dates/
+    timestamps/times go through the memcomparable encoding; floats (NaN
+    declines at runtime), decimals and var-width composites do not."""
+    if file_schema is None:
+        return False
+    for k in primary_keys:
+        idx = file_schema.get_field_index(k)
+        if idx < 0:
+            return False
+        t = file_schema.field(idx).type
+        if len(primary_keys) == 1 and (
+            pa.types.is_string(t)
+            or pa.types.is_large_string(t)
+            or pa.types.is_binary(t)
+            or pa.types.is_large_binary(t)
+        ):
+            continue
+        if (
+            pa.types.is_boolean(t)
+            or pa.types.is_integer(t)
+            or pa.types.is_date(t)
+            or pa.types.is_timestamp(t)
+            or pa.types.is_time(t)
+        ):
+            continue
+        return False
+    return True
 
 
 # decoded-size multiplier over on-disk bytes when deciding whether a unit
@@ -373,14 +439,26 @@ def iter_scan_unit_batches(
         def raw_batches():
             for path in files:
                 fmt = format_for(path)
-                yield from fmt.iter_batches(
+                yield from timed_decode_iter(iter(fmt.iter_batches(
                     path,
                     columns=plan.read_columns,
                     arrow_filter=plan.file_filter,
                     batch_size=rows,
                     storage_options=storage_options,
                     zone_predicates=plan.zone_predicates,
-                )
+                )))
+
+        # degeneracy: with no partition fill, no CDC filter, no residual
+        # filter and no projection, postprocess is the identity — a batch
+        # whose schema already matches the plan's then flows straight from
+        # the decoder to the consumer (a pyarrow.dataset-grade plan; the
+        # merge/fill stages never run and report ~0 in the breakdown)
+        post_identity = (
+            not partition_values
+            and not (cdc_column and drop_cdc_deletes)
+            and plan.post_filter is None
+            and columns is None
+        )
 
         # one-batch decode-ahead: batch k+1 fetches/decodes while k
         # postprocesses and emits (memory bound: ONE extra batch)
@@ -389,9 +467,25 @@ def iter_scan_unit_batches(
         ).run()
         try:
             for batch in it:
+                if post_identity and (
+                    plan.file_schema is None
+                    or batch.schema.equals(plan.file_schema)
+                ):
+                    n = len(batch)
+                    if n == 0:
+                        continue
+                    out_rows += n
+                    if n <= batch_size:
+                        yield batch
+                    else:  # same row partitioning to_batches(max_chunksize) produced
+                        for lo in range(0, n, batch_size):
+                            yield batch.slice(lo, min(batch_size, n - lo))
+                    continue
                 t = pa.Table.from_batches([batch])
                 if plan.file_schema is not None:
+                    fill0 = time.perf_counter()
                     t = uniform_table(t, plan.file_schema, defaults)
+                    stage_histogram("fill").observe(time.perf_counter() - fill0)
                 t = post(t)
                 if len(t):
                     out_rows += len(t)
@@ -401,9 +495,22 @@ def iter_scan_unit_batches(
         _unit_observe("stream", out_rows, started)
         return
 
+    from lakesoul_tpu import native
     from lakesoul_tpu.io.streaming_merge import iter_merged_windows
 
-    rows = _stream_batch_rows(plan.file_schema, len(files), memory_budget_bytes)
+    # the 3x window budget assumes the native gather fast path; merge
+    # operators force the argsort path, a missing native library forces the
+    # pyarrow one, and PK shapes the loser tree declines (floats/decimals/
+    # var-width composites) fall back at runtime — all of those need the
+    # old conservative 4x headroom
+    rows = _stream_batch_rows(
+        plan.file_schema, len(files), memory_budget_bytes,
+        fast_merge=(
+            not merge_operators
+            and native.available()
+            and _pk_native_capable(plan.file_schema, primary_keys)
+        ),
+    )
     started = time.perf_counter()
     out_rows = windows = 0
     for window in iter_merged_windows(

@@ -26,12 +26,15 @@ read/compaction time, bounded, instead of an ad-hoc spill file format.
 
 from __future__ import annotations
 
+import time
 from typing import Iterator
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
 from lakesoul_tpu.io.merge import merge_sorted_tables, uniform_table
+from lakesoul_tpu.obs.stages import stage_histogram
 from lakesoul_tpu.runtime import pipeline as rt_pipeline
 
 # rows per load step per stream; the byte budget divides down from this
@@ -61,10 +64,26 @@ def _key_tuple(table: pa.Table, primary_keys: list[str], row: int) -> tuple:
 
 def _prefix_below(table: pa.Table, primary_keys: list[str], watermark: tuple) -> int:
     """Length of the sorted table's prefix whose PK tuple is strictly below
-    the watermark (vectorized lexicographic compare)."""
+    the watermark (vectorized lexicographic compare; single numeric PKs use
+    binary search instead — sortedness of each stream's buffer is already a
+    precondition of the whole watermark scheme, so O(log n) replaces the
+    O(n) compare per stream per window)."""
     n = len(table)
     if n == 0:
         return 0
+    if len(primary_keys) == 1:
+        w_null, w_val = watermark[0]
+        if not w_null:
+            col = table.column(primary_keys[0])
+            t = col.type
+            if col.null_count == 0 and (
+                pa.types.is_integer(t) or pa.types.is_floating(t)
+            ):
+                total = 0
+                for chunk in col.chunks:
+                    keys = np.asarray(chunk)  # zero-copy primitive view
+                    total += int(np.searchsorted(keys, w_val, side="left"))
+                return total
     lt = eq = None
     for k, (w_null, w_val) in zip(primary_keys, watermark):
         col = table.column(k)
@@ -100,18 +119,19 @@ class _SortedFileStream:
         zone_predicates=None,
     ):
         from lakesoul_tpu.io.formats import format_for
+        from lakesoul_tpu.io.reader import timed_decode_iter
 
         self._file_schema = file_schema
         self._defaults = defaults
         self._batches = _prefetch_iter(
-            format_for(path).iter_batches(
+            timed_decode_iter(iter(format_for(path).iter_batches(
                 path,
                 columns=columns,
                 arrow_filter=arrow_filter,
                 batch_size=batch_rows,
                 storage_options=storage_options,
                 zone_predicates=zone_predicates,
-            )
+            )))
         )
         self.buffer: pa.Table = (
             file_schema.empty_table() if file_schema is not None else pa.table({})
@@ -130,13 +150,15 @@ class _SortedFileStream:
             return False
         t = pa.table(pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch)
         if self._file_schema is not None:
+            fill0 = time.perf_counter()
             t = uniform_table(t, self._file_schema, self._defaults)
+            stage_histogram("fill").observe(time.perf_counter() - fill0)
         elif not self._primed:
             # no declared schema: adopt the first batch's schema
             self._file_schema = t.schema
             self.buffer = t.schema.empty_table()
             self._primed = True
-        self.buffer = pa.concat_tables([self.buffer, t]) if len(self.buffer) else t
+        self.buffer = pa.concat_tables([self.buffer, t]) if len(self.buffer) else t  # lakelint: ignore[hot-path-materialize] chunk-list append, zero-copy: the buffer shares the decoded batches' buffers
         return True
 
     def last_key(self, primary_keys: list[str]) -> tuple:
@@ -148,7 +170,7 @@ class _SortedFileStream:
         emit = self.buffer.slice(0, cut)
         # copy the (small) remainder: a zero-copy suffix slice would pin its
         # whole parent batches — decoded row groups — in memory
-        self.buffer = self.buffer.slice(cut).combine_chunks()
+        self.buffer = self.buffer.slice(cut).combine_chunks()  # lakelint: ignore[hot-path-materialize] bounded remainder copy: a zero-copy suffix slice would pin whole decoded row groups in memory
         return emit
 
     def take_all(self) -> pa.Table:

@@ -54,6 +54,13 @@ def _bind(lib) -> None:
     lib.ls_merge_bytes.argtypes = [u8p, i64p, i64p, ctypes.c_int32, i64p, u8p]
     lib.ls_merge_bytes.restype = ctypes.c_int64
     lib.ls_pack_bits.argtypes = [u8p, u8p, ctypes.c_int64, ctypes.c_int64]
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.ls_gather_fixed.argtypes = [u8p, ctypes.c_int64, i64p, ctypes.c_int64, u8p]
+    lib.ls_gather_valid_bits.argtypes = [u8p, ctypes.c_int64, i64p, ctypes.c_int64, u8p]
+    lib.ls_gather_valid_bits.restype = ctypes.c_int64
+    lib.ls_gather_multi_chunked.argtypes = [
+        u64p, i32p, i64p, ctypes.c_int32, i32p, i64p, ctypes.c_int64, u64p,
+    ]
     lib.ls_bitpack64.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, u8p]
     lib.ls_bitunpack64.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i64p]
 
@@ -181,6 +188,77 @@ def merge_sorted_runs_bytes(data: np.ndarray, offsets: np.ndarray, run_offsets: 
         _ptr(tail, ctypes.c_uint8),
     )
     return order, tail.astype(bool), int(groups)
+
+
+def gather_fixed(src: np.ndarray, idx: np.ndarray, out: np.ndarray | None = None) -> np.ndarray:
+    """Gather ``src[idx]`` for fixed-width values (the MOR merge-apply /
+    null-fill hot path).  A negative index writes zero bytes — the caller
+    marks those rows null via :func:`gather_valid_bits`.  ``out`` may be a
+    reusable buffer of the right length/dtype."""
+    lib = get_lib()
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = len(idx)
+    if out is None:
+        out = np.empty(n, dtype=src.dtype)
+    lib.ls_gather_fixed(
+        src.view(np.uint8).ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        src.dtype.itemsize,
+        _ptr(idx, ctypes.c_int64),
+        n,
+        out.view(np.uint8).ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def gather_multi_chunked(
+    chunk_addrs: np.ndarray,
+    chunk_counts: np.ndarray,
+    widths: np.ndarray,
+    chunk_of: np.ndarray,
+    local_idx: np.ndarray,
+    out_addrs: np.ndarray,
+) -> None:
+    """Whole-table gather in ONE native call over possibly-chunked,
+    null-free fixed-width columns (the merge-apply hot path gathers straight
+    from the concatenated runs — no combine_chunks copy, no per-column
+    ctypes round-trips).  ``chunk_of``/``local_idx`` are the pre-resolved
+    per-row (chunk, offset) pairs — one vectorized searchsorted in the
+    caller, shared by every column with the same chunking (see
+    io/merge.take_indices); the caller guarantees contiguity and dtypes."""
+    lib = get_lib()
+    lib.ls_gather_multi_chunked(
+        _ptr(chunk_addrs, ctypes.c_uint64),
+        _ptr(chunk_counts, ctypes.c_int32),
+        _ptr(widths, ctypes.c_int64),
+        len(widths),
+        _ptr(chunk_of, ctypes.c_int32),
+        _ptr(local_idx, ctypes.c_int64),
+        len(local_idx),
+        _ptr(out_addrs, ctypes.c_uint64),
+    )
+
+
+def gather_valid_bits(
+    bits: np.ndarray | None, bit_offset: int, idx: np.ndarray
+) -> tuple[np.ndarray, int]:
+    """Gather an Arrow validity bitmap by row index → (packed LSB-first
+    bitmap of ``len(idx)`` bits, null count).  ``bits=None`` = all-valid
+    source; negative indices emit null (the fill half of gather+fill)."""
+    lib = get_lib()
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = len(idx)
+    out = np.empty((n + 7) // 8, dtype=np.uint8)
+    nulls = lib.ls_gather_valid_bits(
+        _ptr(np.ascontiguousarray(bits, np.uint8), ctypes.c_uint8)
+        if bits is not None
+        else None,
+        bit_offset,
+        _ptr(idx, ctypes.c_int64),
+        n,
+        _ptr(out, ctypes.c_uint8),
+    )
+    return out, int(nulls)
 
 
 def bitpack64(vals: np.ndarray, base: int, width: int) -> np.ndarray:

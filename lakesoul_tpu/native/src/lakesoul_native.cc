@@ -329,6 +329,131 @@ void ls_bitunpack64(const uint8_t* in, int64_t n, int64_t base, int32_t width,
   }
 }
 
+// ------------------------------------------------------- gather + fill
+// MOR merge-apply hot path: after the loser tree emits a take-order, every
+// value column is materialized by gathering rows at those indices.  Doing
+// the gather here (one tight loop per column, width-specialized) replaces
+// the Python-side Table.take + fill_null pair: a NEGATIVE index means "no
+// source row" and emits a null (validity bit 0, value bytes 0) — the fill
+// half of gather+fill, used by UseLastNotNull-style reductions and schema
+// null-fill.  `src` is the column's value buffer; out must hold n*width
+// bytes.
+void ls_gather_fixed(const uint8_t* src, int64_t width, const int64_t* idx,
+                     int64_t n, uint8_t* out) {
+  switch (width) {
+    case 1: {
+      const uint8_t* s = src;
+      for (int64_t i = 0; i < n; i++) out[i] = idx[i] < 0 ? 0 : s[idx[i]];
+      return;
+    }
+    case 2: {
+      const uint16_t* s = (const uint16_t*)src;
+      uint16_t* o = (uint16_t*)out;
+      for (int64_t i = 0; i < n; i++) o[i] = idx[i] < 0 ? 0 : s[idx[i]];
+      return;
+    }
+    case 4: {
+      const uint32_t* s = (const uint32_t*)src;
+      uint32_t* o = (uint32_t*)out;
+      for (int64_t i = 0; i < n; i++) o[i] = idx[i] < 0 ? 0 : s[idx[i]];
+      return;
+    }
+    case 8: {
+      const uint64_t* s = (const uint64_t*)src;
+      uint64_t* o = (uint64_t*)out;
+      for (int64_t i = 0; i < n; i++) o[i] = idx[i] < 0 ? 0 : s[idx[i]];
+      return;
+    }
+    default:
+      for (int64_t i = 0; i < n; i++) {
+        if (idx[i] < 0) {
+          std::memset(out + i * width, 0, (size_t)width);
+        } else {
+          std::memcpy(out + i * width, src + idx[i] * width, (size_t)width);
+        }
+      }
+  }
+}
+
+// Whole-table gather in ONE call: every column fixed-width and null-free,
+// possibly CHUNKED (the merge fast path gathers straight from the
+// concatenated runs without ever combining them into one buffer — the
+// per-window combine_chunks copy this replaces was the single largest
+// merge-apply cost).  The caller resolves global row ids to
+// (chunk_of[i], local_idx[i]) ONCE — one vectorized numpy searchsorted,
+// shared by every column with the same chunking — so the per-row work here
+// is a pure two-level gather.  Layout, flattened across columns:
+//   chunk_addrs[sum(chunk_counts)]   value-buffer addresses (uint64)
+//   chunk_counts[ncols], widths[ncols]
+//   out_addrs[ncols]                 output buffer addresses (n*width bytes)
+void ls_gather_multi_chunked(const uint64_t* chunk_addrs,
+                             const int32_t* chunk_counts,
+                             const int64_t* widths, int32_t ncols,
+                             const int32_t* chunk_of, const int64_t* local_idx,
+                             int64_t n, const uint64_t* out_addrs) {
+  int64_t addr_base = 0;
+  for (int32_t c = 0; c < ncols; c++) {
+    const int32_t k = chunk_counts[c];
+    const int64_t w = widths[c];
+    const uint64_t* addrs = chunk_addrs + addr_base;
+    uint8_t* out = (uint8_t*)(uintptr_t)out_addrs[c];
+    if (k == 1) {
+      ls_gather_fixed((const uint8_t*)(uintptr_t)addrs[0], w, local_idx, n, out);
+    } else {
+#define LS_GATHER_CHUNKED_T(T)                                          \
+      {                                                                 \
+        T* o = (T*)out;                                                 \
+        for (int64_t i = 0; i < n; i++) {                               \
+          o[i] = ((const T*)(uintptr_t)addrs[chunk_of[i]])[local_idx[i]]; \
+        }                                                               \
+      }
+      switch (w) {
+        case 1: LS_GATHER_CHUNKED_T(uint8_t); break;
+        case 2: LS_GATHER_CHUNKED_T(uint16_t); break;
+        case 4: LS_GATHER_CHUNKED_T(uint32_t); break;
+        case 8: LS_GATHER_CHUNKED_T(uint64_t); break;
+        default:
+          for (int64_t i = 0; i < n; i++) {
+            const uint8_t* src = (const uint8_t*)(uintptr_t)addrs[chunk_of[i]];
+            std::memcpy(out + i * w, src + local_idx[i] * w, (size_t)w);
+          }
+      }
+#undef LS_GATHER_CHUNKED_T
+    }
+    addr_base += k;
+  }
+}
+
+// Gather an Arrow validity bitmap (LSB-first, starting at `bit_offset`) by
+// row index into a fresh packed bitmap.  `bits == nullptr` means the source
+// has no nulls; negative indices emit 0 (null) — the fill half.  Returns
+// the output null count so the caller can build the Array header without a
+// second pass.
+int64_t ls_gather_valid_bits(const uint8_t* bits, int64_t bit_offset,
+                             const int64_t* idx, int64_t n,
+                             uint8_t* out_bits) {
+  const int64_t nbytes = (n + 7) / 8;
+  std::memset(out_bits, 0, (size_t)nbytes);
+  int64_t nulls = 0;
+  for (int64_t i = 0; i < n; i++) {
+    bool valid;
+    if (idx[i] < 0) {
+      valid = false;
+    } else if (bits == nullptr) {
+      valid = true;
+    } else {
+      const int64_t b = bit_offset + idx[i];
+      valid = (bits[b >> 3] >> (b & 7)) & 1;
+    }
+    if (valid) {
+      out_bits[i >> 3] |= (uint8_t)(1u << (i & 7));
+    } else {
+      nulls++;
+    }
+  }
+  return nulls;
+}
+
 // --------------------------------------------------------------- bit pack
 // bits [n, d] {0,1} bytes → packed [n, ceil(d/8)] MSB-first (np.packbits).
 void ls_pack_bits(const uint8_t* bits, uint8_t* out, int64_t n, int64_t d) {

@@ -18,6 +18,13 @@ and update it, never format strings per row.
 
 from lakesoul_tpu.obs.exporter import serve_prometheus
 from lakesoul_tpu.obs.logging import JsonLogFormatter, configure_logging
+from lakesoul_tpu.obs.stages import (
+    SCAN_STAGES,
+    stage_counts,
+    stage_histogram,
+    stage_observe,
+    stage_seconds,
+)
 from lakesoul_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -53,4 +60,9 @@ __all__ = [
     "JsonLogFormatter",
     "configure_logging",
     "serve_prometheus",
+    "SCAN_STAGES",
+    "stage_counts",
+    "stage_histogram",
+    "stage_observe",
+    "stage_seconds",
 ]

@@ -1,0 +1,58 @@
+"""Scan-path stage attribution.
+
+One histogram family — ``lakesoul_scan_stage_seconds{stage=...}`` — shared
+by every leg of the scan→train path, so the per-stage cost breakdown the
+hot-path work is judged against (arxiv 2604.21275's discipline: measure per
+stage, then delete what the measurement exposes) is a queryable series, not
+a guess:
+
+=============  ==============================================================
+``decode``     file bytes → Arrow batches (format readers)
+``merge``      MOR merge-apply: loser tree / argsort + row gather
+``fill``       schema-evolution uniform (cast/null-fill) + partition columns
+``rebatch``    fixed-size window assembly in the loader
+``collate``    Arrow window → numpy pytree (+ user transform)
+``queue``      consumer stall on the loader's prefetch queue
+``device_put`` host batch → device transfer dispatch
+=============  ==============================================================
+
+On a compacted no-PK table the contract is DEGENERACY: ``merge`` and
+``fill`` must report ~0 — the scan is a plain decode plan.  The
+``scan_stages`` micro-benchmark leg enforces that as a budget.
+
+Handles are memoized module-level (the registry is a process singleton);
+hot loops fetch a histogram once and pay only ``observe``.
+"""
+
+from __future__ import annotations
+
+from lakesoul_tpu.obs.metrics import Histogram, registry
+
+SCAN_STAGES = (
+    "decode", "merge", "fill", "rebatch", "collate", "queue", "device_put",
+)
+
+_handles: dict[str, Histogram] = {}
+
+
+def stage_histogram(stage: str) -> Histogram:
+    """The ``lakesoul_scan_stage_seconds`` histogram for one stage."""
+    h = _handles.get(stage)
+    if h is None:
+        h = registry().histogram("lakesoul_scan_stage_seconds", stage=stage)
+        _handles[stage] = h
+    return h
+
+
+def stage_observe(stage: str, seconds: float) -> None:
+    stage_histogram(stage).observe(seconds)
+
+
+def stage_seconds() -> dict[str, float]:
+    """Cumulative seconds per stage since process start (bench/test helper;
+    subtract two snapshots for a leg delta)."""
+    return {s: stage_histogram(s).value["sum"] for s in SCAN_STAGES}
+
+
+def stage_counts() -> dict[str, int]:
+    return {s: stage_histogram(s).value["count"] for s in SCAN_STAGES}

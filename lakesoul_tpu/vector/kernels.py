@@ -58,9 +58,15 @@ def _packed_scan_kernel(q_ref, codes_ref, norms_ref, factors_ref, out_ref, *, d:
     out_ref[0, :] = norms * norms + qsq - 2.0 * est_rq
 
 
-@functools.partial(jax.jit, static_argnames=("d", "tile"))
-def packed_scan_pallas(packed_codes, norms, factors, q_rot, *, d: int, tile: int = 512):
-    """Pallas packed-code scan over one cluster: returns estimated sq-dists [N]."""
+@functools.partial(jax.jit, static_argnames=("d", "tile", "interpret"))
+def packed_scan_pallas(
+    packed_codes, norms, factors, q_rot, *, d: int, tile: int = 512,
+    interpret: bool = False,
+):
+    """Pallas packed-code scan over one cluster: returns estimated sq-dists
+    [N].  ``interpret=True`` runs the kernel in the Pallas interpreter — the
+    pinned JAX has no ``force_tpu_interpret_mode``, so differential tests on
+    CPU opt in per call."""
     n, d8 = packed_codes.shape
     n_pad = ((n + tile - 1) // tile) * tile
     if n_pad != n:
@@ -83,6 +89,7 @@ def packed_scan_pallas(packed_codes, norms, factors, q_rot, *, d: int, tile: int
             pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
     )(q_r, packed_codes, norms.reshape(1, -1), factors.reshape(1, -1))
     return out[0, :n]
 
@@ -94,7 +101,10 @@ def _pow2_bucket(n: int, floor: int = 512) -> int:
     return p
 
 
-def packed_scan(packed_codes, norms, factors, q_rot, *, d: int, pallas: bool | None = None):
+def packed_scan(
+    packed_codes, norms, factors, q_rot, *, d: int, pallas: bool | None = None,
+    interpret: bool = False,
+):
     """Estimated sq-distances for one cluster's packed codes (auto backend).
 
     Cluster sizes are padded to power-of-2 buckets so repeated searches over
@@ -115,7 +125,7 @@ def packed_scan(packed_codes, norms, factors, q_rot, *, d: int, pallas: bool | N
     if use_pallas:
         out = packed_scan_pallas(
             jnp.asarray(packed_codes), jnp.asarray(norms), jnp.asarray(factors),
-            jnp.asarray(q_rot), d=d,
+            jnp.asarray(q_rot), d=d, interpret=interpret,
         )
     else:
         out = estimate_distances(

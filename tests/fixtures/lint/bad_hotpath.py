@@ -1,0 +1,37 @@
+"""Seeded hot-path-materialize violations: the intermediate-table
+materializations PR 8 deleted from the scan/loader hot path — per-window
+concat_tables, per-column combine_chunks, to_pandas — plus the legal
+shapes (zero-copy slices, a pragma'd bounded copy) that must stay silent."""
+
+import pyarrow as pa
+
+
+def rebatch_by_concat(pending, n):
+    big = pa.concat_tables(pending)  # SEED: hot-path-materialize
+    return big.slice(0, n)
+
+
+def collate_by_combine(table):
+    out = {}
+    for name in table.column_names:
+        out[name] = table.column(name).combine_chunks()  # SEED: hot-path-materialize
+    return out
+
+
+def collate_via_pandas(table):
+    return table.to_pandas()  # SEED: hot-path-materialize
+
+
+def bare_import_style(concat_tables, pending):
+    # an un-qualified call is the same materialization
+    return concat_tables(pending)  # SEED: hot-path-materialize
+
+
+def zero_copy_window_is_fine(batches, start, length):
+    # allowed: Table.from_batches over zero-copy slices — no buffer copies
+    return pa.Table.from_batches([b.slice(start, length) for b in batches])
+
+
+def justified_remainder_copy(buffer, cut):
+    # allowed: pragma'd bounded copy (unpins decoded parents)
+    return buffer.slice(cut).combine_chunks()  # lakelint: ignore[hot-path-materialize] bounded remainder copy unpins parents

@@ -117,6 +117,35 @@ def test_wall_clock_lease_rule_line_exact():
     assert lint_fixture("bad_wallclock.py") == []
 
 
+def test_hot_path_materialize_rule_line_exact():
+    """The 19th rule: concat_tables / .combine_chunks() / .to_pandas() in
+    the scan/loader hot-path modules are flagged line-exactly; zero-copy
+    window assembly and pragma'd bounded copies stay silent."""
+    from lakesoul_tpu.analysis.rules.perf import HotPathMaterializeRule
+
+    rules = [HotPathMaterializeRule(scope=("bad_hotpath.py",))]
+    found = [
+        f for f in lint_fixture("bad_hotpath.py", rules=rules)
+        if f.rule == "hot-path-materialize"
+    ]
+    assert len(found) == 4, found
+    assert_seed_lines(found, "bad_hotpath.py", "hot-path-materialize")
+    # out-of-scope path (fixture root isn't the scan/loader modules): the
+    # default-scoped catalog stays silent even with violations present
+    assert lint_fixture("bad_hotpath.py") == []
+
+
+def test_hot_path_modules_clean_without_baseline():
+    """The three hot-path modules hold under the rule with NO baseline at
+    all: every surviving materialization carries an inline pragma whose
+    reason names why the copy is legal (zero-copy chunk-list ops, bounded
+    remainder copies)."""
+    from lakesoul_tpu.analysis.rules.perf import HotPathMaterializeRule
+
+    found, _ = run(rules=[HotPathMaterializeRule()], baseline=Baseline([]))
+    assert [f for f in found if f.rule == "hot-path-materialize"] == [], found
+
+
 def test_ad_hoc_retry_rule_exempts_resilience_module(tmp_path):
     """The one legal retry loop lives in runtime/resilience.py — the same
     shape there must not be flagged."""
@@ -333,7 +362,7 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 18 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 19 and "rbac-gate-reachability" in rule_ids
     assert "pallas-blockspec" in rule_ids
     for r in driver["rules"]:
         assert r["shortDescription"]["text"]

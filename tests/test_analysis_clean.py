@@ -1,7 +1,7 @@
-"""CI gate: the repo must lint clean — under ALL 18 rules: the 9
-per-function ones (incl. ad-hoc-retry and wall-clock-lease), the 4
-interprocedural ones (call graph + dataflow), and the 5 device-pack ones
-(jit/pallas trace safety).
+"""CI gate: the repo must lint clean — under ALL 19 rules: the 10
+per-function ones (incl. ad-hoc-retry, wall-clock-lease and
+hot-path-materialize), the 4 interprocedural ones (call graph + dataflow),
+and the 5 device-pack ones (jit/pallas trace safety).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -15,10 +15,11 @@ from lakesoul_tpu.analysis.engine import Baseline, default_baseline_path
 
 EXPECTED_RULES = {
     # per-function (PR 3; ad-hoc-retry joined with the resilience layer,
-    # wall-clock-lease with the lease table)
+    # wall-clock-lease with the lease table, hot-path-materialize with the
+    # zero-copy scan path)
     "raw-thread", "lock-held-call", "stage-nondeterminism",
     "unclosed-reader", "undocumented-env", "metric-name", "sqlite-scope",
-    "ad-hoc-retry", "wall-clock-lease",
+    "ad-hoc-retry", "wall-clock-lease", "hot-path-materialize",
     # interprocedural
     "rbac-gate-reachability", "taint-path-segments",
     "transitive-lock-held-call", "interprocedural-unclosed-reader",
@@ -33,13 +34,13 @@ DEVICE_RULES = {
 }
 
 
-def test_all_eighteen_rules_registered():
+def test_all_nineteen_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 18
+    assert len(ids) == len(set(ids)) == 19
     assert set(ids) == EXPECTED_RULES
 
 

@@ -325,3 +325,108 @@ class TestCompositeMerge:
         assert got.num_rows == 100
         sel = got.filter(pc.and_(pc.equal(got["day"], 2), pc.equal(got["slot"], 7)))
         assert sel.column("v").to_pylist() == [9.0]
+
+
+class TestNativeGather:
+    """ls_gather_fixed / ls_gather_valid_bits: the merge-apply gather+fill
+    entry point must agree exactly with pyarrow take (+ if_else null fill)."""
+
+    def _table(self, n=500, seed=0):
+        rng = np.random.default_rng(seed)
+        return pa.table({
+            "i64": pa.array(rng.integers(-(2**60), 2**60, n, dtype=np.int64)),
+            "i32": pa.array(rng.integers(-(2**30), 2**30, n).astype(np.int32)),
+            "i16": pa.array(rng.integers(-1000, 1000, n).astype(np.int16)),
+            "u8": pa.array(rng.integers(0, 255, n).astype(np.uint8)),
+            "f32": pa.array(rng.normal(size=n).astype(np.float32)),
+            "f64": pa.array(rng.normal(size=n)),
+            "ts": pa.array(rng.integers(0, 10**15, n).astype("datetime64[us]")),
+            "s": pa.array([f"row{i}" for i in range(n)]),
+            "nv": pa.array(
+                [None if i % 5 == 0 else float(i) for i in range(n)],
+                type=pa.float64(),
+            ),
+        })
+
+    def test_take_indices_matches_pyarrow_take(self):
+        from lakesoul_tpu.io.merge import take_indices
+
+        t = self._table()
+        rng = np.random.default_rng(1)
+        idx = rng.integers(0, len(t), 300).astype(np.int64)
+        ref = t.take(pa.array(idx))
+        got = take_indices(t, idx)
+        assert got.equals(ref)
+
+    def test_take_indices_on_sliced_chunks(self):
+        from lakesoul_tpu.io.merge import take_indices
+
+        t = self._table().slice(37, 400)  # nonzero offsets in every buffer
+        rng = np.random.default_rng(2)
+        idx = rng.integers(0, len(t), 200).astype(np.int64)
+        assert take_indices(t, idx).equals(t.take(pa.array(idx)))
+
+    def test_gather_fill_negative_index_is_null(self):
+        from lakesoul_tpu.io.merge import _gather_fill
+
+        col = pa.array(np.arange(50, dtype=np.int64))
+        idx = np.array([0, -1, 3, -1, 49], dtype=np.int64)
+        out = _gather_fill(pa.chunked_array([col]), idx)
+        assert out.to_pylist() == [0, None, 3, None, 49]
+        # and over a column that already has nulls
+        coln = pa.array([None if i % 3 == 0 else i for i in range(50)],
+                        type=pa.int64())
+        outn = _gather_fill(pa.chunked_array([coln]), idx)
+        assert outn.to_pylist() == [None, None, None, None, 49]
+
+    def test_gather_fill_matches_python_fallback(self, monkeypatch):
+        from lakesoul_tpu.io.merge import _gather_fill
+
+        rng = np.random.default_rng(3)
+        col = pa.chunked_array([pa.array(
+            [None if i % 7 == 0 else float(i) for i in range(200)],
+            type=pa.float64(),
+        )])
+        idx = rng.integers(-1, 200, 120).astype(np.int64)
+        fast = _gather_fill(col, idx)
+        monkeypatch.setenv("LAKESOUL_TPU_DISABLE_NATIVE", "1")
+        slow = _gather_fill(col, idx)
+        monkeypatch.delenv("LAKESOUL_TPU_DISABLE_NATIVE")
+        assert pa.chunked_array([fast]).equals(pa.chunked_array(
+            [slow] if isinstance(slow, pa.Array) else slow.chunks
+        ))
+
+    def test_gather_valid_bits_counts(self):
+        vals = pa.array([None, 1, 2, None, 4], type=pa.int64())
+        bufs = vals.buffers()
+        vbits = np.frombuffer(bufs[0], dtype=np.uint8)
+        idx = np.array([1, 0, -1, 4], dtype=np.int64)
+        out, nulls = native.gather_valid_bits(vbits, vals.offset, idx)
+        assert nulls == 2  # index 0 (null source) + index -1 (fill)
+        got = [(out[i >> 3] >> (i & 7)) & 1 for i in range(4)]
+        assert got == [1, 0, 0, 1]
+
+    def test_empty_and_identity(self):
+        from lakesoul_tpu.io.merge import take_indices
+
+        t = self._table(20)
+        assert len(take_indices(t, np.array([], dtype=np.int64))) == 0
+        ident = take_indices(t, np.arange(20, dtype=np.int64))
+        assert ident.equals(t)
+
+    def test_take_indices_negative_fill_on_chunked_null_free(self):
+        """Negative indices must yield NULL cells even for null-free
+        multi-chunk columns — the multi-column fast path cannot represent
+        fill rows (searchsorted would map -1 to garbage), so their presence
+        must route every column through the per-column gather+fill."""
+        from lakesoul_tpu.io.merge import take_indices
+
+        a = pa.table({"x": pa.array(np.arange(10, dtype=np.int64)),
+                      "y": pa.array(np.arange(10).astype(np.float32))})
+        b = pa.table({"x": pa.array(np.arange(10, 25, dtype=np.int64)),
+                      "y": pa.array(np.arange(10, 25).astype(np.float32))})
+        t = pa.concat_tables([a, b])  # 2 chunks per column, no nulls
+        idx = np.array([3, -1, 14, -1, 24], dtype=np.int64)
+        got = take_indices(t, idx)
+        assert got.column("x").to_pylist() == [3, None, 14, None, 24]
+        assert got.column("y").to_pylist() == [3.0, None, 14.0, None, 24.0]
