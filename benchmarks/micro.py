@@ -541,16 +541,21 @@ def bench_chaos(n_rows: int = 400_000, n_files: int = 8, p: float = 0.3) -> None
 def bench_lint() -> None:
     """Analyzer wall-time over the whole package (CI-gate cost leg: the
     lint gate runs on every PR, so its cost is tracked next to the perf
-    legs; target < 10 s for all 18 rules INCLUDING the project call-graph
-    build the interprocedural rules share and the device-index/taint
-    passes of the JAX/TPU pack)."""
+    legs; target < 10 s for all 23 rules INCLUDING the project call-graph
+    build the interprocedural rules share, the device-index/taint passes
+    of the JAX/TPU pack, and the thread-root/lockset passes of the
+    concurrency pack).  Per-rule wall milliseconds ride along in the leg
+    JSON so a future rule regression is attributable to ONE rule id — note
+    a shared index (call graph, device index, thread roots) bills to the
+    first rule that builds it."""
     from lakesoul_tpu.analysis import run_repo
     from lakesoul_tpu.analysis.engine import Project, Module, package_root
 
     # parse+rule cost is dominated by file IO the first time; report the
     # steady-state of a fresh run, which is what CI pays
+    timings: dict = {}
     start = time.perf_counter()
-    findings, _ = run_repo()
+    findings, _ = run_repo(timings=timings)
     dt = time.perf_counter() - start
     n_files = sum(
         len([f for f in files if f.endswith(".py")])
@@ -570,6 +575,13 @@ def bench_lint() -> None:
         files=n_files, findings=len(findings),
         files_per_s=round(n_files / dt, 1),
         callgraph_ms=round(cg_dt * 1e3, 1),
+        rules=len(timings),
+        rule_ms={
+            rule_id: round(seconds * 1e3, 1)
+            for rule_id, seconds in sorted(
+                timings.items(), key=lambda kv: -kv[1]
+            )
+        },
         **{f"callgraph_{k}": v for k, v in graph.stats().items()},
     )
     assert dt < 10.0, f"lint gate took {dt:.1f}s — budget is 10s"

@@ -23,13 +23,22 @@ Four complementary layers:
   ``jit-static-arg-shape``, ``pallas-blockspec``) over a shared device
   index (jit entries, pallas kernels, the traced-function closure) and
   the taint framework's device-value lattice.
-- :mod:`lockgraph` / :mod:`tracecheck` — the opt-in runtime detectors:
-  ``LAKESOUL_LOCKCHECK=1`` instruments ``Lock``/``RLock`` to record the
-  per-thread acquisition graph (lock-order cycles,
-  lock-held-across-``pool.submit``); ``LAKESOUL_TRACECHECK=1`` wraps jit
-  entry points to count distinct abstract signatures per function and
-  flags functions that recompile beyond their budget.  Both are wired
-  into the test suite via conftest fixtures.
+- :mod:`threadroots` + :mod:`rules.races` + :mod:`rules.lifetime` — the
+  concurrency-soundness pack: thread-root inference over the call graph
+  (Thread targets, pool submissions, pipeline stages, ``do_*`` handlers)
+  feeding Eraser-style static locksets (``shared-state-race``,
+  ``racy-check-then-act``) and the zero-copy buffer-lifetime rules
+  (``view-escapes-release``, ``ring-aliasing``).
+- :mod:`lockgraph` / :mod:`tracecheck` / :mod:`racecheck` — the opt-in
+  runtime detectors: ``LAKESOUL_LOCKCHECK=1`` instruments
+  ``Lock``/``RLock`` to record the per-thread acquisition graph
+  (lock-order cycles, lock-held-across-``pool.submit``);
+  ``LAKESOUL_TRACECHECK=1`` wraps jit entry points to count distinct
+  abstract signatures per function and flags functions that recompile
+  beyond their budget; ``LAKESOUL_RACECHECK=1`` runs Eraser lockset
+  tracking on the instrumented hot classes' field writes and arms the
+  collate ring's canary/poison mode.  All are wired into the test suite
+  via conftest fixtures.
 """
 
 from lakesoul_tpu.analysis.engine import (

@@ -46,6 +46,9 @@ __all__ = [
     "env_requested",
     "watch",
     "current_held",
+    "instrument_locks",
+    "uninstrument_locks",
+    "real_lock",
 ]
 
 _ENV = "LAKESOUL_LOCKCHECK"
@@ -288,6 +291,42 @@ def _make_rlock():
     return CheckedRLock(_REAL_RLOCK())
 
 
+# ------------------------------------------------------- lock instrumentation
+# The checked-lock wrappers serve TWO detectors: this module's lock-order
+# graph and racecheck's per-field lockset tracking (it reads current_held()).
+# Both may be armed independently per test, so the threading.Lock/RLock
+# patch is refcounted — the real primitives come back only when the last
+# detector lets go.
+
+_PATCH_COUNT = 0
+
+
+def real_lock():
+    """An UNchecked lock for detector-internal state — the detectors must
+    never trace their own bookkeeping locks."""
+    return _REAL_LOCK()
+
+
+def instrument_locks() -> None:
+    """Patch ``threading.Lock``/``threading.RLock`` to checked wrappers
+    (refcounted; see above)."""
+    global _PATCH_COUNT
+    _PATCH_COUNT += 1
+    if _PATCH_COUNT == 1:
+        threading.Lock = _make_lock
+        threading.RLock = _make_rlock
+
+
+def uninstrument_locks() -> None:
+    global _PATCH_COUNT
+    if _PATCH_COUNT == 0:
+        return
+    _PATCH_COUNT -= 1
+    if _PATCH_COUNT == 0:
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+
+
 # --------------------------------------------------------------- pool hook
 
 
@@ -351,8 +390,7 @@ def enable() -> None:
     """Patch lock construction + pool submit.  Idempotent."""
     if _STATE.enabled:
         return
-    threading.Lock = _make_lock
-    threading.RLock = _make_rlock
+    instrument_locks()
     from lakesoul_tpu.runtime.pool import WorkerPool
 
     if not hasattr(WorkerPool.submit, "_lockgraph_orig"):
@@ -365,8 +403,7 @@ def disable() -> None:
     working (bookkeeping stays consistent); recording stops."""
     if not _STATE.enabled:
         return
-    threading.Lock = _REAL_LOCK
-    threading.RLock = _REAL_RLOCK
+    uninstrument_locks()
     from lakesoul_tpu.runtime.pool import WorkerPool
 
     orig = getattr(WorkerPool.submit, "_lockgraph_orig", None)

@@ -26,7 +26,15 @@ from lakesoul_tpu.analysis.rules.conventions import (
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.lifetime import (
+    RingAliasingRule,
+    ViewEscapesReleaseRule,
+)
 from lakesoul_tpu.analysis.rules.perf import HotPathMaterializeRule
+from lakesoul_tpu.analysis.rules.races import (
+    RacyCheckThenActRule,
+    SharedStateRaceRule,
+)
 from lakesoul_tpu.analysis.rules.jaxtpu import (
     JitStaticArgShapeRule,
     PallasBlockSpecRule,
@@ -66,6 +74,11 @@ def all_rules() -> list[Rule]:
         TaintPathSegmentsRule(),
         TransitiveLockHeldCallRule(),
         InterproceduralUnclosedReaderRule(),
+        # concurrency-soundness pack (thread roots + locksets + lifetimes)
+        SharedStateRaceRule(),
+        RacyCheckThenActRule(),
+        ViewEscapesReleaseRule(),
+        RingAliasingRule(),
         # device pack (jit/pallas trace safety)
         TraceImpureCallRule(),
         TraceHostSyncRule(),

@@ -1,0 +1,231 @@
+"""Zero-copy buffer-lifetime rules for the PR-8 collate machinery.
+
+The scan path's speed comes from *borrowing*: ``_np_column_views`` hands
+out numpy views over Arrow batch buffers, and the opt-in
+``LAKESOUL_COLLATE_REUSE`` ring hands out output-buffer sets that are
+**overwritten in place** once the ring wraps.  Both are only sound inside
+a window discipline — a view travels with the batch that owns its bytes,
+and a ring slot is dead the moment the ring wraps back to it.  Nothing
+type-checks that discipline, and a violation is not a crash but silently
+corrupt training data.  Two rules pin it:
+
+- ``view-escapes-release``: the result of ``_np_column_views(batch)`` or
+  ``<ring>.next_slot()`` must stay inside the borrowing function's window:
+  passing it as a call argument is the sanctioned hand-off
+  (``window.collate(slot)``), and storing a *view* together with its
+  owning batch in one tuple is the rebatcher's keep-alive idiom
+  (``self._pending.append((b, views))``).  Everything else escapes the
+  release point: storing a bare view/slot on ``self`` or into a
+  container, returning it, or closing over it in a nested function — the
+  borrower then outlives the slot and reads bytes a later window already
+  overwrote.
+- ``ring-aliasing``: every ``_BufferRing(...)`` construction must sit
+  under a guard that excludes ``cache='device'``.  The device-resident
+  epoch KEEPS every delivered batch, and on host-backed jax devices
+  ``device_put`` may alias the host buffer — a ring under that mode would
+  overwrite the cached epoch in place.  The exclusion lives in one ``if``
+  today; this rule keeps any future ring construction honest.
+
+The runtime half (``analysis/racecheck.py``) closes what the lexical
+rules cannot see: its ring canary checks, at each slot hand-out, that no
+borrower still holds the previous window's buffers, and poisons the slot
+so a stale read is loud garbage instead of plausible data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from lakesoul_tpu.analysis.engine import (
+    Finding,
+    Module,
+    Rule,
+    dotted_name,
+    enclosing_function_bodies,
+    walk_stopping_at_functions,
+)
+
+# the zero-copy loader module the rules default-scope to; fixtures override
+SCOPE = ("data/jax_iter.py",)
+
+_VIEW_FACTORY = "_np_column_views"
+_SLOT_METHOD = "next_slot"
+_RING_CTOR = "_BufferRing"
+
+# container methods a borrowed value must not be handed into
+_STORE_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "put", "put_nowait",
+}
+
+
+def _tracked_call(value: ast.expr) -> "str | tuple[str, str | None] | None":
+    """Classify an RHS: ``("view", source_name)`` for ``_np_column_views(x)``,
+    ``("slot", None)`` for ``<ring>.next_slot()``, else None.  IfExp arms
+    are checked too (``views = _np_column_views(b) if cap else None``)."""
+    if isinstance(value, ast.IfExp):
+        return _tracked_call(value.body) or _tracked_call(value.orelse)
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    terminal = (name or "").rsplit(".", 1)[-1]
+    if terminal == _VIEW_FACTORY:
+        src = value.args[0].id if (
+            value.args and isinstance(value.args[0], ast.Name)
+        ) else None
+        return ("view", src)
+    if isinstance(value.func, ast.Attribute) and value.func.attr == _SLOT_METHOD:
+        return ("slot", None)
+    return None
+
+
+class ViewEscapesReleaseRule(Rule):
+    id = "view-escapes-release"
+    title = "borrowed view / ring slot escapes its release point"
+
+    def __init__(self, scope: tuple = SCOPE):
+        self.scope = scope
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not any(s in module.relpath for s in self.scope):
+            return
+        for _, body in enclosing_function_bodies(module.tree):
+            nodes = list(walk_stopping_at_functions(body))
+            views: dict[str, str | None] = {}  # name -> owning-batch name
+            slots: set[str] = set()
+            for node in nodes:
+                if isinstance(node, ast.Assign):
+                    kind = _tracked_call(node.value)
+                    if kind is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            if kind[0] == "view":
+                                views[t.id] = kind[1]
+                            else:
+                                slots.add(t.id)
+            if not views and not slots:
+                continue
+            yield from self._scan_escapes(module, nodes, views, slots)
+
+    # ------------------------------------------------------------- escapes
+    def _borrowed(self, expr: ast.expr, views, slots) -> "tuple[str, str] | None":
+        """``(kind, name)`` when ``expr`` hands a borrowed value onward
+        WITHOUT its keep-alive: a bare tracked name, or a tuple/list that
+        contains a tracked view but NOT the batch that owns its bytes
+        (slots have no keep-alive — any containerized escape is a bug)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in slots:
+                return ("ring slot", expr.id)
+            if expr.id in views:
+                return ("view", expr.id)
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            names = {e.id for e in expr.elts if isinstance(e, ast.Name)}
+            for n in names & slots:
+                return ("ring slot", n)
+            for n in names & set(views):
+                src = views[n]
+                if src is None or src not in names:
+                    return ("view", n)  # travelling without its batch
+            return None
+        return None
+
+    def _scan_escapes(self, module, nodes, views, slots) -> Iterable[Finding]:
+        def finding(line: int, kind: str, name: str, how: str) -> Finding:
+            return Finding(
+                self.id,
+                module.relpath,
+                line,
+                f"{kind} {name!r} {how} — it escapes the release point: the "
+                "borrower can outlive the window and read bytes a later "
+                "window already overwrote (views must travel with their "
+                "owning batch; ring slots must not outlive one collate)",
+            )
+
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                if _tracked_call(node.value) is not None:
+                    continue  # the tracking assignment itself
+                hit = self._borrowed(node.value, views, slots)
+                if hit is not None:
+                    kind, name = hit
+                    target = node.targets[0]
+                    if isinstance(target, ast.Name):
+                        continue  # local rebind stays inside the window
+                    yield finding(node.lineno, kind, name, "is stored")
+            elif isinstance(node, ast.Return) and node.value is not None:
+                hit = self._borrowed(node.value, views, slots)
+                if hit is not None:
+                    kind, name = hit
+                    yield finding(node.lineno, kind, name, "is returned")
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr not in _STORE_METHODS:
+                    continue
+                for arg in node.args:
+                    hit = self._borrowed(arg, views, slots)
+                    if hit is not None:
+                        kind, name = hit
+                        yield finding(
+                            node.lineno, kind, name,
+                            f"is stored via .{node.func.attr}(...)",
+                        )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                captured = {
+                    n.id for n in ast.walk(node)
+                    if isinstance(n, ast.Name)
+                } & (set(views) | slots)
+                for name in sorted(captured):
+                    kind = "ring slot" if name in slots else "view"
+                    yield finding(
+                        node.lineno, kind, name, "is closed over"
+                    )
+
+
+class RingAliasingRule(Rule):
+    id = "ring-aliasing"
+    title = "_BufferRing built without the cache='device' exclusion"
+
+    def __init__(self, scope: tuple = SCOPE):
+        self.scope = scope
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not any(s in module.relpath for s in self.scope):
+            return
+        parents = module.parents()
+        for node in module.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if (name or "").rsplit(".", 1)[-1] != _RING_CTOR:
+                continue
+            if self._device_guarded(node, parents):
+                continue
+            yield Finding(
+                self.id,
+                module.relpath,
+                node.lineno,
+                "_BufferRing(...) constructed without a guard excluding "
+                "cache='device' — the device-resident epoch keeps every "
+                "delivered batch and device_put may alias host buffers, so "
+                "a reuse ring would overwrite the cached epoch in place",
+            )
+
+    @staticmethod
+    def _device_guarded(call: ast.Call, parents) -> bool:
+        node: ast.AST = call
+        while node in parents:
+            node = parents[node]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            test = None
+            if isinstance(node, (ast.If, ast.IfExp)):
+                test = node.test
+            if test is not None and any(
+                isinstance(sub, ast.Constant) and sub.value == "device"
+                for sub in ast.walk(test)
+            ):
+                return True
+        return False

@@ -315,6 +315,10 @@ class _LeaseHeartbeat:
         self._ttl_ms = ttl_ms
         self._ttl_s = ttl_ms / 1000.0
         self._period_s = max(self._ttl_s / 3.0, 0.05)
+        # published by the heartbeat thread, read by the job thread: every
+        # post-init write holds _guard so the hand-off is a clean release/
+        # acquire (racecheck-proven), not a torn unlocked publish
+        self._guard = threading.Lock()
         self.valid_until = time.monotonic() + self._ttl_s
         self.fenced = False
         self._stop = threading.Event()
@@ -347,9 +351,11 @@ class _LeaseHeartbeat:
                 )
                 continue
             if renewed is None:
-                self.fenced = True  # expired or fenced: never revive, re-acquire
+                with self._guard:
+                    self.fenced = True  # expired or fenced: never revive, re-acquire
                 return
-            self.valid_until = time.monotonic() + self._ttl_s
+            with self._guard:
+                self.valid_until = time.monotonic() + self._ttl_s
 
 
 class LeasedCompactionService:

@@ -310,6 +310,27 @@ class _Window:
         return out
 
 
+def _host_backed_devices(sharding=None) -> bool:
+    """True when the loader's device_put target shares host memory (CPU):
+    there device_put may alias a dtype-matching numpy buffer instead of
+    copying, so a delivered batch can keep borrowing a collate buffer
+    indefinitely.  The target is the explicit ``sharding``'s devices when
+    one was given (a host-device sharding on an accelerator machine still
+    aliases), else the default backend."""
+    import jax
+
+    try:
+        if sharding is not None:
+            devices = getattr(sharding, "device_set", None)
+            if devices:
+                return any(
+                    getattr(d, "platform", "cpu") == "cpu" for d in devices
+                )
+        return jax.default_backend() == "cpu"
+    except Exception:  # backend init failure: assume aliasing, stay safe
+        return True
+
+
 class _BufferRing:
     """Round-robin pool of collate output buffer sets (opt-in via
     ``LAKESOUL_COLLATE_REUSE=1``): with ``size`` ≥ the number of windows that
@@ -318,7 +339,11 @@ class _BufferRing:
     buffers of a window the consumer has already retired.  Only safe when
     the consumer copies batches out (e.g. ``device_put`` to a non-host
     backend) before ``size`` further batches are drawn; the default path
-    allocates fresh buffers per window."""
+    allocates fresh buffers per window.  That contract is machine-checked:
+    ``LAKESOUL_RACECHECK=1`` (analysis/racecheck.py) wraps ``next_slot``
+    with a canary that flags any slot handed out while a borrower still
+    references its buffers, then poisons the dead bytes so a stale read
+    is loud garbage instead of plausible training data."""
 
     def __init__(self, size: int):
         self._slots: list[dict[str, np.ndarray]] = [{} for _ in range(max(1, size))]
@@ -445,14 +470,19 @@ class JaxBatchIterator:
         self._collate = collate_fn or _default_collate
         # opt-in collate-buffer reuse ring (see _BufferRing contract); sized
         # to cover every window that can be live at once.  Never under
-        # cache='device': the resident epoch KEEPS every delivered batch, and
-        # on host-backed jax devices device_put may alias the host buffer —
-        # a wrapped ring would overwrite cached epochs in place.
+        # cache='device' (the resident epoch KEEPS every delivered batch) and
+        # never when device_put targets a HOST-BACKED backend: there
+        # jax.device_put of an already-device-dtype column (float32/int32) is
+        # zero-copy — the jax.Array aliases the slot buffer, and the wrapped
+        # ring would overwrite live device data in place.  Found by the
+        # racecheck ring canary on a real CPU-mesh training drive; TPU/GPU
+        # device_put copies across the link, so the ring stays armed there.
         self._ring: _BufferRing | None = None
         if (
             collate_fn is None
             and cache != "device"
             and os.environ.get("LAKESOUL_COLLATE_REUSE") == "1"
+            and not (device_put and _host_backed_devices(sharding))
         ):
             self._ring = _BufferRing(
                 max(1, prefetch) + max(1, device_prefetch) + 2

@@ -257,9 +257,13 @@ class DiskPageCache:
                     continue
                 entries.append((st.st_mtime, key_dir, idx, st.st_size))
         entries.sort()  # oldest first → least recently used at the front
-        for _, key, idx, size in entries:
-            self._index[(key, idx)] = size
-            self._bytes += size
+        with self._lock:
+            # only ever called during __init__ today, but the index/byte
+            # accounting invariant is "mutated under _lock" everywhere else;
+            # holding it here keeps that machine-checkable (shared-state-race)
+            for _, key, idx, size in entries:
+                self._index[(key, idx)] = size
+                self._bytes += size
 
     @staticmethod
     def _key(path: str) -> str:

@@ -428,12 +428,16 @@ class PipelineIterator:
             # holding a shared pool worker would let one slow training
             # loop starve every other pipeline in the process.  The pool
             # is reserved for runnable work (map_parallel items).
-            self._threads = [t for t in self._threads if t.is_alive()]
             t = threading.Thread(  # lakelint: ignore[raw-thread] consumer-paced slot pump; a parked pool worker would starve other pipelines
                 target=produce, args=(item, q),
                 daemon=True, name=f"{self._name}-{st.name}-slot",
             )
-            self._threads.append(t)
+            # under a downstream prefetch this generator body runs on the
+            # pump thread while close() reads _threads from the consumer —
+            # every _threads mutation holds _lock (racecheck-proven)
+            with self._lock:
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
             t.start()
             depth.inc()
             return True
@@ -468,7 +472,8 @@ class PipelineIterator:
         hist, depth = self._stage_metrics(st)
         q: _queue.Queue = _queue.Queue(maxsize=st.depth)
         st.queue = q
-        self._prefetch_queues.append((q, depth))
+        with self._lock:
+            self._prefetch_queues.append((q, depth))
         owned = list(self._consumer_gens)  # the pump now owns the upstream chain
 
         def pump():
@@ -504,7 +509,8 @@ class PipelineIterator:
         t = threading.Thread(  # lakelint: ignore[raw-thread] prefetch pump parks on a bounded queue; pool workers are reserved for runnable work
             target=pump, daemon=True, name=f"{self._name}-{st.name}"
         )
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         t.start()
         return self._drain_prefetch(q, st, depth)
 
@@ -548,11 +554,17 @@ class PipelineIterator:
                     close()
                 except Exception:
                     pass
-        for t in self._threads:
+        # snapshot under _lock (slot pumps mutate _threads/_prefetch_queues
+        # from their own threads), join OUTSIDE it — joining under the lock
+        # would be the lock-held-call deadlock shape
+        with self._lock:
+            threads = list(self._threads)
+            queues, self._prefetch_queues = self._prefetch_queues, []
+        for t in threads:
             t.join(timeout=join_timeout)
         # reconcile this run's leftover contribution to the shared
         # queue-depth gauges: items the pump enqueued but nobody consumed
-        for q, depth in self._prefetch_queues:
+        for q, depth in queues:
             while True:
                 try:
                     got = q.get_nowait()
@@ -560,7 +572,6 @@ class PipelineIterator:
                     break
                 if got is not _DONE and not isinstance(got, BaseException):
                     depth.dec()
-        self._prefetch_queues.clear()
 
     def __del__(self):  # abandoned iterator: stop producers, don't join
         try:

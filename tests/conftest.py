@@ -59,6 +59,44 @@ _LOCKCHECK_MODULES = ("test_runtime", "test_metadata")
 
 _TRACECHECK_MODULES = ("test_vector", "test_models_parallel", "test_catalog")
 
+# --------------------------------------------------------------- racecheck
+# LAKESOUL_RACECHECK=1 arms lakelint's runtime race detector
+# (lakesoul_tpu/analysis/racecheck.py) for the suites that drive the
+# concurrent hot classes hard: the pipeline/pool machinery (test_runtime),
+# the admission/breaker/ANN serving surfaces (test_resilience), and the
+# lease heartbeat (test_topology).  Eraser lockset tracking on instrumented
+# class fields: a field written by two threads with no common lock — or a
+# collate-ring slot reused while a borrowed view is live — fails the test
+# at teardown with both access stacks.
+
+_RACECHECK_MODULES = ("test_runtime", "test_resilience", "test_topology")
+
+
+@pytest.fixture(autouse=True)
+def _racecheck(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rpartition(".")[2] not in _RACECHECK_MODULES:
+        yield
+        return
+    from lakesoul_tpu.analysis import racecheck
+
+    if not racecheck.env_requested() or racecheck.enabled():
+        # not armed, or something else already manages the detector
+        yield
+        return
+    racecheck.reset()
+    racecheck.enable()
+    try:
+        yield
+    finally:
+        violations = racecheck.violations()
+        racecheck.disable()
+        racecheck.reset()
+    assert not violations, "racecheck violations:\n" + "\n\n".join(
+        v.render() for v in violations
+    )
+
 
 @pytest.fixture(autouse=True)
 def _tracecheck(request):

@@ -1,0 +1,79 @@
+"""Seeded shared-state races: fields touched from two thread roots with no
+common lock — the Eraser lockset class — plus the legal shapes (one lock
+everywhere, condition-aliased locks, single-root writers) that must stay
+silent."""
+
+import threading
+
+
+class Telemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.synced = 0
+        self.pending = []
+
+    def worker_loop(self):
+        self.count += 1  # SEED: shared-state-race
+        self.pending.append(1)  # SEED: shared-state-race
+        with self._lock:
+            self.synced += 1
+
+    def reset(self):
+        self.count = 0
+        if len(self.pending) > 10:  # SEED: racy-check-then-act
+            self.pending.clear()
+
+    def bump_synced(self):
+        with self._lock:
+            self.synced += 1
+
+    def drain_locked(self):
+        with self._lock:
+            if len(self.pending) > 10:  # locked: check-then-act is atomic
+                self.pending.clear()
+
+    def spill(self, path):
+        if len(self.pending) > 100:  # SEED: racy-check-then-act
+            with open(path, "w") as f:  # a non-lock `with` shields nothing
+                f.write("spill")
+                self.pending.clear()
+
+    def start(self):
+        threading.Thread(target=self.worker_loop).start()
+
+
+class ConditionAliased:
+    """``Condition(self._mu)`` wraps the SAME lock: ``with self._cv`` and
+    ``with self._mu`` must intersect to a non-empty lockset."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self.depth = 0
+
+    def producer_loop(self):
+        with self._cv:
+            self.depth += 1
+            self._cv.notify()
+
+    def take(self):
+        with self._mu:
+            self.depth -= 1
+
+    def start(self):
+        threading.Thread(target=self.producer_loop).start()
+
+
+class MainOnly:
+    """Unlocked writes from two *main-root* methods: one thread of control,
+    no race, no finding."""
+
+    def __init__(self):
+        self.cursor = 0
+
+    def advance(self):
+        self.cursor += 1
+
+    def rewind(self):
+        self.cursor = 0

@@ -1,0 +1,56 @@
+"""Seeded buffer-lifetime bugs: zero-copy views and reuse-ring slots that
+escape their release point, and a ``_BufferRing`` built without the
+``cache='device'`` exclusion — plus the sanctioned shapes (argument
+hand-off, view-travels-with-its-batch, guarded ring) that must stay
+silent."""
+
+
+def _np_column_views(batch):
+    return {"c": batch}
+
+
+class _BufferRing:
+    def __init__(self, size):
+        self._slots = [{} for _ in range(size)]
+        self._next = 0
+
+    def next_slot(self):
+        slot = self._slots[self._next]
+        self._next = (self._next + 1) % len(self._slots)
+        return slot
+
+
+class BadRebatcher:
+    def __init__(self):
+        self._ring = _BufferRing(4)  # SEED: ring-aliasing
+        self._pending = []
+        self._stash = None
+
+    def push(self, batch):
+        views = _np_column_views(batch)
+        self._stash = views  # SEED: view-escapes-release
+        self._pending.append(views)  # SEED: view-escapes-release
+        return views  # SEED: view-escapes-release
+
+    def push_ok(self, batch):
+        views = _np_column_views(batch)
+        self._pending.append((batch, views))  # ok: travels with its batch
+
+    def collate_bad(self, window):
+        slot = self._ring.next_slot()
+        self._pending.append(slot)  # SEED: view-escapes-release
+
+        def deliver_later():  # SEED: view-escapes-release
+            return dict(slot)
+
+        return deliver_later
+
+    def collate_ok(self, window):
+        slot = self._ring.next_slot()
+        return window.collate(slot)  # ok: argument hand-off, not an escape
+
+
+def make_guarded_ring(cache):
+    if cache != "device":
+        return _BufferRing(4)  # ok: the device-cache exclusion guards it
+    return None

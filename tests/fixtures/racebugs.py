@@ -1,0 +1,78 @@
+"""Deliberate runtime shared-state races for the racecheck detector
+(tests/test_racecheck.py).  Each function reproduces one race class with
+the threads SEQUENCED so the bug is observable without the test ever
+depending on a lucky interleaving — exactly the lockbugs.py discipline:
+
+- :class:`UnsyncCounter` / :func:`unsynchronized_writes` — two threads
+  ``+=`` the same field with no lock.  Even when the threads happen to run
+  back-to-back, Eraser's lockset goes empty on the second thread's first
+  write and the violation records both access stacks — the evidence a
+  production torn update leaves AFTER corrupting a run, available BEFORE.
+- :class:`SyncCounter` / :func:`synchronized_writes` — the same shape with
+  every write under one lock; must stay violation-free.
+- :class:`HandoffFlag` / :func:`locked_publish_after_init` — the
+  init-phase pattern the detector must NOT flag: the constructing thread
+  writes unlocked (construction happens-before publication), the second
+  thread publishes under a lock.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class UnsyncCounter:
+    def __init__(self):
+        self.value = 0
+
+    def bump(self, n: int) -> None:
+        for _ in range(n):
+            self.value += 1
+
+
+class SyncCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self, n: int) -> None:
+        for _ in range(n):
+            with self._lock:
+                self.value += 1
+
+
+class HandoffFlag:
+    def __init__(self):
+        self._guard = threading.Lock()
+        self.fenced = False  # init-phase write: unlocked on purpose
+
+    def fence(self) -> None:
+        with self._guard:
+            self.fenced = True
+
+
+def _run_sequenced(fn, rounds: int = 2) -> None:
+    """Run ``fn`` on ``rounds`` threads back-to-back (never concurrently):
+    the detector keys on lockset evidence, not on timing."""
+    for _ in range(rounds):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+
+def unsynchronized_writes() -> UnsyncCounter:
+    c = UnsyncCounter()
+    _run_sequenced(lambda: c.bump(50))
+    return c
+
+
+def synchronized_writes() -> SyncCounter:
+    c = SyncCounter()
+    _run_sequenced(lambda: c.bump(50))
+    return c
+
+
+def locked_publish_after_init() -> HandoffFlag:
+    f = HandoffFlag()
+    _run_sequenced(f.fence, rounds=1)
+    return f

@@ -135,6 +135,150 @@ def test_hot_path_materialize_rule_line_exact():
     assert lint_fixture("bad_hotpath.py") == []
 
 
+def test_shared_state_race_rule_line_exact():
+    """The lockset rule: fields written from ≥2 thread roots with no common
+    lock are flagged line-exactly; one-lock-everywhere fields,
+    condition-aliased locks, and single-root writers stay silent."""
+    from lakesoul_tpu.analysis.rules.races import SharedStateRaceRule
+
+    rules = [SharedStateRaceRule(scope=("bad_races.py",))]
+    found = [
+        f for f in lint_fixture("bad_races.py", rules=rules)
+        if f.rule == "shared-state-race"
+    ]
+    assert len(found) == 2, found
+    assert_seed_lines(found, "bad_races.py", "shared-state-race")
+    msgs = "\n".join(f.message for f in found)
+    assert "self.count" in msgs and "self.pending" in msgs
+    assert "thread:Telemetry.worker_loop" in msgs and "main" in msgs
+    assert "self.synced" not in msgs  # locked twin
+    assert "self.depth" not in msgs  # condition-aliased lock agrees
+    assert "self.cursor" not in msgs  # single-root writer
+    # out-of-scope (the default scope is the package): the catalog's only
+    # finding on this fixture is the raw Thread the race needs to exist
+    assert {f.rule for f in lint_fixture("bad_races.py")} == {"raw-thread"}
+
+
+def test_racy_check_then_act_rule_line_exact():
+    from lakesoul_tpu.analysis.rules.races import RacyCheckThenActRule
+
+    rules = [RacyCheckThenActRule(scope=("bad_races.py",))]
+    found = [
+        f for f in lint_fixture("bad_races.py", rules=rules)
+        if f.rule == "racy-check-then-act"
+    ]
+    assert len(found) == 2, found
+    assert_seed_lines(found, "bad_races.py", "racy-check-then-act")
+    msgs = "\n".join(f.message for f in found)
+    assert "self.pending" in msgs and "TOCTOU" in msgs
+    # the locked twin (drain_locked) must stay silent — the check and the
+    # act are atomic under the class lock; a non-lock `with` (spill's
+    # open()) shields nothing
+
+
+def test_view_escapes_release_rule_line_exact():
+    from lakesoul_tpu.analysis.rules.lifetime import ViewEscapesReleaseRule
+
+    rules = [ViewEscapesReleaseRule(scope=("bad_viewescape.py",))]
+    found = [
+        f for f in lint_fixture("bad_viewescape.py", rules=rules)
+        if f.rule == "view-escapes-release"
+    ]
+    assert len(found) == 5, found
+    assert_seed_lines(found, "bad_viewescape.py", "view-escapes-release")
+    msgs = "\n".join(f.message for f in found)
+    assert "is stored" in msgs and "is returned" in msgs
+    assert "is closed over" in msgs
+    # the sanctioned shapes stay silent: argument hand-off (collate_ok) and
+    # the view-travels-with-its-batch tuple (push_ok)
+
+
+def test_ring_aliasing_rule_line_exact():
+    from lakesoul_tpu.analysis.rules.lifetime import RingAliasingRule
+
+    rules = [RingAliasingRule(scope=("bad_viewescape.py",))]
+    found = [
+        f for f in lint_fixture("bad_viewescape.py", rules=rules)
+        if f.rule == "ring-aliasing"
+    ]
+    assert len(found) == 1, found
+    assert_seed_lines(found, "bad_viewescape.py", "ring-aliasing")
+    assert "cache='device'" in found[0].message
+    # out-of-scope default: both lifetime rules default to data/jax_iter.py
+    assert lint_fixture("bad_viewescape.py") == []
+
+
+def test_thread_root_inference_on_fixture():
+    """The root index must see the Thread(target=) entry, keep the worker
+    off the main root, and leave uncalled public methods main-rooted."""
+    from lakesoul_tpu.analysis.threadroots import thread_roots
+
+    project = Project(root=LINT)
+    project.modules.append(Module.load(LINT / "bad_races.py", LINT))
+    idx = thread_roots(project)
+    assert ("thread", "bad_races.py::Telemetry.worker_loop") in idx.entries
+    worker = idx.roots_of("bad_races.py::Telemetry.worker_loop")
+    assert worker == {"thread:bad_races.py::Telemetry.worker_loop"}
+    assert idx.roots_of("bad_races.py::Telemetry.reset") == {"main"}
+
+
+def test_thread_root_inference_on_real_loader():
+    """Real-repo shapes: the pipeline source generator carries the pipeline
+    root, the lease heartbeat its thread root, the Flight verbs handler
+    roots — and the per-request HTTP handler collapses to ONE root."""
+    from lakesoul_tpu.analysis.engine import package_root
+    from lakesoul_tpu.analysis.threadroots import thread_roots
+
+    project = Project(root=package_root().parent)
+    for rel in (
+        "data/jax_iter.py", "compaction/service.py", "service/flight.py",
+        "service/storage_proxy.py",
+    ):
+        mod = Module.load(package_root() / rel, package_root().parent)
+        assert mod is not None
+        project.modules.append(mod)
+    idx = thread_roots(project)
+    kinds = {k for k, _ in idx.entries}
+    assert {"thread", "pipeline", "handler"} <= kinds
+    hb = idx.roots_of(
+        "lakesoul_tpu/compaction/service.py::_LeaseHeartbeat._run"
+    )
+    assert any(r.startswith("thread:") for r in hb)
+    src = idx.roots_of(
+        "lakesoul_tpu/data/jax_iter.py::JaxBatchIterator._epoch_windows"
+    )
+    assert any(r.startswith("pipeline:") for r in src)
+    # every do_* verb of the per-request proxy handler shares one root
+    proxy_roots = {
+        r
+        for q, roots in idx.roots.items()
+        if "storage_proxy.py::StorageProxy.__init__.Handler.do_" in q
+        for r in roots
+        if r.startswith("handler:")
+    }
+    assert len(proxy_roots) == 1, proxy_roots
+
+
+def test_concurrency_rules_silent_on_real_hot_modules():
+    """The fixed runtime/pipeline, page cache, loader, serving and
+    heartbeat modules hold under the whole concurrency pack with NO
+    baseline: the PR-8/PR-6 machinery is lockset-clean."""
+    from lakesoul_tpu.analysis.rules.lifetime import (
+        RingAliasingRule,
+        ViewEscapesReleaseRule,
+    )
+    from lakesoul_tpu.analysis.rules.races import (
+        RacyCheckThenActRule,
+        SharedStateRaceRule,
+    )
+
+    findings, _ = run(rules=[
+        SharedStateRaceRule(), RacyCheckThenActRule(),
+        ViewEscapesReleaseRule(), RingAliasingRule(),
+    ], baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 def test_hot_path_modules_clean_without_baseline():
     """The three hot-path modules hold under the rule with NO baseline at
     all: every surviving materialization carries an inline pragma whose
@@ -362,8 +506,9 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 19 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 23 and "rbac-gate-reachability" in rule_ids
     assert "pallas-blockspec" in rule_ids
+    assert "shared-state-race" in rule_ids and "view-escapes-release" in rule_ids
     for r in driver["rules"]:
         assert r["shortDescription"]["text"]
     assert len(run_["results"]) == len(findings)

@@ -1,7 +1,8 @@
-"""CI gate: the repo must lint clean — under ALL 19 rules: the 10
+"""CI gate: the repo must lint clean — under ALL 23 rules: the 10
 per-function ones (incl. ad-hoc-retry, wall-clock-lease and
 hot-path-materialize), the 4 interprocedural ones (call graph + dataflow),
-and the 5 device-pack ones (jit/pallas trace safety).
+the 5 device-pack ones (jit/pallas trace safety), and the 4
+concurrency-pack ones (thread-root locksets + buffer lifetimes).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -26,6 +27,9 @@ EXPECTED_RULES = {
     # device pack (jit/pallas trace safety)
     "trace-impure-call", "trace-host-sync", "tpu-dtype-width",
     "jit-static-arg-shape", "pallas-blockspec",
+    # concurrency pack (thread-root locksets + buffer lifetimes)
+    "shared-state-race", "racy-check-then-act",
+    "view-escapes-release", "ring-aliasing",
 }
 
 DEVICE_RULES = {
@@ -33,14 +37,19 @@ DEVICE_RULES = {
     "jit-static-arg-shape", "pallas-blockspec",
 }
 
+CONCURRENCY_RULES = {
+    "shared-state-race", "racy-check-then-act",
+    "view-escapes-release", "ring-aliasing",
+}
 
-def test_all_nineteen_rules_registered():
+
+def test_all_twenty_three_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 19
+    assert len(ids) == len(set(ids)) == 23
     assert set(ids) == EXPECTED_RULES
 
 
@@ -109,4 +118,18 @@ def test_device_pack_clean_repo_wide_without_baseline():
     device = [r for r in all_rules() if r.id in DEVICE_RULES]
     assert len(device) == 5
     findings, _ = run(rules=device, baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_concurrency_pack_clean_repo_wide_without_baseline():
+    """The four concurrency rules hold with NO baseline entries at all —
+    the real shared-state findings this PR surfaced were FIXED (page-cache
+    index under its lock, pipeline thread/queue registries under _lock,
+    heartbeat publishes under a guard), not suppressed."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    conc = [r for r in all_rules() if r.id in CONCURRENCY_RULES]
+    assert len(conc) == 4
+    findings, _ = run(rules=conc, baseline=Baseline([]))
     assert findings == [], "\n".join(f.render() for f in findings)
