@@ -35,7 +35,7 @@ def changed_lines(base: str, root: Path) -> dict[str, set[int]]:
         # diff.mnemonicprefix/diff.noprefix config would change the '+++'
         # prefix and silently empty the changed-line map (a vacuously
         # green strict-on-new-code gate)
-        proc = subprocess.run(
+        proc = subprocess.run(  # lakelint: ignore[raw-process] git CLI is the diff oracle: a bounded, reaped, check=False invocation — not a serving/worker process
             [
                 "git", "-c", "diff.mnemonicprefix=false",
                 "-c", "diff.noprefix=false", "diff", "--no-ext-diff",

@@ -31,6 +31,7 @@ from lakesoul_tpu.analysis.rules.lifetime import (
     ViewEscapesReleaseRule,
 )
 from lakesoul_tpu.analysis.rules.perf import HotPathMaterializeRule
+from lakesoul_tpu.analysis.rules.process import RawProcessRule
 from lakesoul_tpu.analysis.rules.races import (
     RacyCheckThenActRule,
     SharedStateRaceRule,
@@ -69,6 +70,7 @@ def all_rules() -> list[Rule]:
         AdHocRetryRule(),
         WallClockLeaseRule(),
         HotPathMaterializeRule(),
+        RawProcessRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

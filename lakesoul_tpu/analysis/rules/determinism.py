@@ -29,6 +29,10 @@ ORDERED_STAGE_MODULES = (
     "io/merge.py",
     "io/page_cache.py",
     "data/jax_iter.py",
+    # scan-plane producers: spool segments must be byte-identical no matter
+    # which worker produces them, so their code paths stay deterministic
+    "scanplane/worker.py",
+    "scanplane/spool.py",
 )
 
 # random-module calls that draw from the GLOBAL rng; random.Random /

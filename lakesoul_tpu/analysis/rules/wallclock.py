@@ -32,7 +32,7 @@ from typing import Iterable
 
 from lakesoul_tpu.analysis.engine import Finding, Module, Rule, dotted_name
 
-SCOPE = ("service/", "compaction/", "meta/")
+SCOPE = ("service/", "compaction/", "meta/", "scanplane/")
 
 _KEYWORDS = ("ttl", "deadline", "lease", "expire", "expiry", "timeout")
 

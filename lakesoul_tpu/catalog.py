@@ -685,6 +685,10 @@ class LakeSoulScan:
         self._vector_search: tuple | None = None
         self._cache = False
         self._limit: int | None = None
+        # batch-source seam (data/batch_source.py): None = decode in this
+        # process; a factory (scan → source) = remote delivery, e.g. a
+        # scan-plane fleet via via_scanplane()
+        self._batch_source_factory = None
 
     def _replace(self, **kw) -> "LakeSoulScan":
         s = copy.copy(self)
@@ -756,6 +760,24 @@ class LakeSoulScan:
         entirely.  The cache key includes the partition version digest, so
         any commit to the table invalidates it automatically."""
         return self._replace(_cache=True)
+
+    def via_scanplane(self, target, **client_kwargs) -> "LakeSoulScan":
+        """Source this scan's batches from a scan-plane gateway instead of
+        decoding in-process: ``target`` is a gateway location
+        (``grpc://host:port``) or an existing
+        :class:`~lakesoul_tpu.scanplane.client.ScanPlaneClient`.  Chainable
+        like every builder method; every consumer downstream —
+        ``to_batches``/``to_jax_iter``/``to_torch``/ray — then streams
+        from the fleet with byte-identical results (``device_put``,
+        collate, and loader stats stay client-side)."""
+        from lakesoul_tpu.scanplane.client import ScanPlaneClient
+
+        client = (
+            target
+            if isinstance(target, ScanPlaneClient)
+            else ScanPlaneClient(target, **client_kwargs)
+        )
+        return self._replace(_batch_source_factory=client.source)
 
     def _cache_key(self) -> tuple:
         info = self._table.info
@@ -968,18 +990,24 @@ class LakeSoulScan:
             storage_options=self._table.catalog.storage_options,
         )
 
-    def _projected_empty_table(self) -> pa.Table:
+    def projected_schema(self) -> pa.Schema:
+        """The Arrow schema this scan's batches carry (projection applied)
+        — THE one definition, shared by local delivery and the scan
+        plane's spool writer + gateway stream so they can never drift."""
         base = self._table.info.arrow_schema
         if self._columns is not None:
-            base = pa.schema([base.field(c) for c in self._columns])
-        return base.empty_table()
+            return pa.schema([base.field(c) for c in self._columns])
+        return base
+
+    def _projected_empty_table(self) -> pa.Table:
+        return self.projected_schema().empty_table()
 
     def to_arrow(self, *, parallel: bool | None = None) -> pa.Table:
         """Materialize the scan.  ``parallel=None`` (auto) decodes scan
         units concurrently on the shared runtime pool when there is more
         than one; unit order is preserved, so the result is byte-identical
         to ``parallel=False``."""
-        if self._limit is not None:
+        if self._limit is not None or self._batch_source_factory is not None:
             batches = list(self.to_batches())
             if batches:
                 return pa.Table.from_batches(batches)
@@ -1031,6 +1059,13 @@ class LakeSoulScan:
         (no filter/vector search/limit, unit needs no PK merge: the same
         conditions as the count_rows shortcut); the residual lands inside one
         unit and only that prefix is decoded and discarded."""
+        if self._batch_source_factory is not None:
+            # remote delivery (via_scanplane): the source owns limit/skip
+            # semantics and yields the byte-identical stream
+            yield from self._batch_source_factory(self).iter_batches(
+                num_threads=num_threads, skip_rows=skip_rows
+            )
+            return
         if skip_rows:
             skip = skip_rows
             fast_ok = (

@@ -429,6 +429,10 @@ class JaxBatchIterator:
         drop_remainder: drop the final short batch (jit-friendly default True).
         io_threads: decode scan units on this many threads (multi-core hosts;
             see LakeSoulScan.to_batches).
+        consumer: attribution tag for this loader's ``queue`` stall series
+            (``lakesoul_scan_stage_seconds{stage=queue,consumer=...}``) —
+            with several concurrent loaders (a trainer fleet on one host)
+            the tag says WHICH client starved.  Default ``"local"``.
         cache: ``"device"`` pins every delivered batch in device memory on the
             first complete epoch; re-iterating then replays the resident
             batches with ZERO storage/host/link traffic (the tf.data
@@ -452,6 +456,7 @@ class JaxBatchIterator:
         io_threads: int | None = None,
         checkpoint: "LoaderCheckpoint | None" = None,
         cache: str | None = None,
+        consumer: str | None = None,
     ):
         from lakesoul_tpu.errors import ConfigError
 
@@ -487,10 +492,12 @@ class JaxBatchIterator:
             self._ring = _BufferRing(
                 max(1, prefetch) + max(1, device_prefetch) + 2
             )
-        # stage-attribution handles, fetched once (the obs hot-path contract)
+        # stage-attribution handles, fetched once (the obs hot-path
+        # contract); the queue series carries this loader's consumer tag so
+        # multi-client stall is attributable per client
         self._h_rebatch = stage_histogram("rebatch")
         self._h_collate = stage_histogram("collate")
-        self._h_queue = stage_histogram("queue")
+        self._h_queue = stage_histogram("queue", consumer=consumer or "local")
         self._h_device_put = stage_histogram("device_put")
         self._transform = transform
         self._device_put = device_put
@@ -536,7 +543,12 @@ class JaxBatchIterator:
             capture_views=self._collate is _default_collate,
         )
         h = self._h_rebatch
-        for arrow_batch in self._scan.to_batches(
+        # the batch-source seam: in-process decode OR a scan-plane fleet
+        # (scan.via_scanplane) — everything downstream (rebatch, collate,
+        # prefetch, device_put, stats) is identical either way
+        from lakesoul_tpu.data.batch_source import batch_source_for
+
+        for arrow_batch in batch_source_for(self._scan).iter_batches(
             num_threads=self._io_threads, skip_rows=skip
         ):
             t0 = time.perf_counter()

@@ -17,11 +17,34 @@ from __future__ import annotations
 
 
 def read_lakesoul(scan):
-    """LakeSoulScan → ray.data.Dataset (one read task per scan unit)."""
+    """LakeSoulScan → ray.data.Dataset: one read task per scan unit
+    (in-process scans) or per scan-plane range (``scan.via_scanplane``
+    scans, where tasks pull from the fleet's gateway instead of decoding —
+    the same batch-source seam every adapter rides)."""
     try:
         import ray
     except ImportError as e:  # pragma: no cover - ray not in the TPU image
         raise ImportError("ray is required for read_lakesoul") from e
+
+    from lakesoul_tpu.data.batch_source import batch_source_for
+
+    source = batch_source_for(scan)
+    if getattr(source, "remote", False):
+        payload = source.task_payload()
+        items = [
+            {"unit": {"scanplane": payload, "seq_index": i}}
+            for i in range(source.num_task_ranges())
+        ]
+
+        def load_remote(df):
+            unit = dict(df["unit"].iloc[0])
+            from lakesoul_tpu.scanplane.client import read_task_range
+
+            return read_task_range(unit["scanplane"], unit["seq_index"])
+
+        return ray.data.from_items(items).map_batches(
+            load_remote, batch_size=1, batch_format="pandas"
+        )
 
     units = [
         {

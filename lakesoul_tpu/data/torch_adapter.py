@@ -1,4 +1,9 @@
-"""PyTorch adapter (parity with python/src/lakesoul/torch/dataset.py:15)."""
+"""PyTorch adapter (parity with python/src/lakesoul/torch/dataset.py:15).
+
+Batches come through the batch-source seam
+(:mod:`lakesoul_tpu.data.batch_source`), so a scan bound to a scan-plane
+fleet (``scan.via_scanplane(...)``) streams remotely with the same
+iterator contract — the torch side never knows who decoded."""
 
 from __future__ import annotations
 
@@ -23,6 +28,8 @@ class TorchIterableDataset:
                 self._scan = scan
 
             def __iter__(self):
-                yield from self._scan.to_batches()
+                from lakesoul_tpu.data.batch_source import batch_source_for
+
+                yield from batch_source_for(self._scan).iter_batches()
 
         return _DS(scan)

@@ -27,7 +27,7 @@ def _build() -> bool:
         # no -march=native: the .so may travel with the package tree to a
         # different CPU (container image, shared venv) where native ISA
         # extensions would SIGILL; these kernels vectorize fine at -O3
-        subprocess.run(
+        subprocess.run(  # lakelint: ignore[raw-process] one-shot compiler invocation at import bootstrap (timeout-bounded, reaped); not a managed service process
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
              _SRC, "-o", _LIB_PATH],
             check=True,

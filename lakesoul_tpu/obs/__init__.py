@@ -20,8 +20,10 @@ from lakesoul_tpu.obs.exporter import serve_prometheus
 from lakesoul_tpu.obs.logging import JsonLogFormatter, configure_logging
 from lakesoul_tpu.obs.stages import (
     SCAN_STAGES,
+    queue_seconds_by_consumer,
     stage_counts,
     stage_histogram,
+    stage_merge,
     stage_observe,
     stage_seconds,
 )
@@ -61,8 +63,10 @@ __all__ = [
     "configure_logging",
     "serve_prometheus",
     "SCAN_STAGES",
+    "queue_seconds_by_consumer",
     "stage_counts",
     "stage_histogram",
+    "stage_merge",
     "stage_observe",
     "stage_seconds",
 ]

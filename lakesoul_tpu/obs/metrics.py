@@ -143,6 +143,20 @@ class Histogram:
             self._sum += v
             self._count += 1
 
+    def merge(self, total_s: float, count: int) -> None:
+        """Fold an EXTERNAL (sum, count) delta into this histogram — the
+        cross-process transport for stage attribution (a scanplane worker's
+        per-range timings riding into the client's registry).  Sum and count
+        stay exact; bucket placement is approximated at the delta's mean
+        (the remote process only ships aggregates, not raw observations)."""
+        if count <= 0:
+            return
+        idx = bisect.bisect_left(self.bounds, total_s / count)
+        with self._lock:
+            self._counts[idx] += count
+            self._sum += total_s
+            self._count += count
+
     @property
     def value(self) -> dict:
         with self._lock:
@@ -233,6 +247,20 @@ class MetricsRegistry:
         with self._lock:
             if fn not in self._collectors:
                 self._collectors.append(fn)
+
+    def series(self, name: str) -> list[tuple[dict, "Counter | Gauge | Histogram"]]:
+        """Every registered series of one metric family, as
+        ``(labels_dict, metric)`` pairs — the aggregation hook for families
+        that fan out over labels (e.g. ``lakesoul_scan_stage_seconds`` with
+        per-consumer ``queue`` series): callers sum across the returned
+        metrics instead of reaching into the registry's internals."""
+        with self._lock:
+            items = [
+                (dict(labels), m)
+                for (n, labels), m in self._metrics.items()
+                if n == name
+            ]
+        return items
 
     # ------------------------------------------------------------ exposition
     def _collected(self) -> list[tuple[str, str, float, dict]]:

@@ -20,6 +20,22 @@ On a compacted no-PK table the contract is DEGENERACY: ``merge`` and
 ``fill`` must report ~0 — the scan is a plain decode plan.  The
 ``scan_stages`` micro-benchmark leg enforces that as a budget.
 
+Two label dimensions beyond ``stage``:
+
+- ``consumer=`` on the ``queue`` stage: with several concurrent loaders in
+  one process (a trainer fleet on one host, the scanplane bench's client
+  swarm) an unlabeled stall histogram cannot say WHICH client starved —
+  every loader tags its queue series (default ``local``).
+- ``worker=`` on producer stages merged from another process: a scanplane
+  worker ships its per-range (sum, count) deltas with each spooled range
+  and the client folds them into its own registry via :func:`stage_merge`,
+  so one snapshot shows remote decode/merge next to local collate/queue.
+
+Aggregation helpers (:func:`stage_seconds` / :func:`stage_counts`) sum
+across ALL series of a stage regardless of extra labels — the degeneracy
+budgets and bench breakdowns see one number per stage, the labeled series
+stay queryable for attribution.
+
 Handles are memoized module-level (the registry is a process singleton);
 hot loops fetch a histogram once and pay only ``observe``.
 """
@@ -32,27 +48,66 @@ SCAN_STAGES = (
     "decode", "merge", "fill", "rebatch", "collate", "queue", "device_put",
 )
 
-_handles: dict[str, Histogram] = {}
+STAGE_FAMILY = "lakesoul_scan_stage_seconds"
+
+_handles: dict[tuple, Histogram] = {}
 
 
-def stage_histogram(stage: str) -> Histogram:
-    """The ``lakesoul_scan_stage_seconds`` histogram for one stage."""
-    h = _handles.get(stage)
+def stage_histogram(stage: str, **labels: str) -> Histogram:
+    """The ``lakesoul_scan_stage_seconds`` histogram for one stage (plus
+    optional attribution labels, e.g. ``consumer=`` for queue stalls or
+    ``worker=`` for merged remote stages)."""
+    key = (stage, tuple(sorted(labels.items())))
+    h = _handles.get(key)
     if h is None:
-        h = registry().histogram("lakesoul_scan_stage_seconds", stage=stage)
-        _handles[stage] = h
+        h = registry().histogram(STAGE_FAMILY, stage=stage, **labels)
+        _handles[key] = h
     return h
 
 
-def stage_observe(stage: str, seconds: float) -> None:
-    stage_histogram(stage).observe(seconds)
+def stage_observe(stage: str, seconds: float, **labels: str) -> None:
+    stage_histogram(stage, **labels).observe(seconds)
+
+
+def stage_merge(stage: str, seconds: float, count: int, **labels: str) -> None:
+    """Fold a cross-process (sum, count) stage delta into this process's
+    registry — how a scanplane worker's decode/merge/fill time travels with
+    its spooled ranges into the consuming client's snapshot."""
+    stage_histogram(stage, **labels).merge(seconds, count)
+
+
+def _family_series() -> list[tuple[dict, Histogram]]:
+    return registry().series(STAGE_FAMILY)
 
 
 def stage_seconds() -> dict[str, float]:
-    """Cumulative seconds per stage since process start (bench/test helper;
-    subtract two snapshots for a leg delta)."""
-    return {s: stage_histogram(s).value["sum"] for s in SCAN_STAGES}
+    """Cumulative seconds per stage since process start, summed across all
+    labeled series of each stage (bench/test helper; subtract two snapshots
+    for a leg delta)."""
+    out = {s: 0.0 for s in SCAN_STAGES}
+    for labels, h in _family_series():
+        stage = labels.get("stage")
+        if stage in out:
+            out[stage] += h.value["sum"]
+    return out
 
 
 def stage_counts() -> dict[str, int]:
-    return {s: stage_histogram(s).value["count"] for s in SCAN_STAGES}
+    out = {s: 0 for s in SCAN_STAGES}
+    for labels, h in _family_series():
+        stage = labels.get("stage")
+        if stage in out:
+            out[stage] += h.value["count"]
+    return out
+
+
+def queue_seconds_by_consumer() -> dict[str, float]:
+    """Per-consumer queue-stall split (the multi-client attribution view):
+    ``{consumer: seconds}`` across every tagged queue series."""
+    out: dict[str, float] = {}
+    for labels, h in _family_series():
+        if labels.get("stage") != "queue":
+            continue
+        consumer = labels.get("consumer", "local")
+        out[consumer] = out.get(consumer, 0.0) + h.value["sum"]
+    return out

@@ -1,0 +1,44 @@
+"""Disaggregated scan plane: one table feeding a fleet of trainers.
+
+The single-process data path terminates in the process that decodes it;
+this package scales the scan OUT (ROADMAP item 3; the reference's L6
+Flight gateway role; Deep Lake's streaming dataloader, arxiv 2209.10785):
+
+- **Sessions** (:mod:`.session`): a scan request + the pinned plan, split
+  into deterministic *ranges* (one per scan unit, in plan order) and
+  published as a manifest every process can read.
+- **Workers** (:mod:`.worker`): separate OS processes that lease ranges
+  through the PR-7 lease table (fencing tokens, TTL heartbeat), decode +
+  MOR-merge them through the normal scan path, and publish each range as
+  an Arrow IPC *spool segment* (atomic rename) with a sidecar carrying
+  rows and per-stage timings.  SIGKILL a worker: its leases expire within
+  one TTL and a peer re-produces the ranges — byte-identical, because the
+  scan path is deterministic.
+- **Delivery** (:mod:`.delivery` + the ``scan_stream`` DoExchange verb in
+  :mod:`lakesoul_tpu.service.flight`): trainer clients stream their rank's
+  ranges over Flight, admission-gated and RBAC-checked like every other
+  verb; same-host clients negotiate the shared-memory fast path and read
+  the spool segments zero-copy (``pa.memory_map``) — only control messages
+  cross the socket.
+- **Clients** (:mod:`.client`): :class:`~.client.ScanPlaneClient` is a
+  drop-in batch source for ``scan.to_jax_iter()`` / the torch and ray
+  adapters (``scan.via_scanplane(...)``), with mid-stream reconnect resume
+  (exactly-once delivery across worker deaths and socket errors) and the
+  workers' stage timings merged into the local registry snapshot.
+- **Service** (:mod:`.service`, ``python -m lakesoul_tpu.scanplane``): the
+  deployable process — a Flight gateway plus N worker child processes —
+  mirroring the compaction service entry.
+"""
+
+from lakesoul_tpu.scanplane.client import ScanPlaneClient
+from lakesoul_tpu.scanplane.delivery import ScanPlaneDelivery
+from lakesoul_tpu.scanplane.session import ScanSession, session_request_from_scan
+from lakesoul_tpu.scanplane.worker import ScanPlaneWorker
+
+__all__ = [
+    "ScanPlaneClient",
+    "ScanPlaneDelivery",
+    "ScanPlaneWorker",
+    "ScanSession",
+    "session_request_from_scan",
+]

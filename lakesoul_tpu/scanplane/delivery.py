@@ -1,0 +1,281 @@
+"""Server-side scan-plane delivery: the ``scan_stream`` DoExchange verb.
+
+The Flight gateway parses + RBAC-checks + admission-gates the exchange
+(:meth:`LakeSoulFlightServer.do_exchange`) and hands the stream here.  Two
+modes, one wire protocol:
+
+- **spool mode** (a spool directory is configured): the delivery head
+  publishes the session manifest (idempotent) and serves each of the
+  client's ranges as soon as a worker spools it — batches over the socket,
+  or, when the client proves it can read the spool (same host / shared
+  tmpfs), a metadata-only message carrying the segment path: the client
+  maps it zero-copy and the hot queue stage never touches the socket.
+- **inline mode** (no spool): the gateway decodes ranges itself through
+  the normal scan path — the degraded single-process shape, so a plain
+  gateway serves remote scans for every adapter with zero fleet setup.
+
+Wire protocol (all metadata is JSON):
+
+==============  ==========================================================
+``hello`` →     ``{kind, session, nranges, shm: {probe, token} | null}``
+← ``mode``      ``{kind, shm: bool}`` — client ALWAYS answers (symmetric
+                read, no sniffing); truthy only after the probe verified
+``range`` →     ``{kind, range, rows, batches, worker?, fence?, stages?,
+                path?}`` — ``path`` present = shm fast path, no data
+                messages follow for this range; absent = the range's
+                record batches follow on the data plane
+``end`` →       ``{kind, ranges}``
+==============  ==========================================================
+
+Resume contract: ``start_range`` (position in the CLIENT's range
+sequence) and ``start_batch`` (batches already delivered within that
+range) — deterministic production makes redelivery byte-identical, so a
+reconnecting client skips exactly what it already consumed and the stream
+stays exactly-once end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import uuid
+
+from lakesoul_tpu.runtime.resilience import _env_float
+from lakesoul_tpu.scanplane import session as sess
+from lakesoul_tpu.scanplane import spool
+
+logger = logging.getLogger(__name__)
+
+ENV_WAIT_S = "LAKESOUL_SCANPLANE_WAIT_S"
+ENV_SHM = "LAKESOUL_SCANPLANE_SHM"
+
+
+def _shm_enabled() -> bool:
+    return os.environ.get(ENV_SHM, "1") != "0"
+
+
+class ScanPlaneDelivery:
+    """One per gateway; stateless between exchanges except the spool."""
+
+    def __init__(
+        self,
+        catalog,
+        spool_dir: str | None = None,
+        *,
+        wait_s: float | None = None,
+        offer_shm: bool | None = None,
+    ):
+        self.catalog = catalog
+        self.spool_dir = spool_dir
+        self.wait_s = _env_float(ENV_WAIT_S, 120.0) if wait_s is None else float(wait_s)
+        self.offer_shm = (
+            (_shm_enabled() and spool_dir is not None)
+            if offer_shm is None
+            else bool(offer_shm)
+        )
+
+    # ------------------------------------------------------------- sessions
+    def resolve_session(self, request: dict) -> sess.ScanSession:
+        from lakesoul_tpu.errors import LakeSoulError
+
+        # a reconnecting client PINS its session: resuming by position is
+        # only exactly-once against the SAME plan, so a pin that no longer
+        # resolves (table advanced, spool pruned) must fail the stream
+        # loudly instead of silently serving a different plan's rows
+        pinned = request.get("session")
+        if self.spool_dir is not None:
+            if pinned:
+                existing = sess.ScanSession.load(self.spool_dir, pinned)
+                if existing is None:
+                    raise LakeSoulError(
+                        f"scanplane session {pinned} no longer exists (the"
+                        " table advanced or the spool was pruned); restart"
+                        " the scan"
+                    )
+                sess.touch_session(self.spool_dir, pinned)
+                return existing
+            # manifest-first: locating a session costs one partition-head
+            # query; the full scan plan is only paid by the FIRST exchange
+            # of a session, not by every client/reconnect
+            _, _, sid = sess.ScanSession.locate(self.catalog, request)
+            existing = sess.ScanSession.load(self.spool_dir, sid)
+            if existing is not None:
+                sess.touch_session(self.spool_dir, sid)
+                return existing
+            session = sess.ScanSession.plan(self.catalog, request)
+            session.publish(self.spool_dir)
+            return session
+        session = sess.ScanSession.plan(self.catalog, request)
+        if pinned and session.session_id != pinned:
+            raise LakeSoulError(
+                f"scanplane session {pinned} no longer matches the table"
+                " state (a commit landed mid-stream); restart the scan"
+            )
+        return session
+
+    # ------------------------------------------------------------- exchange
+    def handle_scan_stream(self, request: dict, reader, writer, *, metrics=None) -> dict:
+        """Serve one client's exchange; returns {rows, ranges} totals."""
+        session = self.resolve_session(request)
+        rank = request.get("rank")
+        world = request.get("world")
+        indices = session.client_ranges(rank, world)
+        start_range = max(0, int(request.get("start_range") or 0))
+        start_batch = max(0, int(request.get("start_batch") or 0))
+        pending = indices[start_range:]
+        if request.get("max_ranges") is not None:
+            # a bounded slice of the client's sequence — the per-task unit
+            # distributed adapters (ray) fan out over
+            pending = pending[: max(0, int(request["max_ranges"]))]
+
+        shm_offer = None
+        if self.offer_shm and self.spool_dir is not None:
+            # the probe is the manifest itself: a client that can read it
+            # and echo the token shares our filesystem, so segment paths
+            # resolve on its side too
+            shm_offer = {
+                "probe": os.path.join(
+                    session.dir(self.spool_dir), sess.MANIFEST_NAME
+                ),
+                "token": session.session_id,
+            }
+        writer.write_metadata(json.dumps({
+            "kind": "hello",
+            "session": session.session_id,
+            "nranges": len(indices),
+            "version_digest": session.version_digest,
+            "shm": shm_offer,
+        }).encode())
+
+        # symmetric negotiation: the client always answers with its mode
+        chunk = reader.read_chunk()
+        mode = {}
+        if chunk.app_metadata is not None:
+            mode = json.loads(chunk.app_metadata.to_pybytes().decode())
+        use_shm = bool(mode.get("shm")) and shm_offer is not None
+
+        scan = sess.scan_for_request(self.catalog, session.request)
+        writer.begin(sess.projected_schema(scan))
+
+        rows_total = 0
+        served = 0
+        for seq, index in enumerate(pending):
+            skip = start_batch if seq == 0 else 0
+            if self.spool_dir is not None:
+                rows_total += self._serve_spooled(
+                    session, index, skip, use_shm, writer, metrics
+                )
+            else:
+                rows_total += self._serve_inline(
+                    scan, session, index, skip, writer, metrics
+                )
+            served += 1
+        writer.write_metadata(json.dumps({
+            "kind": "end", "ranges": served,
+        }).encode())
+        return {"rows": rows_total, "ranges": served}
+
+    # ---------------------------------------------------------- spool mode
+    def _wait_ready(self, sdir: str, index: int) -> None:
+        deadline = time.monotonic() + self.wait_s
+        delay = 0.002
+        while not spool.range_ready(sdir, index):
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"range {index} not produced within {self.wait_s:.0f}s —"
+                    " are scanplane workers running against this spool?"
+                )
+            time.sleep(delay)
+            # cap the poll low: this wait sits on the client's critical
+            # path once per range, and a produced range is typically only
+            # milliseconds away (tmpfs rename)
+            delay = min(delay * 1.5, 0.02)
+
+    def _serve_spooled(self, session, index, skip, use_shm, writer, metrics) -> int:
+        sdir = session.dir(self.spool_dir)
+        self._wait_ready(sdir, index)
+        # a stream can outlive the session TTL (slow trainer, huge shard):
+        # every served range freshens the manifest so the pruner never
+        # sweeps a session mid-delivery
+        sess.touch_session(self.spool_dir, session.session_id)
+        sidecar = spool.read_sidecar(sdir, index)
+        meta = {
+            "kind": "range",
+            "range": index,
+            "rows": sidecar.get("rows", 0),
+            "batches": sidecar.get("batches", 0),
+            "worker": sidecar.get("worker"),
+            "fence": sidecar.get("fence"),
+            "stages": sidecar.get("stages") or {},
+        }
+        if use_shm:
+            meta["path"] = spool.segment_path(sdir, index)
+            writer.write_metadata(json.dumps(meta).encode())
+            rows = int(sidecar.get("rows", 0))
+            if skip:
+                # a resumed range: the client maps the segment and skips
+                # locally, so meter only what it will actually consume —
+                # sidecar batch_rows keeps this JSON arithmetic (older
+                # sidecars without it fall back to a zero-copy peek)
+                per_batch = sidecar.get("batch_rows")
+                if per_batch is None:
+                    _, segs = spool.read_range(sdir, index)
+                    per_batch = [b.num_rows for b in segs]
+                rows = max(0, rows - sum(per_batch[:skip]))
+            if metrics is not None:
+                metrics.add(rows_out=rows)
+            return rows
+        writer.write_metadata(json.dumps(meta).encode())
+        _, batches = spool.read_range(sdir, index)
+        rows = 0
+        for b in batches[skip:]:
+            writer.write_batch(b)
+            rows += b.num_rows
+        if metrics is not None:
+            metrics.add(rows_out=rows)
+        return rows
+
+    # --------------------------------------------------------- inline mode
+    def _serve_inline(self, scan, session, index, skip, writer, metrics) -> int:
+        unit = session.ranges[index]
+        writer.write_metadata(json.dumps({
+            "kind": "range", "range": index, "stages": {},
+        }).encode())
+        rows = 0
+        for i, batch in enumerate(sess.iter_range_batches(scan, unit)):
+            if i < skip:
+                continue
+            writer.write_batch(batch)
+            rows += batch.num_rows
+        if metrics is not None:
+            metrics.add(rows_out=rows)
+        return rows
+
+
+def default_spool_dir() -> str:
+    """A fresh spool location: tmpfs when available (the shared-memory
+    fast path is then literal shared memory), else the system tempdir."""
+    import tempfile
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK) else None
+    return tempfile.mkdtemp(prefix="lakesoul-scanplane-", dir=base)
+
+
+def probe_matches(offer: dict | None) -> bool:
+    """Client-side shm probe: can we read the server's manifest and does
+    it carry the session token?  Proves a shared filesystem (same host or
+    shared tmpfs mount) before trusting segment paths."""
+    if not offer:
+        return False
+    try:
+        with open(offer["probe"]) as f:
+            manifest = json.loads(f.read())
+        return manifest.get("session") == offer.get("token")
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def new_exchange_id() -> str:
+    return uuid.uuid4().hex[:12]

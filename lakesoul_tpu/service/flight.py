@@ -178,8 +178,14 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         jwt_secret: str | None = None,
         max_inflight: int | None = None,
         max_queue: int | None = None,
+        scanplane=None,
     ):
         self.catalog = catalog
+        # scan-plane delivery (DoExchange "scan_stream"): a configured
+        # ScanPlaneDelivery serves worker-produced spool segments (with the
+        # same-host shm fast path); None = lazily-built inline delivery, so
+        # a plain gateway still serves remote scans with zero fleet setup
+        self.scanplane = scanplane
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
@@ -363,11 +369,8 @@ class LakeSoulFlightServer(flight.FlightServerBase):
                 if slot is not None:
                     slot.release()
 
-        # stream lazily with the table schema (projection-aware)
-        out_schema = table.schema
-        if req.get("columns"):
-            out_schema = pa.schema([out_schema.field(c) for c in req["columns"]])
-        stream = flight.GeneratorStream(out_schema, gen())
+        # stream lazily with the scan's projected schema
+        stream = flight.GeneratorStream(scan.projected_schema(), gen())
         if slot is not None:
             slot.transfer()
         return stream
@@ -423,6 +426,55 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             raise flight.FlightServerError(str(e))
         finally:
             self.metrics.add(active_put_streams=-1)
+
+    # ------------------------------------------------------------ DoExchange
+    def do_exchange(self, context, descriptor, reader, writer):
+        """Bidirectional scan-plane delivery (verb ``scan_stream``): the
+        whole exchange runs inside the handler, so the plain admission
+        gate bounds concurrent exchanges end to end (no slot transfer —
+        unlike do_get there is no lazy stream outliving the call)."""
+        with self._admitted():
+            return self._do_exchange(context, descriptor, reader, writer)
+
+    def _do_exchange(self, context, descriptor, reader, writer):
+        """Ungated handler body — subclasses override THIS (single gate at
+        the public entry, same contract as _do_get/_do_put/_do_action)."""
+        with self._span(context, "flight.do_exchange"):
+            return self._do_exchange_json(context, descriptor, reader, writer)
+
+    def _do_exchange_json(self, context, descriptor, reader, writer):
+        try:
+            req = json.loads(descriptor.command.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise flight.FlightServerError(f"bad exchange descriptor: {e}")
+        verb = req.get("verb")
+        if verb != "scan_stream":
+            raise flight.FlightServerError(f"unknown exchange verb {verb!r}")
+        ns = req.get("namespace", "default")
+        name = req.get("table")
+        if not name:
+            raise flight.FlightServerError("scan_stream needs a table")
+        # same per-table RBAC as do_get: the exchange streams table data
+        self._check(context, ns, name)
+        delivery = self.scanplane
+        if delivery is None:
+            from lakesoul_tpu.scanplane.delivery import ScanPlaneDelivery
+
+            delivery = self.scanplane = ScanPlaneDelivery(self.catalog)
+        from lakesoul_tpu.errors import TransientError
+
+        try:
+            delivery.handle_scan_stream(
+                req, reader, writer, metrics=self.metrics
+            )
+        except TransientError as e:
+            # e.g. the session plan racing a writer burst: retryable —
+            # clients back off and reconnect like an admission shed
+            raise flight.FlightUnavailableError(str(e)) from e
+        except LakeSoulError as e:
+            raise flight.FlightServerError(str(e))
+        except TimeoutError as e:
+            raise flight.FlightServerError(str(e))
 
     # --------------------------------------------------------------- actions
     def do_action(self, context, action):
@@ -633,6 +685,12 @@ class LakeSoulFlightClient:
     def action(self, name: str, body: dict | None = None) -> list:
         action = flight.Action(name, json.dumps(body or {}).encode())
         return [r.body.to_pybytes() for r in self._client.do_action(action, options=self._options)]
+
+    def exchange(self, descriptor):
+        """Open a DoExchange under this client's auth/trace headers
+        (the scan-plane client drives the ``scan_stream`` protocol on the
+        returned writer/reader pair)."""
+        return self._client.do_exchange(descriptor, options=self._options)
 
     def list_tables(self) -> list[str]:
         return [

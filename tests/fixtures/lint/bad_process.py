@@ -1,0 +1,69 @@
+"""Seeded raw-process violations: the ad-hoc process/socket shapes the
+scan-plane topology layer (scanplane/, runtime/, the sanctioned serving
+entries) exists to replace — unsupervised subprocess children, a
+multiprocessing pool outside the runtime, and a raw HTTP serving socket
+with no admission control or RBAC."""
+
+import multiprocessing  # SEED: raw-process (multiprocessing import)
+import subprocess
+from subprocess import Popen  # imported name tracked, flagged at the call
+
+
+def spawn_unsupervised_child(cmd):
+    return subprocess.Popen(cmd)  # SEED: raw-process (subprocess.Popen)
+
+
+def shell_out(cmd):
+    return subprocess.run(cmd, capture_output=True)  # SEED: raw-process (subprocess.run)
+
+
+def from_imported_popen(cmd):
+    return Popen(cmd)  # SEED: raw-process (from-imported Popen)
+
+
+def handrolled_pool(n, fn, items):
+    with multiprocessing.Pool(n) as pool:  # SEED: raw-process (multiprocessing.Pool)
+        return pool.map(fn, items)
+
+
+def fork_by_hand():
+    import os
+
+    pid = os.fork()  # SEED: raw-process (os.fork)
+    return pid
+
+
+def raw_http_server(handler):
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)  # SEED: raw-process (raw socket server)
+    return srv
+
+
+def raw_socket_listener():
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # SEED: raw-process (raw socket server)
+    s.bind(("127.0.0.1", 0))
+    s.listen(16)
+    return s
+
+
+def client_socket_is_fine(host):
+    # connect-and-talk sockets never listen: not a serving surface
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect((host, 443))
+    return s
+
+
+def allowed_with_pragma(cmd):
+    # a justified one-shot invocation stays legal when it names why
+    return subprocess.run(cmd)  # lakelint: ignore[raw-process] fixture: demonstrates the pragma escape hatch
+
+
+def not_a_process(items):
+    # plain calls that merely LOOK process-shaped stay legal
+    run = items.run if hasattr(items, "run") else None
+    return run

@@ -117,6 +117,42 @@ def test_wall_clock_lease_rule_line_exact():
     assert lint_fixture("bad_wallclock.py") == []
 
 
+def test_raw_process_rule_line_exact():
+    """The 24th rule: ad-hoc subprocess spawning (dotted and from-imported),
+    multiprocessing (import and calls), os.fork, and raw socket-server
+    construction are flagged line-exactly; the pragma escape hatch and
+    merely process-shaped attribute names stay silent."""
+    found = [f for f in lint_fixture("bad_process.py") if f.rule == "raw-process"]
+    assert len(found) == 8, found
+    assert_seed_lines(found, "bad_process.py", "raw-process")
+    messages = " ".join(f.message for f in found)
+    assert "unsupervised child process" in messages
+    assert "multiprocessing" in messages
+    assert "raw serving socket" in messages
+
+
+def test_raw_process_allows_topology_layers(tmp_path):
+    """The same shapes inside scanplane//runtime/ (and the sanctioned
+    serving entries) are the POINT of those layers — the rule keys on the
+    module path, so the real package lints clean (test_analysis_clean)
+    while the fixture catches every seeded site."""
+    from lakesoul_tpu.analysis.rules.process import RawProcessRule
+
+    rule = RawProcessRule()
+    src = (LINT / "bad_process.py").read_text()
+    for rel in (
+        "lakesoul_tpu/scanplane/service.py",
+        "lakesoul_tpu/runtime/pool.py",
+        "lakesoul_tpu/obs/exporter.py",
+        "lakesoul_tpu/service/storage_proxy.py",
+    ):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        mod = Module.load(p, tmp_path)
+        assert list(rule.check(mod)) == [], rel
+
+
 def test_hot_path_materialize_rule_line_exact():
     """The 19th rule: concat_tables / .combine_chunks() / .to_pandas() in
     the scan/loader hot-path modules are flagged line-exactly; zero-copy
@@ -506,7 +542,8 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 23 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 24 and "rbac-gate-reachability" in rule_ids
+    assert "raw-process" in rule_ids
     assert "pallas-blockspec" in rule_ids
     assert "shared-state-race" in rule_ids and "view-escapes-release" in rule_ids
     for r in driver["rules"]:

@@ -1,0 +1,200 @@
+"""Process-level chaos for the scan plane (slow tier; the quick smoke of
+the same entries runs in test_scanplane.py::TestServiceEntrySmoke).
+
+The acceptance contract, proven with real OS processes sharing one
+warehouse + spool:
+
+- SIGKILL a scan-plane worker that is mid-range and HOLDING its lease →
+  a peer worker takes the range over within one lease TTL, and a fleet of
+  concurrent trainer clients completes with **exactly-once** delivery:
+  every client's stream is byte-identical to the single-process
+  ``scan.shard(rank, world)`` scan — no duplicate, no missing batches.
+- The killed child is the REAL worker entry point
+  (``python -m lakesoul_tpu.scanplane worker``), the same process the
+  service role spawns — what is tested is what deploys."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.scanplane import spool as spool_mod
+from lakesoul_tpu.scanplane.client import ScanPlaneClient
+from lakesoul_tpu.scanplane.delivery import ScanPlaneDelivery
+from lakesoul_tpu.scanplane.session import ScanSession
+from lakesoul_tpu.service.flight import LakeSoulFlightServer
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("p", pa.string())])
+TTL_S = 2.0
+N_CLIENTS = 8
+
+pytestmark = pytest.mark.slow
+
+
+def _child_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "LAKESOUL_RETRY_SEED": "7",
+    })
+    env.update(extra)
+    return env
+
+
+def _spawn_worker(wh, db, spool, *, worker_id, **extra_env) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "lakesoul_tpu.scanplane", "worker",
+            "--warehouse", wh, "--db-path", db, "--spool", spool,
+            "--lease-ttl-s", str(TTL_S), "--poll-s", "0.05",
+            "--worker-id", worker_id,
+        ],
+        env=_child_env(**extra_env),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO,
+    )
+
+
+class TestSigkillWorkerTakeover:
+    def test_peer_takes_over_leased_range_exactly_once_delivery(self, tmp_path):
+        wh, db = str(tmp_path / "wh"), str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table(
+            "t", SCHEMA, primary_keys=["id"], range_partitions=["p"],
+            hash_bucket_num=2,
+        )
+        rng = np.random.default_rng(3)
+        for wave in range(3):
+            for part, base in (("a", 0.0), ("b", 1000.0)):
+                ids = np.sort(
+                    rng.choice(40_000, 12_000, replace=False)
+                ).astype(np.int64)
+                t.upsert(pa.table({
+                    "id": ids,
+                    "v": base + rng.normal(size=len(ids)),
+                    "p": np.repeat(part, len(ids)),
+                }, schema=SCHEMA))
+
+        spool = str(tmp_path / "spool")
+        os.makedirs(spool)
+        delivery = ScanPlaneDelivery(catalog, spool, wait_s=90)
+        server = LakeSoulFlightServer(
+            catalog, "grpc://127.0.0.1:0", scanplane=delivery
+        )
+        threading.Thread(target=server.serve, daemon=True).start()
+        location = f"grpc://127.0.0.1:{server.port}"
+
+        req = {"table": "t", "batch_size": 4096}
+        session = ScanSession.plan(catalog, req)
+        session.publish(spool)
+        nranges = len(session.ranges)
+        assert nranges >= 4  # 2 partitions x 2 buckets
+        store = catalog.client.store
+        keys = [f"scanplane/{session.session_id}/{i}" for i in range(nranges)]
+
+        # the victim hangs INSIDE its first leased range (holding the
+        # lease) — the most destructive SIGKILL window
+        victim = _spawn_worker(
+            wh, db, spool, worker_id="victim",
+            LAKESOUL_FAULTS="scanplane.range:1:hang:300",
+        )
+        peer = None
+        try:
+            held_key = None
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and held_key is None:
+                for k in keys:
+                    lease = store.get_lease(k)
+                    if lease is not None and lease.holder == "victim":
+                        held_key = k
+                        assert lease.fencing_token == 1
+                        break
+                if victim.poll() is not None:
+                    _, err = victim.communicate(timeout=10.0)
+                    pytest.fail(f"victim exited early: {err[-2000:]}")
+                time.sleep(0.05)
+            assert held_key is not None, "victim never leased a range"
+            held_index = int(held_key.rsplit("/", 1)[-1])
+
+            # trainer fleet starts consuming BEFORE the kill: rank r of 8
+            results: dict[int, list] = {r: [] for r in range(N_CLIENTS)}
+            errors: list = []
+            threads = []
+
+            def consume(rank):
+                try:
+                    c = ScanPlaneClient(location)
+                    for b in c.iter_batches(req, rank=rank, world=N_CLIENTS):
+                        results[rank].append(b)
+                except BaseException as e:
+                    errors.append((rank, e))
+
+            for r in range(N_CLIENTS):
+                th = threading.Thread(target=consume, args=(r,), daemon=True)
+                th.start()
+                threads.append(th)
+
+            # peer worker runs alongside; it produces every OTHER range but
+            # cannot touch the victim's until the lease expires
+            peer = _spawn_worker(wh, db, spool, worker_id="peer")
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(10.0)
+            killed_at = time.monotonic()
+
+            sdir = session.dir(spool)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if spool_mod.range_ready(sdir, held_index):
+                    break
+                time.sleep(0.02)
+            assert spool_mod.range_ready(sdir, held_index), (
+                "peer never produced the victim's range"
+            )
+            takeover_latency = time.monotonic() - killed_at
+            # "within one lease TTL": expiry <= TTL after the kill; poll
+            # cadence + the decode itself add the small remainder
+            assert takeover_latency < TTL_S + 4.0, takeover_latency
+            # the fencing trail proves the takeover: token 2 on the
+            # victim's range, and the sidecar records the peer as producer
+            side = spool_mod.read_sidecar(sdir, held_index)
+            assert side["fence"] == 2
+            assert side["worker"] == "peer"
+
+            for th in threads:
+                th.join(90.0)
+            assert not errors, errors
+
+            # EXACTLY-ONCE: every client's stream is byte-identical to the
+            # single-process shard scan — no duplicate, no missing batches
+            total = 0
+            for r in range(N_CLIENTS):
+                want = list(
+                    t.scan().batch_size(4096).shard(r, N_CLIENTS).to_batches()
+                )
+                got = results[r]
+                assert len(got) == len(want), (r, len(got), len(want))
+                for a, b in zip(got, want):
+                    assert a.equals(b)
+                total += sum(b.num_rows for b in got)
+            assert total == t.scan().count_rows()
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+            if peer is not None:
+                peer.terminate()
+                try:
+                    peer.wait(10.0)
+                except subprocess.TimeoutExpired:
+                    peer.kill()
+            server.shutdown()
