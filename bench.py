@@ -1521,6 +1521,55 @@ def main():
             cost_s=240,
         )
 
+    # ---- freshness chaos leg (ingest-to-train SLO under fire) ------------
+    def freshness_leg():
+        """Run benchmarks/micro.py freshness in a fresh subprocess (three
+        real roles + SIGKILL + flaky faults; see bench_freshness) and
+        commit its published figures into the trajectory."""
+        import subprocess as sp
+
+        out = sp.run(
+            [sys.executable, os.path.join(REPO, "benchmarks", "micro.py"),
+             "freshness"],
+            capture_output=True, text=True,
+            timeout=max(60.0, _remaining()),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        lines = [
+            json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")
+        ]
+        legs = [d for d in lines if d.get("bench") == "freshness" and "value" in d]
+        if out.returncode != 0 or not legs:
+            sys.stderr.write(out.stderr[-2000:])
+            raise RuntimeError(
+                f"freshness leg failed (rc={out.returncode})"
+            )
+        return legs[-1]
+
+    emit.leg(
+        "freshness", freshness_leg,
+        lambda out: {
+            "freshness_seconds": {
+                "p50": out["freshness_p50_s"],
+                "p99": out["freshness_p99_s"],
+                "max": out["freshness_max_s"],
+            },
+            "freshness_slo_target_s": out["slo_target_s"],
+            "freshness_slo_in_budget": out["slo_in_budget"],
+            "freshness_rows_per_s": out["rows_per_s"],
+            "freshness_rows": out["rows"],
+            "freshness_oracle_exact": out["oracle_exact"],
+            "freshness_chaos": {
+                "fault_p": out["fault_p"],
+                "compactor_sigkilled": out["compactor_sigkilled"],
+                "takeover_fenced": out["takeover_fenced"],
+                "lease_ttl_s": out["lease_ttl_s"],
+            },
+        },
+        cost_s=240,
+    )
+
     emit.record["complete"] = True
     emit._emit()
 

@@ -15,6 +15,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py lint       # lakelint wall-time over the package
     python benchmarks/micro.py topology   # SIGKILL→takeover latency (leased compaction)
     python benchmarks/micro.py scanplane  # disaggregated scan: 8 clients, 1→4 workers
+    python benchmarks/micro.py freshness  # ingest-to-train SLO under three-role chaos
     python benchmarks/micro.py all
 """
 
@@ -991,6 +992,203 @@ def bench_scanplane(
         )
 
 
+# freshness-leg SLO gates (env-tunable for slow boxes): the leg FAILS if
+# the p99 commit-to-visible latency or the sustained delivery rate misses
+FRESHNESS_SLO_S = float(os.environ.get("LAKESOUL_FRESHNESS_SLO_S", 10.0))
+FRESHNESS_TPUT_FLOOR = float(
+    os.environ.get("LAKESOUL_FRESHNESS_THROUGHPUT_FLOOR", 100.0)
+)
+
+
+def bench_freshness(
+    commits: int = 15, rows_per_commit: int = 400, ttl_s: float = 2.0,
+    fault_p: float = 0.3,
+) -> None:
+    """The always-fresh-lakehouse leg (ROADMAP item 4): three REAL roles
+    against one warehouse — ``python -m lakesoul_tpu.freshness writer``
+    streaming checkpointed CDC upserts, the real ``python -m
+    lakesoul_tpu.compaction`` leased service (SIGKILLed mid-leased-job,
+    with a peer taking over under the fencing trail), and a follower
+    trainer in THIS process under p=0.3 flaky-store + flaky-poll faults.
+    Publishes ``freshness_seconds`` p50/p99 (commit-to-visible, measured
+    at the follower's consumer hand-off) and sustained rows/s, and FAILS
+    unless both declared SLOs hold AND delivery exactly matches the
+    writer's oracle.  ``LAKESOUL_RETRY_SEED`` pins every backoff schedule
+    so the run reproduces."""
+    import signal
+    import subprocess
+    import threading
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.freshness import FreshFollower, SloMonitor, ThroughputSlo
+    from lakesoul_tpu.freshness.__main__ import oracle_sha
+    from lakesoul_tpu.meta.entity import CommitOp, now_millis
+    from lakesoul_tpu.runtime import faults
+    from lakesoul_tpu.runtime.resilience import RetryPolicy
+
+    schema = pa.schema([
+        ("id", pa.int64()), ("seq", pa.int64()), ("v", pa.float64()),
+    ])
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "LAKESOUL_RETRY_SEED": "7",
+    })
+    victim_env = dict(env, LAKESOUL_FAULTS="compaction.leased_job:1:hang:300")
+    expected = commits * rows_per_commit
+
+    with tempfile.TemporaryDirectory() as d:
+        wh, db = os.path.join(d, "wh"), os.path.join(d, "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table(
+            "fresh", schema, primary_keys=["id"], hash_bucket_num=2, cdc=True
+        )
+        start_ts = now_millis() - 1
+        store = catalog.client.store
+        lease_key = f"compaction/{t.info.table_id}/-5"
+
+        def compactor(service_id: str, e: dict) -> subprocess.Popen:
+            return subprocess.Popen(
+                [sys.executable, "-m", "lakesoul_tpu.compaction",
+                 "--warehouse", wh, "--db-path", db,
+                 "--lease-ttl-s", str(ttl_s), "--poll-s", "0.1",
+                 "--version-gap", "3", "--service-id", service_id],
+                env=e, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+
+        victim = compactor("victim", victim_env)
+        writer = subprocess.Popen(
+            [sys.executable, "-m", "lakesoul_tpu.freshness", "writer",
+             "--warehouse", wh, "--db-path", db, "--table", "fresh",
+             "--commits", str(commits),
+             "--rows-per-commit", str(rows_per_commit),
+             "--interval-s", "0.15"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+        peer_box: dict = {}
+        killed_at: dict = {}
+
+        def kill_and_replace():
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if store.get_lease(lease_key) is not None:
+                    victim.send_signal(signal.SIGKILL)
+                    victim.wait(10.0)
+                    killed_at["t"] = time.monotonic()
+                    peer_box["peer"] = compactor("peer", env)
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=kill_and_replace, daemon=True)
+
+        slo = SloMonitor(target_s=FRESHNESS_SLO_S, budget_fraction=0.05,
+                         slo="bench-freshness")
+        tput = ThroughputSlo(FRESHNESS_TPUT_FLOOR, slo="bench-freshness-tput")
+        stop = threading.Event()
+        follower = FreshFollower(
+            catalog.table("fresh").scan().batch_size(2048),
+            start_timestamp_ms=start_ts,
+            poll_interval=0.05,
+            stop_event=stop,
+            retry_policy=RetryPolicy(
+                max_attempts=12, base_delay_s=0.002, max_delay_s=0.05, seed=7
+            ),
+            slo=slo,
+        )
+
+        rows: list[tuple[int, int, float]] = []
+        faults.clear()
+        faults.install(f"follow.poll:{fault_p}:flaky")
+        faults.install(f"object_store.cat_file:{fault_p}:flaky")
+        faults.install(f"object_store.open:{fault_p}:flaky")
+        try:
+            tput.start()
+            watcher.start()
+
+            def consume():
+                for b in follower.iter_batches():
+                    rows.extend(zip(
+                        b.column("seq").to_pylist(),
+                        b.column("id").to_pylist(),
+                        b.column("v").to_pylist(),
+                    ))
+                    if len(rows) >= expected:
+                        stop.set()
+
+            th = threading.Thread(target=consume, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 180.0
+            while th.is_alive() and time.monotonic() < deadline:
+                th.join(timeout=0.2)
+            stop.set()
+            th.join(timeout=15.0)
+            tput.add_rows(len(rows))
+        finally:
+            faults.clear()
+            out, _ = writer.communicate(timeout=60.0)
+            if victim.poll() is None:
+                victim.send_signal(signal.SIGKILL)
+
+        try:
+            oracle = json.loads(out.strip().splitlines()[-1])
+            assert writer.returncode == 0
+            assert len(rows) == expected, (
+                f"delivered {len(rows)} of {expected} rows"
+            )
+            assert oracle_sha(rows) == oracle["sha256"], (
+                "delivered rows diverged from the writer oracle"
+            )
+            assert "t" in killed_at, "victim compactor never held a lease"
+
+            snap = slo.snapshot()
+            rate = tput.evaluate()
+            assert snap["in_budget"] and snap["p99_s"] <= FRESHNESS_SLO_S, snap
+            assert rate["ok"], rate
+
+            # the peer completes the compaction under the fencing trail
+            fence_deadline = time.monotonic() + 60.0
+            fenced = []
+            while time.monotonic() < fence_deadline and not fenced:
+                fenced = [
+                    v for v in store.get_partition_versions(
+                        t.info.table_id, "-5"
+                    )
+                    if v.commit_op == CommitOp.COMPACTION
+                    and v.expression.startswith("fence=")
+                ]
+                if not fenced:
+                    time.sleep(0.2)
+            assert fenced and any(
+                int(v.expression.split("=", 1)[1]) >= 2 for v in fenced
+            ), "no fenced takeover CompactionCommit"
+        finally:
+            peer = peer_box.get("peer")
+            if peer is not None and peer.poll() is None:
+                peer.send_signal(signal.SIGKILL)
+                peer.wait(10.0)
+
+        _emit(
+            "freshness", snap["p99_s"], "s",
+            freshness_p50_s=round(snap["p50_s"], 4),
+            freshness_p99_s=round(snap["p99_s"], 4),
+            freshness_max_s=round(snap["max_s"], 4),
+            slo_target_s=FRESHNESS_SLO_S,
+            slo_in_budget=snap["in_budget"],
+            slo_violations=snap["violations"],
+            commits_observed=snap["count"],
+            rows=len(rows),
+            rows_per_s=round(rate["rows_per_s"], 1),
+            throughput_floor=FRESHNESS_TPUT_FLOOR,
+            oracle_exact=True,
+            compactor_sigkilled=True,
+            takeover_fenced=True,
+            fault_p=fault_p,
+            lease_ttl_s=ttl_s,
+        )
+
+
 LEGS = {
     "merge": bench_merge,
     "scan_stages": bench_scan_stages,
@@ -1004,6 +1202,7 @@ LEGS = {
     "lint": bench_lint,
     "topology": bench_topology,
     "scanplane": bench_scanplane,
+    "freshness": bench_freshness,
 }
 
 

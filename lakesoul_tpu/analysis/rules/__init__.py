@@ -30,6 +30,7 @@ from lakesoul_tpu.analysis.rules.lifetime import (
     RingAliasingRule,
     ViewEscapesReleaseRule,
 )
+from lakesoul_tpu.analysis.rules.loops import UnstoppableLoopRule
 from lakesoul_tpu.analysis.rules.perf import HotPathMaterializeRule
 from lakesoul_tpu.analysis.rules.process import RawProcessRule
 from lakesoul_tpu.analysis.rules.races import (
@@ -71,6 +72,7 @@ def all_rules() -> list[Rule]:
         WallClockLeaseRule(),
         HotPathMaterializeRule(),
         RawProcessRule(),
+        UnstoppableLoopRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

@@ -32,7 +32,7 @@ from typing import Iterable
 
 from lakesoul_tpu.analysis.engine import Finding, Module, Rule, dotted_name
 
-SCOPE = ("service/", "compaction/", "meta/", "scanplane/")
+SCOPE = ("service/", "compaction/", "meta/", "scanplane/", "freshness/")
 
 _KEYWORDS = ("ttl", "deadline", "lease", "expire", "expiry", "timeout")
 

@@ -1238,86 +1238,72 @@ class LakeSoulScan:
         *,
         poll_interval: float = 1.0,
         stop_event=None,
-        settle_ms: int = 250,  # retained for API compat; unused (see below)
+        settle_ms=None,  # deprecated no-op, kept for API compat (see note)
         cursors: dict | None = None,
+        state=None,
+        slo=None,
+        retry_policy=None,
     ) -> Iterator[pa.RecordBatch]:
         """Unbounded incremental source: yield batches for every commit after
         ``start_timestamp_ms`` (default: now), then keep polling for new
         commits — the role of the reference's unbounded Flink source
         (LakeSoulSource + dynamic split enumerator).  Stops when
-        ``stop_event`` (threading.Event) is set.
+        ``stop_event`` (threading.Event) is set; the idle wait rides
+        ``stop_event.wait(poll_interval)``, so shutdown latency is bounded
+        by ONE poll tick.
 
-        Planning is driven by per-partition VERSION cursors
-        (MetaDataClient.poll_scan_plan): each poll costs one head query plus
-        O(new commits) — unchanged partitions are skipped without touching
-        version history.  Version cursors are exact, so the old timestamp
-        settle window (``settle_ms``) is no longer needed: a commit is either
-        visible with a new version number or it is not.
+        The loop is the freshness follower
+        (:class:`lakesoul_tpu.freshness.follower.FreshFollower`): polls and
+        unit decodes run under the shared
+        :class:`~lakesoul_tpu.runtime.resilience.RetryPolicy` (transient
+        store/meta faults retry on the seeded schedule instead of killing
+        the stream; permanent failures raise typed), and an attached
+        ``slo`` (:class:`~lakesoul_tpu.freshness.slo.SloMonitor`) observes
+        each delivered commit's commit-to-visible latency.
 
-        Pass ``cursors`` (a dict the stream mutates in place; serialize with
-        meta.client.follow_cursors_to_json) to make the stream RESUMABLE:
-        persist it with your checkpoint and a restarted consumer continues
-        exactly after the last delivered commit — the pending-splits
-        checkpointing the reference's Flink source gets from
-        SimpleLakeSoulPendingSplitsSerializer."""
-        from lakesoul_tpu.meta.entity import now_millis
+        Resume, two grains:
 
-        import time as _time
+        - ``cursors`` (a dict the stream mutates in place; serialize with
+          ``meta.client.follow_cursors_to_json``): commit-grained — a
+          restarted consumer continues after the last *enumerated* commit
+          (the pending-splits checkpointing of the reference's Flink
+          source).
+        - ``state`` (a :class:`~lakesoul_tpu.freshness.follower.
+          FollowerState` or its JSON): row-exact — replays the recorded
+          undelivered units, so a killed consumer resumes with no
+          duplicated and no lost row.
 
-        info = self._table.info
-        client = self._table.catalog.client
-        budget = self._table.io_config().memory_budget_bytes
-        if cursors is None:
-            start = start_timestamp_ms if start_timestamp_ms is not None else now_millis()
-            cursors = client.init_follow_cursors(
-                info.table_name, start, info.table_namespace
+        .. deprecated:: PR 12
+            ``settle_ms`` has been a no-op since follow moved to version
+            cursors (a commit is either visible with a new version number
+            or it is not); the parameter is retained so existing callers
+            keep working and will be removed in a future PR.
+        """
+        if settle_ms is not None:
+            import warnings
+
+            warnings.warn(
+                "LakeSoulScan.follow(settle_ms=...) is deprecated and has"
+                " no effect: version cursors made the settle window"
+                " obsolete",
+                DeprecationWarning,
+                stacklevel=2,
             )
-        while stop_event is None or not stop_event.is_set():
-            units = client.poll_scan_plan(
-                info.table_name, cursors, info.table_namespace
-            )
-            units = self._filter_partitions(units)
-            # non-PK units must shard per FILE: each rank's polls batch
-            # commits differently, so a multi-file unit's identity (first
-            # file) is timing-dependent — per-file units are not
-            exploded: list[ScanPlanPartition] = []
-            for u in units:
-                if u.primary_keys:
-                    exploded.append(u)
-                    continue
-                sizes = (
-                    u.file_sizes
-                    if len(u.file_sizes) == len(u.data_files)
-                    else [0] * len(u.data_files)
-                )
-                for f, sz in zip(u.data_files, sizes):
-                    exploded.append(
-                        ScanPlanPartition(
-                            data_files=[f],
-                            primary_keys=[],
-                            bucket_id=u.bucket_id,
-                            partition_desc=u.partition_desc,
-                            partition_values=u.partition_values,
-                            file_sizes=[sz],
-                        )
-                    )
-            units = self._restrict_units(exploded, stable_shard=True)
-            emitted = False
-            for unit in units:
-                for batch in iter_scan_unit_batches(
-                    unit.data_files,
-                    unit.primary_keys,
-                    batch_size=self._batch_size,
-                    memory_budget_bytes=budget,
-                    file_sizes=unit.file_sizes,
-                    **self._unit_kwargs(unit),
-                ):
-                    emitted = True
-                    yield batch
-            if stop_event is not None and stop_event.is_set():
-                return
-            if not emitted:
-                _time.sleep(poll_interval)
+        from lakesoul_tpu.freshness.follower import FollowerState, FreshFollower
+
+        if isinstance(state, str):
+            state = FollowerState.from_json(state)
+        follower = FreshFollower(
+            self,
+            start_timestamp_ms=start_timestamp_ms,
+            state=state,
+            cursors=cursors,
+            poll_interval=poll_interval,
+            stop_event=stop_event,
+            retry_policy=retry_policy,
+            slo=slo,
+        )
+        yield from follower.iter_batches()
 
     # jax / torch / huggingface delivery
     def to_jax_iter(self, **kwargs):

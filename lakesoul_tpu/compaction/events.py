@@ -92,17 +92,32 @@ class PollingWatermarkNotifier(CompactionNotifier):
     compaction advances.  Every open gap is re-delivered on every poll
     (at-least-once); suppressing repeats is the consumer's job — the
     leased service already tracks not-compactable heads, and per-partition
-    leases make redundant deliveries harmless."""
+    leases make redundant deliveries harmless.
+
+    Failure isolation (the long-running-service contract): the candidate
+    derivation runs under the shared
+    :class:`~lakesoul_tpu.runtime.resilience.RetryPolicy` (transient store
+    blips retry on the seeded schedule; exhaustion/permanent errors fail
+    THIS poll only — logged, counted, re-derived next tick, because the
+    watermark is committed state and loses nothing).  A raising listener
+    no longer aborts the poll: its exception is logged once with the
+    active trace id, counted into
+    ``lakesoul_notifier_listener_errors_total``, and the remaining
+    listeners and events still see the delivery."""
 
     def __init__(
         self,
         store,
         *,
         version_gap: int = COMPACTION_TRIGGER_VERSION_GAP,
+        retry_policy=None,
     ):
+        from lakesoul_tpu.runtime.resilience import RetryPolicy
+
         self.store = store
         self.version_gap = version_gap
         self._fns: list[Callable[[CompactionEvent], None]] = []
+        self._policy = retry_policy or RetryPolicy.from_env()
 
     def listen(self, fn) -> None:
         self._fns.append(fn)
@@ -113,13 +128,49 @@ class PollingWatermarkNotifier(CompactionNotifier):
         except ValueError:
             pass
 
+    def _candidates(self) -> list[CompactionEvent]:
+        from lakesoul_tpu.obs import registry
+
+        def attempt():
+            return list(self.store.get_compaction_candidates(self.version_gap))
+
+        try:
+            return self._policy.run(attempt, op="notifier.poll")
+        except Exception:
+            # candidates are RE-DERIVED every poll from committed state: a
+            # failed derivation delays delivery by one tick, it must never
+            # kill the owning service loop
+            registry().counter("lakesoul_notifier_poll_errors_total").inc()
+            logger.exception(
+                "compaction candidate derivation failed; retrying next poll"
+            )
+            return []
+
     def poll(self) -> int:
         if not self._fns:
             return 0
+        from lakesoul_tpu.obs import registry
+        from lakesoul_tpu.obs.tracing import current_span
+
         delivered = 0
-        for ev in self.store.get_compaction_candidates(self.version_gap):
+        for ev in self._candidates():
             for fn in list(self._fns):
-                fn(ev)
+                try:
+                    fn(ev)
+                except Exception:
+                    # isolate: one bad listener must not starve the others
+                    # (or later events) of the delivery
+                    registry().counter(
+                        "lakesoul_notifier_listener_errors_total"
+                    ).inc()
+                    sp = current_span()
+                    logger.exception(
+                        "compaction listener %r failed for %s/%s (trace %s)",
+                        getattr(fn, "__qualname__", fn),
+                        ev.table_id,
+                        ev.partition_desc,
+                        sp.trace_id if sp is not None else "-",
+                    )
             delivered += 1
         return delivered
 

@@ -12,9 +12,13 @@ source FACTORY (set by :meth:`LakeSoulScan.via_scanplane`), and
 with ``to_batches``-identical semantics (limit applied, deterministic
 order, generators close cleanly on abandonment).  Local scans resolve to
 :class:`ScanBatchSource` (a thin ``to_batches`` wrapper); remote scans to
-:class:`lakesoul_tpu.scanplane.client.RemoteBatchSource`.  Adapters that
-consume the seam get remote scan FOR FREE — the parity tests pin that the
-two sources are byte-identical.
+:class:`lakesoul_tpu.scanplane.client.RemoteBatchSource`; continuous
+scans (``to_jax_iter(follow=...)``) to
+:class:`lakesoul_tpu.freshness.follower.FollowBatchSource` — an unbounded
+retry-hardened stream over the table's commit log with an exactly-once
+resumable position.  Adapters that consume the seam get remote AND
+follow delivery FOR FREE — the parity tests pin that the sources are
+byte-identical where they overlap.
 """
 
 from __future__ import annotations
@@ -32,8 +36,41 @@ class ScanBatchSource:
         return self._scan.to_batches(num_threads=num_threads, skip_rows=skip_rows)
 
 
-def batch_source_for(scan):
-    """Resolve a scan to its batch source (remote factory wins)."""
+def batch_source_for(scan, follow=None):
+    """Resolve a scan to its batch source.
+
+    ``follow`` turns the scan into a CONTINUOUS source: ``True`` follows
+    from now, a dict passes :class:`~lakesoul_tpu.freshness.follower.
+    FreshFollower` options (``start_timestamp_ms``, ``state``,
+    ``poll_interval``, ``stop_event``, ``slo``, ``retry_policy``), a
+    persisted position (``FollowerState`` or its JSON) resumes from it,
+    an existing :class:`~lakesoul_tpu.freshness.follower.
+    FollowBatchSource` is used as-is.  Any other value raises — a typo'd
+    ``follow=`` must never silently become follow-from-now, discarding
+    the caller's resume position.  Otherwise the remote factory
+    (``via_scanplane``) wins, then in-process decode."""
+    if follow is not None and follow is not False:
+        from lakesoul_tpu.errors import ConfigError
+        from lakesoul_tpu.freshness.follower import (
+            FollowBatchSource,
+            FollowerState,
+        )
+
+        if isinstance(follow, FollowBatchSource):
+            return follow
+        if follow is True:
+            opts = {}
+        elif isinstance(follow, dict):
+            opts = follow
+        elif isinstance(follow, (str, FollowerState)):
+            opts = {"state": follow}
+        else:
+            raise ConfigError(
+                f"follow must be True, an options dict, a FollowerState"
+                f" (or its JSON), or a FollowBatchSource — got"
+                f" {type(follow).__name__}"
+            )
+        return FollowBatchSource(scan, **opts)
     factory = getattr(scan, "_batch_source_factory", None)
     if factory is not None:
         return factory(scan)

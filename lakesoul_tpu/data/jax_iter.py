@@ -429,6 +429,27 @@ class JaxBatchIterator:
         drop_remainder: drop the final short batch (jit-friendly default True).
         io_threads: decode scan units on this many threads (multi-core hosts;
             see LakeSoulScan.to_batches).
+        follow: make the loader a CONTINUOUS training source over the
+            table's commit log (the freshness layer): ``True`` follows
+            from now, a dict passes follower options
+            (``start_timestamp_ms``, ``state``, ``poll_interval``,
+            ``stop_event``, ``slo``, ``retry_policy`` — see
+            :class:`lakesoul_tpu.freshness.follower.FreshFollower`), or an
+            existing ``FollowBatchSource``.  The stream never ends on its
+            own — set a ``stop_event`` to shut it down within one poll
+            tick.  Resume via :meth:`follow_state_json`, NOT via
+            ``checkpoint`` (the follower carries its own exactly-once
+            position; mixing the two raises).  Note the pipeline-lag
+            semantics under ``device_put=True``: the double buffer keeps
+            ``device_prefetch`` transfers in flight and the rebatcher
+            holds sub-``batch_size`` remainders, so when ingest PAUSES
+            the consumer trails the stream head by up to
+            ``device_prefetch`` windows + one partial window until more
+            commits arrive (continuous traffic — the follow workload —
+            keeps the lag bounded and flowing; latency-critical
+            low-traffic consumers should use ``device_put=False``, where
+            delivery is immediate).  The freshness SLO measures at the
+            source hand-off either way.
         consumer: attribution tag for this loader's ``queue`` stall series
             (``lakesoul_scan_stage_seconds{stage=queue,consumer=...}``) —
             with several concurrent loaders (a trainer fleet on one host)
@@ -457,11 +478,23 @@ class JaxBatchIterator:
         checkpoint: "LoaderCheckpoint | None" = None,
         cache: str | None = None,
         consumer: str | None = None,
+        follow=None,
     ):
         from lakesoul_tpu.errors import ConfigError
 
         if cache not in (None, "device"):
             raise ConfigError(f"unknown cache mode {cache!r}; expected 'device'")
+        if follow is not None and follow is not False:
+            if checkpoint is not None:
+                raise ConfigError(
+                    "follow and checkpoint are mutually exclusive: the"
+                    " follower carries its own exactly-once position"
+                    " (follow_state_json)"
+                )
+            if cache == "device":
+                raise ConfigError(
+                    "cache='device' cannot cache an unbounded follow stream"
+                )
         if cache == "device" and checkpoint is not None:
             # a replayed epoch never touches the input stream, so a loader
             # checkpoint could not represent its position
@@ -507,6 +540,15 @@ class JaxBatchIterator:
         self._drop_remainder = drop_remainder
         self._io_threads = io_threads
         self._checkpoint = checkpoint
+        # follow mode: ONE seam source for the iterator's lifetime — its
+        # follower owns the exactly-once position follow_state_json() reads
+        self._follow_source = None
+        self._follow_started = False
+        if follow is not None and follow is not False:
+            from lakesoul_tpu.data.batch_source import batch_source_for
+
+            self._follow_source = batch_source_for(scan, follow=follow)
+        self._rows_out = 0  # consumer-delivered rows (follow resume anchor)
         if checkpoint is not None:
             digest = self._plan_digest()
             if checkpoint.plan_digest is None:
@@ -530,6 +572,19 @@ class JaxBatchIterator:
         and current producer-queue depth.  Cheap enough to read every step."""
         return self._stats.snapshot()
 
+    def follow_state_json(self) -> str:
+        """Resume-ready follower position covering exactly the batches this
+        iterator has DELIVERED (rows sitting in the prefetch/device
+        pipelines replay on restart — never skipped, never duplicated).
+        Persist it next to the model checkpoint; a restarted trainer
+        continues with ``scan.to_jax_iter(follow={"state": saved, ...})``.
+        Only meaningful in follow mode."""
+        from lakesoul_tpu.errors import ConfigError
+
+        if self._follow_source is None:
+            raise ConfigError("follow_state_json() requires follow mode")
+        return self._follow_source.resume_state(self._rows_out).to_json()
+
     # ------------------------------------------------------------- pipeline
     def _epoch_windows(self) -> "Iterator[_Window]":
         """Fixed-size row windows over one epoch's scan (the pipeline
@@ -543,12 +598,18 @@ class JaxBatchIterator:
             capture_views=self._collate is _default_collate,
         )
         h = self._h_rebatch
-        # the batch-source seam: in-process decode OR a scan-plane fleet
-        # (scan.via_scanplane) — everything downstream (rebatch, collate,
-        # prefetch, device_put, stats) is identical either way
+        # the batch-source seam: in-process decode, a scan-plane fleet
+        # (scan.via_scanplane) OR a continuous follow stream (follow=) —
+        # everything downstream (rebatch, collate, prefetch, device_put,
+        # stats) is identical either way
         from lakesoul_tpu.data.batch_source import batch_source_for
 
-        for arrow_batch in batch_source_for(self._scan).iter_batches(
+        source = (
+            self._follow_source
+            if self._follow_source is not None
+            else batch_source_for(self._scan)
+        )
+        for arrow_batch in source.iter_batches(
             num_threads=self._io_threads, skip_rows=skip
         ):
             t0 = time.perf_counter()
@@ -597,6 +658,21 @@ class JaxBatchIterator:
         return jax.tree_util.tree_map(lambda x: x, batch)
 
     def __iter__(self):
+        if self._follow_source is not None:
+            from lakesoul_tpu.errors import ConfigError
+
+            if self._follow_started:
+                # a second pass would rebuild the follower from the INITIAL
+                # state while _rows_out kept accumulating: duplicated
+                # delivery now and a follow_state_json() position pointing
+                # into a snapshot ring that never saw those rows later
+                raise ConfigError(
+                    "a follow-mode iterator is single-pass (the stream is"
+                    " unbounded): build a new iterator — resuming with"
+                    " follow={'state': it.follow_state_json()} — instead"
+                    " of re-iterating"
+                )
+            self._follow_started = True
         if self._device_cached is not None:
             # steady state: replay the HBM-resident epoch, no host pipeline
             self._stats.epoch_begin()
@@ -642,6 +718,7 @@ class JaxBatchIterator:
         def delivered(rows: int) -> None:
             # position advances when a batch reaches the CONSUMER: a trainer
             # saving (model, checkpoint) after step k resumes exactly at k+1
+            self._rows_out += rows
             if self._checkpoint is not None:
                 self._checkpoint.rows_delivered += rows
 

@@ -247,6 +247,13 @@ def filesystem_for(path: str, storage_options: dict | None = None, *, write: boo
         policy = _store_retry_policy()
         if policy.max_attempts > 1 or faults.active():
             fs = ResilientFileSystem(fs, policy)
+    elif faults.active():
+        # LOCAL filesystems stay unwrapped in production (no network to
+        # retry), but a chaos run on a shared local warehouse — the
+        # multi-process freshness harness — must exercise the REAL
+        # object_store.* fault points and the real retry path, so the
+        # wrapper arms whenever faults are installed
+        fs = ResilientFileSystem(fs, _store_retry_policy())
     if cache_dir and not write and protocol not in OPTION_CACHE_DISABLED_PROTOCOLS:
         from lakesoul_tpu.io.page_cache import CachedReadFileSystem, get_cache
 

@@ -146,6 +146,13 @@ class ScanPlanPartition:
     # on-disk bytes per data file (from DataFileOp.size); lets readers choose
     # materialize-vs-stream without extra object-store HEAD requests
     file_sizes: list[int] = field(default_factory=list)
+    # wall-clock instant (now_millis timebase) the EARLIEST commit feeding
+    # this unit became visible in partition_info — 0 when unknown (batch
+    # plans don't carry it).  Streaming followers subtract it from delivery
+    # time to measure commit-to-visible freshness (freshness/slo.py); the
+    # earliest contributing commit makes the figure the WORST-case staleness
+    # of the unit, which is what an SLO must bound.
+    commit_timestamp_ms: int = 0
 
     @property
     def needs_merge(self) -> bool:
@@ -978,15 +985,20 @@ class MetaDataClient:
             )
             prev_snapshot = set(cur.snapshot) if cur is not None else set()
             new_commits: list[str] = []
+            commit_ts: dict[str, int] = {}
             for v in versions:
                 if v.commit_op == CommitOp.COMPACTION:
                     pass  # rewrites data, adds nothing new
                 elif v.commit_op == CommitOp.UPDATE:
                     new_commits = list(v.snapshot)  # full rewrite
+                    commit_ts = {c: v.timestamp for c in new_commits}
                 else:
-                    new_commits.extend(
-                        c for c in v.snapshot if c not in prev_snapshot
-                    )
+                    fresh = [c for c in v.snapshot if c not in prev_snapshot]
+                    new_commits.extend(fresh)
+                    # the version row's timestamp IS the visibility instant:
+                    # the commit became readable when this row landed
+                    for c in fresh:
+                        commit_ts[c] = v.timestamp
                 prev_snapshot = set(v.snapshot)
             if versions:
                 cursors[desc] = PartitionCursor(versions[-1].version, prev_snapshot)
@@ -995,7 +1007,10 @@ class MetaDataClient:
             if not new_commits:
                 continue
             plan.extend(
-                self._units_from_commits(table_info, desc, new_commits, pk_cols)
+                self._units_from_commits(
+                    table_info, desc, new_commits, pk_cols,
+                    commit_timestamps=commit_ts,
+                )
             )
         return plan
 
@@ -1005,41 +1020,59 @@ class MetaDataClient:
         partition_desc: str,
         commit_ids: list[str],
         pk_cols: list[str],
+        *,
+        commit_timestamps: dict[str, int] | None = None,
     ) -> list[ScanPlanPartition]:
-        """Scan units covering exactly the files added by the given commits."""
+        """Scan units covering exactly the files added by the given commits.
+        ``commit_timestamps`` (commit id → visibility instant from the
+        partition_info version row) stamps each unit with the EARLIEST
+        contributing commit's timestamp for freshness accounting."""
         commits = self.store.get_data_commit_info(
             table_info.table_id, partition_desc, commit_ids
         )
         values = partition_desc_to_dict(partition_desc)
-        files = [op for c in commits for op in c.file_ops if op.file_op.value == "add"]
+        files = [
+            (op, c.commit_id)
+            for c in commits
+            for op in c.file_ops
+            if op.file_op.value == "add"
+        ]
         if not files:
             return []
+        ts = commit_timestamps or {}
+
+        def unit_ts(commit_ids_of_unit) -> int:
+            known = [ts[c] for c in commit_ids_of_unit if c in ts]
+            return min(known) if known else 0
+
         if not pk_cols:
             return [
                 ScanPlanPartition(
-                    data_files=[f.path for f in files],
+                    data_files=[f.path for f, _ in files],
                     primary_keys=[],
                     partition_desc=partition_desc,
                     partition_values=values,
-                    file_sizes=[f.size for f in files],
+                    file_sizes=[f.size for f, _ in files],
+                    commit_timestamp_ms=unit_ts([cid for _, cid in files]),
                 )
             ]
-        by_bucket: dict[int, list[tuple[str, int]]] = {}
-        for f in files:
+        by_bucket: dict[int, list[tuple[str, int, str]]] = {}
+        for f, cid in files:
             bucket = extract_hash_bucket_id(f.path)
             if bucket is None:
                 raise MetadataError(
                     f"cannot determine bucket id from file name {f.path}"
                 )
-            by_bucket.setdefault(bucket, []).append((f.path, f.size))
+            by_bucket.setdefault(bucket, []).append((f.path, f.size, cid))
         return [
             ScanPlanPartition(
-                data_files=[p for p, _ in bucket_files],
+                data_files=[p for p, _, _ in bucket_files],
                 primary_keys=pk_cols,
                 bucket_id=bucket_id,
                 partition_desc=partition_desc,
                 partition_values=values,
-                file_sizes=[s for _, s in bucket_files],
+                file_sizes=[s for _, s, _ in bucket_files],
+                commit_timestamp_ms=unit_ts([cid for _, _, cid in bucket_files]),
             )
             for bucket_id, bucket_files in sorted(by_bucket.items())
         ]

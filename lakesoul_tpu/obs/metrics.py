@@ -157,6 +157,35 @@ class Histogram:
             self._sum += total_s
             self._count += count
 
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile (0..1) from the cumulative buckets —
+        Prometheus ``histogram_quantile`` semantics: linear interpolation
+        inside the owning bucket, the lowest bucket interpolates from 0,
+        and observations beyond the last finite bound clamp to it (the
+        +Inf bucket has no upper edge to interpolate toward).  Returns 0.0
+        on an empty histogram.  SLO evaluators that need EXACT percentiles
+        keep their own bounded reservoir (freshness/slo.py) — this is the
+        registry-side estimate every exporter consumer can reproduce."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank and c:
+                if i >= len(self.bounds):
+                    return self.bounds[-1] if self.bounds else 0.0
+                lo = self.bounds[i - 1] if i else 0.0
+                hi = self.bounds[i]
+                return lo + (hi - lo) * ((rank - prev_cum) / c)
+        return self.bounds[-1] if self.bounds else 0.0
+
     @property
     def value(self) -> dict:
         with self._lock:

@@ -75,7 +75,18 @@ class CheckpointedWriter:
 
     def checkpoint(self, checkpoint_id: int | str) -> int:
         """Flush staged data and commit with checkpoint-derived commit ids.
-        Returns the number of partitions committed (0 on replay/no data)."""
+        Returns the number of partitions committed (0 on replay/no data).
+
+        The commit runs under the shared
+        :class:`~lakesoul_tpu.runtime.resilience.RetryPolicy`: a transient
+        store/meta fault retries on the seeded schedule, and because the
+        commit ids derive from the checkpoint id, a retry after a
+        half-landed attempt is the same idempotent replay a crashed
+        process gets — a continuously-ingesting writer (the freshness
+        chaos harness's writer role) survives injected flaky faults
+        without double-committing an epoch."""
+        from lakesoul_tpu.runtime.resilience import RetryPolicy
+
         files_by_partition = self._staged_files_by_partition()
         if not files_by_partition:
             return 0
@@ -83,13 +94,17 @@ class CheckpointedWriter:
             desc: checkpoint_commit_id(self.table.info.table_id, desc, checkpoint_id)
             for desc in files_by_partition
         }
-        committed = self.table.catalog.client.commit_data_files(
-            self.table.info,
-            files_by_partition,
-            self.commit_op,
-            commit_id_by_partition=commit_ids,
-            storage_options=self.table.io_config().object_store_options,
-        )
+
+        def attempt():
+            return self.table.catalog.client.commit_data_files(
+                self.table.info,
+                files_by_partition,
+                self.commit_op,
+                commit_id_by_partition=commit_ids,
+                storage_options=self.table.io_config().object_store_options,
+            )
+
+        committed = RetryPolicy.from_env().run(attempt, op="cdc.checkpoint")
         return len(committed)
 
     def checkpoint_replace(self, checkpoint_id: int | str) -> int:

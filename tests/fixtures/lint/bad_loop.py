@@ -1,0 +1,69 @@
+"""Seeded unstoppable-loop violations: while-True poll loops that sleep
+blind in a service layer — only a process kill can end them — plus the
+legal shapes (event-riding waits, while-not-stop conditions, stop checks
+in the body, attempt budgets that raise, data-drain loops) that must stay
+silent.  The test instantiates the rule with this file in scope (the
+default scope is streaming//compaction//scanplane//freshness/)."""
+
+import time
+
+
+def poll_forever(store):
+    while True:  # SEED: unstoppable-loop
+        store.get_candidates()
+        time.sleep(1.0)
+
+
+def poll_with_bare_sleep(q):
+    while 1:  # SEED: unstoppable-loop
+        item = q.get_nowait()
+        if item is None:
+            sleep(0.1)  # noqa: F821 — the bare-name import shape counts too
+        else:
+            item.run()
+
+
+def stoppable_wait(stop, store):
+    # allowed: the idle wait rides the stop event — one-tick shutdown
+    while True:
+        store.get_candidates()
+        if stop.wait(1.0):
+            return
+
+
+def stoppable_condition(stop, store):
+    # allowed: not a while-True loop at all
+    while not stop.is_set():
+        store.get_candidates()
+        time.sleep(1.0)
+
+
+def stop_checked_in_body(stop_event, store):
+    # allowed: an if-test naming the stop event consults it every tick
+    while True:
+        if stop_event.is_set():
+            return
+        store.get_candidates()
+        time.sleep(1.0)
+
+
+def attempt_budget(fetch, max_attempts):
+    # allowed: raises on exhaustion — ends under persistent failure
+    attempts = 0
+    while True:
+        try:
+            return fetch()
+        except ConnectionError:
+            attempts += 1
+            if attempts >= max_attempts:
+                raise
+            time.sleep(0.05)
+
+
+def drain_cursor(cur):
+    # allowed: no sleep — a data-drain loop that terminates with its input
+    while True:
+        rows = cur.fetchmany(1024)
+        if not rows:
+            break
+        yield rows

@@ -153,6 +153,48 @@ def test_raw_process_allows_topology_layers(tmp_path):
         assert list(rule.check(mod)) == [], rel
 
 
+def test_unstoppable_loop_rule_line_exact():
+    """The 25th rule: while-True poll loops that sleep blind in the
+    service layers are flagged line-exactly; event-riding waits,
+    while-not-stop conditions, in-body stop checks, attempt budgets that
+    raise, and sleepless data-drain loops stay silent."""
+    from lakesoul_tpu.analysis.rules.loops import UnstoppableLoopRule
+
+    rules = [UnstoppableLoopRule(scope=("bad_loop.py",))]
+    found = [
+        f for f in lint_fixture("bad_loop.py", rules=rules)
+        if f.rule == "unstoppable-loop"
+    ]
+    assert len(found) == 2, found
+    assert_seed_lines(found, "bad_loop.py", "unstoppable-loop")
+    assert "stop" in found[0].message
+    # out-of-scope path (fixture root isn't streaming//compaction//
+    # scanplane//freshness/): the default-scoped catalog stays silent
+    assert lint_fixture("bad_loop.py") == []
+
+
+def test_unstoppable_loop_allows_real_service_loops(tmp_path):
+    """The settled real-code idioms — compaction's run_forever
+    (stop.wait-paced), the scan-plane client's attempt-budget reconnect
+    loop — stay silent under the default scope."""
+    import pathlib
+
+    from lakesoul_tpu.analysis.rules.loops import UnstoppableLoopRule
+
+    rule = UnstoppableLoopRule()
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    for rel in (
+        "lakesoul_tpu/compaction/service.py",
+        "lakesoul_tpu/scanplane/client.py",
+        "lakesoul_tpu/scanplane/worker.py",
+        "lakesoul_tpu/streaming/db_sync.py",
+        "lakesoul_tpu/freshness/follower.py",
+    ):
+        mod = Module.load(repo / rel, repo)
+        assert mod is not None, rel
+        assert list(rule.check(mod)) == [], rel
+
+
 def test_hot_path_materialize_rule_line_exact():
     """The 19th rule: concat_tables / .combine_chunks() / .to_pandas() in
     the scan/loader hot-path modules are flagged line-exactly; zero-copy
@@ -542,8 +584,9 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 24 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 25 and "rbac-gate-reachability" in rule_ids
     assert "raw-process" in rule_ids
+    assert "unstoppable-loop" in rule_ids
     assert "pallas-blockspec" in rule_ids
     assert "shared-state-race" in rule_ids and "view-escapes-release" in rule_ids
     for r in driver["rules"]:

@@ -1,0 +1,363 @@
+"""The ingest-to-train freshness SLO, held under fire.
+
+Three roles run CONCURRENTLY against one warehouse — a CDC writer
+streaming checkpointed upserts, the leased compaction service keeping the
+table compacted, and a follower trainer observing bounded staleness —
+while chaos is injected: flaky-store faults on the follower's read path
+and (in the slow leg) a SIGKILL of the real ``python -m
+lakesoul_tpu.compaction`` process mid-leased-job with a peer taking over.
+The run must hold BOTH declared SLOs — freshness (p99 commit-to-visible
+seconds) and sustained throughput (rows/s) — and the follower's delivered
+rows must exactly match the writer's oracle (no dup, no gap).  No other
+lakehouse repro proves its MOR/compaction loop under concurrent ingest +
+compaction + training with faults injected; this is ROADMAP item 4's
+"heavy traffic" claim as a measured test."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.freshness import FreshFollower, SloMonitor, ThroughputSlo
+from lakesoul_tpu.freshness.__main__ import oracle_sha
+from lakesoul_tpu.meta.entity import CommitOp, now_millis
+from lakesoul_tpu.runtime import faults
+from lakesoul_tpu.runtime.resilience import RetryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = pa.schema([("id", pa.int64()), ("seq", pa.int64()), ("v", pa.float64())])
+
+# declared SLOs for the chaos runs: generous enough for a loaded CI box,
+# tight enough that a broken follower (stuck retry loop, lost poll) fails
+FRESHNESS_TARGET_S = 10.0
+FRESHNESS_BUDGET = 0.05
+THROUGHPUT_FLOOR_ROWS_S = 100.0
+
+
+def _retry_env(monkeypatch) -> None:
+    monkeypatch.setenv("LAKESOUL_RETRY_MAX_ATTEMPTS", "10")
+    monkeypatch.setenv("LAKESOUL_RETRY_BASE_S", "0.002")
+    monkeypatch.setenv("LAKESOUL_RETRY_CAP_S", "0.02")
+    monkeypatch.setenv("LAKESOUL_RETRY_SEED", "7")
+
+
+def _follower_policy() -> RetryPolicy:
+    return RetryPolicy(
+        max_attempts=12, base_delay_s=0.002, max_delay_s=0.05, seed=7
+    )
+
+
+def _drain_until(follower, expected_rows: int, deadline_s: float, stop):
+    """Consume the follower until ``expected_rows`` rows arrived (or the
+    deadline passes); returns the delivered (seq, id, v) tuples."""
+    rows: list[tuple[int, int, float]] = []
+    deadline = time.monotonic() + deadline_s
+
+    def consume():
+        for b in follower.iter_batches():
+            seqs = b.column("seq").to_pylist()
+            ids = b.column("id").to_pylist()
+            vs = b.column("v").to_pylist()
+            rows.extend(zip(seqs, ids, vs))
+            if len(rows) >= expected_rows:
+                stop.set()
+
+    th = threading.Thread(target=consume, daemon=True)
+    th.start()
+    while th.is_alive() and time.monotonic() < deadline:
+        th.join(timeout=0.2)
+    stop.set()
+    th.join(timeout=10.0)
+    return rows
+
+
+def _write_commits(table, *, commits: int, per: int, interval_s: float,
+                   keyspace: int = 4096):
+    """In-process writer role: checkpointed CDC upserts + oracle rows."""
+    from lakesoul_tpu.streaming.cdc import CheckpointedWriter
+
+    cdc_col = table.info.cdc_column
+    w = CheckpointedWriter(table)
+    oracle: list[tuple[int, int, float]] = []
+    seq = 0
+    for ckpt in range(commits):
+        ids, seqs, vals, kinds = [], [], [], []
+        for _ in range(per):
+            id_ = seq % keyspace
+            v = float(seq % 1009) / 7.0
+            ids.append(id_)
+            seqs.append(seq)
+            vals.append(v)
+            kinds.append("insert" if seq < keyspace else "update")
+            oracle.append((seq, id_, v))
+            seq += 1
+        w.write(pa.table(
+            {"id": ids, "seq": seqs, "v": vals, cdc_col: kinds},
+            schema=table.schema,
+        ))
+        w.checkpoint(ckpt)
+        if interval_s > 0:
+            time.sleep(interval_s)
+    return oracle
+
+
+class TestThreeRolesInProcess:
+    """Tier-1 leg: all three roles in one process (writer thread, leased
+    compaction service thread, follower main thread) under p=0.3
+    flaky-store + flaky-poll faults.  Fast enough for every CI run; the
+    real-process SIGKILL variant below is the slow capstone."""
+
+    def test_freshness_and_throughput_slos_hold_under_faults(
+        self, tmp_path, monkeypatch
+    ):
+        _retry_env(monkeypatch)
+        catalog = LakeSoulCatalog(
+            str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db")
+        )
+        t = catalog.create_table(
+            "fresh", SCHEMA, primary_keys=["id"], hash_bucket_num=2, cdc=True
+        )
+        start_ts = now_millis() - 1
+        commits, per = 10, 400
+        expected = commits * per
+
+        # role 2: the leased compaction service (own catalog handle, as a
+        # separate process would hold)
+        from lakesoul_tpu.compaction.service import LeasedCompactionService
+
+        svc = LeasedCompactionService(
+            LakeSoulCatalog(str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db")),
+            service_id="inproc-compactor",
+            lease_ttl_s=5.0,
+            poll_interval_s=0.05,
+            version_gap=3,
+        )
+        svc_thread = threading.Thread(target=svc.run_forever, daemon=True)
+
+        # role 1: the writer
+        oracle: list = []
+        writer_done = threading.Event()
+
+        def write_role():
+            try:
+                oracle.extend(_write_commits(
+                    t, commits=commits, per=per, interval_s=0.05
+                ))
+            finally:
+                writer_done.set()
+
+        writer = threading.Thread(target=write_role, daemon=True)
+
+        # role 3: the follower trainer, under chaos
+        slo = SloMonitor(
+            target_s=FRESHNESS_TARGET_S,
+            budget_fraction=FRESHNESS_BUDGET,
+            slo="chaos-inproc",
+        )
+        tput = ThroughputSlo(THROUGHPUT_FLOOR_ROWS_S, slo="chaos-inproc-tput")
+        stop = threading.Event()
+        follower = FreshFollower(
+            catalog.table("fresh").scan().batch_size(2048),
+            start_timestamp_ms=start_ts,
+            poll_interval=0.05,
+            stop_event=stop,
+            retry_policy=_follower_policy(),
+            slo=slo,
+        )
+
+        faults.clear()
+        faults.install("follow.poll:0.3:flaky")
+        faults.install("object_store.cat_file:0.3:flaky")
+        faults.install("object_store.open:0.3:flaky")
+        try:
+            tput.start()
+            svc_thread.start()
+            writer.start()
+            rows = _drain_until(follower, expected, deadline_s=90.0, stop=stop)
+            tput.add_rows(len(rows))
+        finally:
+            faults.clear()
+            svc.stop()
+            stop.set()
+        writer.join(timeout=30.0)
+        svc_thread.join(timeout=10.0)
+
+        # exactly-once under fire: delivered rows == the writer's oracle
+        assert len(rows) == expected, f"delivered {len(rows)} of {expected}"
+        assert oracle_sha(rows) == oracle_sha(oracle)
+
+        # both SLOs held
+        snap = slo.snapshot()
+        assert snap["count"] >= 1
+        assert snap["in_budget"], snap
+        assert snap["p99_s"] <= FRESHNESS_TARGET_S, snap
+        out = tput.evaluate()
+        assert out["ok"], out
+
+        # the compaction loop really ran against the live table
+        versions = catalog.client.store.get_partition_versions(
+            t.info.table_id, "-5"
+        )
+        assert any(v.commit_op == CommitOp.COMPACTION for v in versions), (
+            "compaction never committed during the run"
+        )
+
+
+@pytest.mark.slow
+class TestThreeProcessSigkillChaos:
+    """The capstone: real processes for every role — ``python -m
+    lakesoul_tpu.freshness writer`` streaming upserts, the real ``python
+    -m lakesoul_tpu.compaction`` leased service SIGKILLed mid-leased-job
+    (hung on the ``compaction.leased_job`` fault point while HOLDING its
+    lease), a peer taking over with the fencing trail, and the follower
+    trainer in this process under p=0.3 flaky faults — all while both
+    SLOs must hold and delivery must match the writer's oracle."""
+
+    def test_sigkill_compactor_mid_run_slos_hold(self, tmp_path, monkeypatch):
+        _retry_env(monkeypatch)
+        wh, db = str(tmp_path / "wh"), str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table(
+            "fresh", SCHEMA, primary_keys=["id"], hash_bucket_num=2, cdc=True
+        )
+        start_ts = now_millis() - 1
+        commits, per = 15, 400
+        expected = commits * per
+        ttl_s = 2.0
+
+        base_env = dict(os.environ)
+        base_env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO,
+            "LAKESOUL_RETRY_SEED": "7",
+        })
+        victim_env = dict(base_env)
+        # the victim hangs INSIDE its leased job, holding the lease — the
+        # deterministic SIGKILL window the topology suite established
+        victim_env["LAKESOUL_FAULTS"] = "compaction.leased_job:1:hang:300"
+
+        def compactor(service_id: str, env: dict) -> subprocess.Popen:
+            return subprocess.Popen(
+                [sys.executable, "-m", "lakesoul_tpu.compaction",
+                 "--warehouse", wh, "--db-path", db,
+                 "--lease-ttl-s", str(ttl_s), "--poll-s", "0.1",
+                 "--version-gap", "3", "--service-id", service_id],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+
+        victim = compactor("victim", victim_env)
+        writer = subprocess.Popen(
+            [sys.executable, "-m", "lakesoul_tpu.freshness", "writer",
+             "--warehouse", wh, "--db-path", db, "--table", "fresh",
+             "--commits", str(commits), "--rows-per-commit", str(per),
+             "--interval-s", "0.15"],
+            env=base_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+
+        # watcher: SIGKILL the victim the moment it holds the lease, then
+        # start the peer that must take over within ~one TTL
+        store = catalog.client.store
+        lease_key = f"compaction/{t.info.table_id}/-5"
+        peer_box: dict = {}
+        killed = threading.Event()
+
+        def kill_and_replace():
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not killed.is_set():
+                if store.get_lease(lease_key) is not None:
+                    victim.send_signal(signal.SIGKILL)
+                    victim.wait(10.0)
+                    peer_box["peer"] = compactor("peer", base_env)
+                    killed.set()
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=kill_and_replace, daemon=True)
+
+        slo = SloMonitor(
+            target_s=FRESHNESS_TARGET_S,
+            budget_fraction=FRESHNESS_BUDGET,
+            slo="chaos-sigkill",
+        )
+        tput = ThroughputSlo(THROUGHPUT_FLOOR_ROWS_S, slo="chaos-sigkill-tput")
+        stop = threading.Event()
+        follower = FreshFollower(
+            catalog.table("fresh").scan().batch_size(2048),
+            start_timestamp_ms=start_ts,
+            poll_interval=0.05,
+            stop_event=stop,
+            retry_policy=_follower_policy(),
+            slo=slo,
+        )
+
+        faults.clear()
+        faults.install("follow.poll:0.3:flaky")
+        faults.install("object_store.cat_file:0.3:flaky")
+        faults.install("object_store.open:0.3:flaky")
+        try:
+            try:
+                tput.start()
+                watcher.start()
+                rows = _drain_until(
+                    follower, expected, deadline_s=120.0, stop=stop
+                )
+                tput.add_rows(len(rows))
+            finally:
+                faults.clear()
+                stop.set()
+                out, err = writer.communicate(timeout=60.0)
+                if victim.poll() is None:
+                    victim.send_signal(signal.SIGKILL)
+
+            assert writer.returncode == 0, err[-1000:]
+            oracle = json.loads(out.strip().splitlines()[-1])
+            assert oracle["rows"] == expected
+
+            # the kill really happened mid-run
+            assert killed.is_set(), "victim compactor never held a lease"
+
+            # exactly-once through the SIGKILL + faults
+            assert len(rows) == expected, f"delivered {len(rows)} of {expected}"
+            assert oracle_sha(rows) == oracle["sha256"]
+
+            # both SLOs held through the chaos
+            snap = slo.snapshot()
+            assert snap["in_budget"], snap
+            assert snap["p99_s"] <= FRESHNESS_TARGET_S, snap
+            assert tput.evaluate()["ok"]
+
+            # the (still running) peer completes the compaction with the
+            # fencing trail: token 2 proves a TAKEOVER commit, never the
+            # victim's
+            deadline = time.monotonic() + 60.0
+            fenced = []
+            while time.monotonic() < deadline:
+                versions = store.get_partition_versions(t.info.table_id, "-5")
+                fenced = [
+                    v for v in versions
+                    if v.commit_op == CommitOp.COMPACTION
+                    and v.expression.startswith("fence=")
+                ]
+                if fenced:
+                    break
+                time.sleep(0.2)
+            assert fenced, "no fenced CompactionCommit after takeover"
+            assert any(
+                int(v.expression.split("=", 1)[1]) >= 2 for v in fenced
+            ), [v.expression for v in fenced]
+        finally:
+            for p in (victim, peer_box.get("peer")):
+                if p is not None and p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+                    p.wait(10.0)
