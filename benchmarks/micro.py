@@ -16,6 +16,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py topology   # SIGKILL→takeover latency (leased compaction)
     python benchmarks/micro.py scanplane  # disaggregated scan: 8 clients, 1→4 workers
     python benchmarks/micro.py freshness  # ingest-to-train SLO under three-role chaos
+    python benchmarks/micro.py ann_scale  # sharded ANN plane: 10M x 128d build/recall/QPS
     python benchmarks/micro.py all
 """
 
@@ -1189,6 +1190,321 @@ def bench_freshness(
         )
 
 
+# ann_scale gates (env-tunable for slow boxes): the leg FAILS on a recall
+# floor breach or a serving-QPS floor breach — same discipline as the
+# scan_stages degeneracy budget.  The QPS floor is 10x the committed
+# single-shard serving baseline (~125 QPS, BENCH_r05 ann_qps_serving).
+ANN_SCALE_ROWS = int(os.environ.get("LAKESOUL_ANN_SCALE_ROWS", 10_000_000))
+ANN_SCALE_DIM = int(os.environ.get("LAKESOUL_ANN_SCALE_DIM", 128))
+ANN_SCALE_RECALL_FLOOR = float(
+    os.environ.get("LAKESOUL_ANN_SCALE_RECALL_FLOOR", 0.95)
+)
+ANN_SCALE_QPS_FLOOR = float(os.environ.get("LAKESOUL_ANN_SCALE_QPS_FLOOR", 1250.0))
+ANN_SCALE_RSS_CEILING_MB = int(
+    os.environ.get("LAKESOUL_ANN_SCALE_RSS_CEILING_MB", 4096)
+)
+ANN_SCALE_SHARD_BUDGET = int(
+    os.environ.get("LAKESOUL_ANN_SHARD_BUDGET_BYTES", 768 << 20)
+)
+
+
+def _ann_scale_corpus_chunks(n_rows: int, dim: int, chunk: int = 500_000):
+    """Deterministic clustered corpus, regenerable chunk by chunk: the exact
+    oracle streams over a SECOND generation of the same chunks instead of
+    holding 5 GB of raw vectors."""
+    rng_c = np.random.default_rng(20260801)
+    centers = (rng_c.normal(size=(4096, dim)) * 3.0).astype(np.float32)
+    for lo in range(0, n_rows, chunk):
+        n = min(chunk, n_rows - lo)
+        rng = np.random.default_rng(77_000 + lo // chunk)
+        vecs = (
+            centers[rng.integers(0, len(centers), n)]
+            + rng.normal(size=(n, dim)).astype(np.float32)
+        )
+        yield lo, vecs
+
+
+def _ann_scale_queries(dim: int, n_q: int = 64):
+    rng_c = np.random.default_rng(20260801)
+    centers = (rng_c.normal(size=(4096, dim)) * 3.0).astype(np.float32)
+    rng = np.random.default_rng(99)
+    return (
+        centers[rng.integers(0, len(centers), n_q)]
+        + rng.normal(size=(n_q, dim)).astype(np.float32)
+    )
+
+
+def _ann_serve_qps(plane, params, *, n_clients=64, per_client=64, depth=16,
+                   max_batch=1024, max_wait_ms=3.0, name="serve"):
+    """Serving QPS: ``n_clients`` threads, each pipelining ``depth`` async
+    submits (the serving pattern of a fleet of low-latency clients), through
+    ONE ragged micro-batching endpoint."""
+    import collections
+    import threading
+
+    from lakesoul_tpu.annplane import ShardedAnnEndpoint
+
+    queries = _ann_scale_queries(plane.dim, 256)
+    with ShardedAnnEndpoint(
+        plane, params, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_pending=2 * n_clients * depth, name=name,
+    ) as ep:
+        ep.search(queries[0])  # warm the dispatch path
+        start = time.perf_counter()
+
+        def client(ci):
+            inflight = collections.deque()
+            for j in range(per_client):
+                inflight.append(ep.submit(queries[(ci * 31 + j) % len(queries)]))
+                if len(inflight) >= depth:
+                    inflight.popleft().result(timeout=120)
+            while inflight:
+                inflight.popleft().result(timeout=120)
+
+        threads = [
+            threading.Thread(target=client, args=(ci,)) for ci in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        stats = ep.stats()
+    return n_clients * per_client / wall, stats
+
+
+def bench_ann_scale() -> None:
+    """The production-scale ANN leg (ROADMAP item 1): a >=10M x 128d corpus
+    written to a real LSF table, streamed through the BOUNDED scan path into
+    a memory-bounded multi-shard build (peak RSS asserted against a ceiling
+    far below the 6.6 GB resident corpus), then served at fleet shape.
+    Publishes and GATES:
+
+    - build rows/s + peak RSS <= ``LAKESOUL_ANN_SCALE_RSS_CEILING_MB``;
+    - multi-shard search recall@10 vs the streaming exact oracle
+      >= ``LAKESOUL_ANN_SCALE_RECALL_FLOOR`` (leg FAILS below, like the
+      scan_stages degeneracy budget);
+    - ragged-batched serving QPS (64 pipelined clients) >=
+      ``LAKESOUL_ANN_SCALE_QPS_FLOOR`` = 10x the committed ~125 QPS
+      single-shard baseline;
+    - the 64-client overload story at the new scale: typed sheds only;
+    - a 1/2/4-shard sweep on a 600k sub-corpus: recall held at every shard
+      count (sharding must not cost recall) with QPS per count published.
+    """
+    import pyarrow as pa
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.annplane import (
+        AnnPlane,
+        AnnPlaneConfig,
+        ShardedAnnBuilder,
+        ShardedAnnEndpoint,
+        iter_table_vectors,
+    )
+    from lakesoul_tpu.errors import OverloadedError
+    from lakesoul_tpu.utils.memory import peak_rss_mb
+    from lakesoul_tpu.vector.config import VectorIndexConfig
+    from lakesoul_tpu.vector.index import SearchParams
+    from lakesoul_tpu.vector.oracle import (
+        StreamingExactOracle,
+        exact_topk,
+        recall_at_k,
+    )
+
+    dim = ANN_SCALE_DIM
+    n_rows = ANN_SCALE_ROWS
+    queries = _ann_scale_queries(dim)
+    params = SearchParams(top_k=10, nprobe=48, rerank_depth=64)
+
+    def shard_sweep_leg() -> dict:
+        """1/2/4-shard sweep on a 600k sub-corpus: sharding must not cost
+        recall (floor enforced at EVERY count), QPS per count published.
+        Runs AFTER the 10M build so the RSS assertion sees a clean peak."""
+        import gc
+
+        sub_n = 600_000
+        sub_vecs = np.concatenate(
+            [v for _, v in _ann_scale_corpus_chunks(sub_n, dim, chunk=200_000)]
+        )
+        sub_ids = np.arange(sub_n, dtype=np.uint64)
+        sub_truth = exact_topk(sub_vecs, sub_ids, queries, 10)
+        sweep = {}
+        with tempfile.TemporaryDirectory() as d:
+            for n_shards in (1, 2, 4):
+                index_cfg = VectorIndexConfig(
+                    column="emb", dim=dim, nlist=256, total_bits=4
+                )
+                probe = AnnPlaneConfig(
+                    index=index_cfg, shard_budget_bytes=1 << 40
+                )
+                rows_per = -(-sub_n // n_shards)
+                cfg = AnnPlaneConfig(
+                    index=index_cfg,
+                    shard_budget_bytes=rows_per * probe.bytes_per_vector(),
+                )
+                root = os.path.join(d, f"plane{n_shards}")
+                ShardedAnnBuilder(root, cfg).build(
+                    (sub_vecs[lo : lo + 200_000], sub_ids[lo : lo + 200_000])
+                    for lo in range(0, sub_n, 200_000)
+                )
+                plane = AnnPlane.open(root, use_pallas=False)
+                assert len(plane.shards) == n_shards, (
+                    len(plane.shards), n_shards,
+                )
+                got, _ = plane.batch_search(queries, params)
+                recall = recall_at_k(sub_truth, got)
+                qps, _ = _ann_serve_qps(
+                    plane, params, n_clients=16, per_client=32, depth=4,
+                    name=f"sweep{n_shards}",
+                )
+                sweep[n_shards] = {
+                    "recall_at_10": round(recall, 4), "qps": round(qps, 1),
+                }
+                assert recall >= ANN_SCALE_RECALL_FLOOR, (
+                    f"{n_shards}-shard recall {recall:.4f} breached the"
+                    f" {ANN_SCALE_RECALL_FLOOR} floor"
+                )
+                del plane
+                gc.collect()
+        return sweep
+
+    # ---- the 10M leg: table write -> bounded-scan build ------------------
+    with tempfile.TemporaryDirectory() as d:
+        catalog = LakeSoulCatalog(
+            os.path.join(d, "wh"), db_path=os.path.join(d, "meta.db")
+        )
+        schema = pa.schema(
+            [("id", pa.int64()), ("emb", pa.list_(pa.float32(), dim))]
+        )
+        table = catalog.create_table(
+            "corpus", schema, properties={"lakesoul.file_format": "lsf"}
+        )
+        # peak_rss_mb is the PROCESS-lifetime high-water mark: under
+        # `micro.py all` an earlier leg may already own the peak, which
+        # would gate the wrong thing — only assert when this leg starts
+        # with clean headroom (standalone runs, the committed mode)
+        rss_at_leg_start = peak_rss_mb()
+        rss_gate_armed = rss_at_leg_start < 0.5 * ANN_SCALE_RSS_CEILING_MB
+        write_start = time.perf_counter()
+        for lo, vecs in _ann_scale_corpus_chunks(n_rows, dim):
+            table.write_arrow(pa.table({
+                "id": np.arange(lo, lo + len(vecs), dtype=np.int64),
+                "emb": pa.FixedSizeListArray.from_arrays(
+                    pa.array(vecs.reshape(-1)), dim
+                ),
+            }, schema=schema))
+        write_dt = time.perf_counter() - write_start
+
+        index_cfg = VectorIndexConfig(
+            column="emb", dim=dim, nlist=512, total_bits=4
+        )
+        cfg = AnnPlaneConfig(
+            index=index_cfg, shard_budget_bytes=ANN_SCALE_SHARD_BUDGET
+        )
+        root = os.path.join(d, "plane")
+        build_start = time.perf_counter()
+        manifest = ShardedAnnBuilder(root, cfg).build(
+            iter_table_vectors(table, "emb", "id", batch_size=262_144)
+        )
+        build_dt = time.perf_counter() - build_start
+        build_rss = peak_rss_mb()
+        assert manifest["complete"] and manifest["total_rows"] == n_rows
+        if rss_gate_armed:
+            assert build_rss <= ANN_SCALE_RSS_CEILING_MB, (
+                f"build peak RSS {build_rss:.0f} MB exceeded the declared"
+                f" {ANN_SCALE_RSS_CEILING_MB} MB ceiling (shard budget"
+                f" {ANN_SCALE_SHARD_BUDGET >> 20} MiB)"
+            )
+        else:
+            sys.stderr.write(
+                f"ann_scale: RSS gate skipped — peak was already"
+                f" {rss_at_leg_start:.0f} MB at leg start (earlier legs own"
+                " the high-water mark)\n"
+            )
+
+        # streaming exact oracle over a REGENERATION of the corpus: truth
+        # never holds more than one chunk + Q x k running best
+        oracle = StreamingExactOracle(queries, 10)
+        for lo, vecs in _ann_scale_corpus_chunks(n_rows, dim):
+            oracle.consume(vecs, np.arange(lo, lo + len(vecs), dtype=np.uint64))
+        truth = oracle.truth()
+
+        plane = AnnPlane.open(root, use_pallas=False)
+        got, _ = plane.batch_search(queries, params)
+        recall = recall_at_k(truth, got)
+        assert recall >= ANN_SCALE_RECALL_FLOOR, (
+            f"10M recall@10 {recall:.4f} breached the"
+            f" {ANN_SCALE_RECALL_FLOOR} floor"
+        )
+
+        qps, serve_stats = _ann_serve_qps(plane, params)
+        # overload at the new scale: 64 clients, tiny pending bound — every
+        # rejection must be the typed shed (anything else would have landed
+        # in errors and failed the count check)
+        import threading
+
+        ep = ShardedAnnEndpoint(
+            plane, params, max_batch=16, max_wait_ms=5.0, max_pending=32,
+            name="overload",
+        )
+        sheds = [0]
+        served = [0]
+        errors = []
+
+        def hammer(ci):
+            for j in range(16):
+                try:
+                    ep.search(queries[(ci + j) % len(queries)], timeout=120)
+                    served[0] += 1
+                except OverloadedError:
+                    sheds[0] += 1
+                except Exception as e:  # pragma: no cover — asserted below
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(ci,)) for ci in range(64)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        overload_stats = ep.stats()
+        ep.close()
+        assert not errors, errors[:3]
+        assert sheds[0] > 0, "overload hammer never tripped the pending bound"
+
+        shard_sweep = shard_sweep_leg()
+
+        _emit(
+            "ann_scale", qps, "QPS",
+            rows=n_rows,
+            dim=dim,
+            shards=len(manifest["shards"]),
+            shard_budget_mb=ANN_SCALE_SHARD_BUDGET >> 20,
+            build_rows_per_s=round(n_rows / build_dt, 1),
+            table_write_rows_per_s=round(n_rows / write_dt, 1),
+            build_peak_rss_mb=round(build_rss, 1),
+            rss_ceiling_mb=ANN_SCALE_RSS_CEILING_MB,
+            rss_gate_armed=rss_gate_armed,
+            recall_at_10=round(recall, 4),
+            recall_floor=ANN_SCALE_RECALL_FLOOR,
+            qps_floor=ANN_SCALE_QPS_FLOOR,
+            qps_vs_committed_baseline=round(qps / 125.2, 1),
+            serving_mean_batch=round(serve_stats["mean_batch"], 1),
+            serving_latency_p50_s=round(serve_stats["latency_p50"], 4),
+            serving_latency_p99_s=round(serve_stats["latency_p99"], 4),
+            nprobe=params.nprobe,
+            overload_sheds=sheds[0],
+            overload_served=served[0],
+            overload_rejected_typed=overload_stats["rejected"],
+            shard_sweep=shard_sweep,
+        )
+        assert qps >= ANN_SCALE_QPS_FLOOR, (
+            f"ragged serving {qps:.0f} QPS below the {ANN_SCALE_QPS_FLOOR}"
+            " floor (10x the committed single-shard baseline)"
+        )
+
+
 LEGS = {
     "merge": bench_merge,
     "scan_stages": bench_scan_stages,
@@ -1203,6 +1519,7 @@ LEGS = {
     "topology": bench_topology,
     "scanplane": bench_scanplane,
     "freshness": bench_freshness,
+    "ann_scale": bench_ann_scale,
 }
 
 

@@ -1,0 +1,26 @@
+"""Sharded ANN plane: memory-bounded multi-shard build, ragged query
+batching into the scoring kernels, fleet-scale QPS serving."""
+
+from lakesoul_tpu.annplane.build import (
+    ShardedAnnBuilder,
+    build_table_ann_plane,
+    iter_table_vectors,
+)
+from lakesoul_tpu.annplane.collective import cross_chip_topk, dryrun_multichip
+from lakesoul_tpu.annplane.config import AnnPlaneConfig
+from lakesoul_tpu.annplane.manifest import PlaneManifestStore
+from lakesoul_tpu.annplane.search import AnnPlane
+from lakesoul_tpu.annplane.serving import AnnPlaneBinding, ShardedAnnEndpoint
+
+__all__ = [
+    "AnnPlane",
+    "AnnPlaneBinding",
+    "AnnPlaneConfig",
+    "PlaneManifestStore",
+    "ShardedAnnBuilder",
+    "ShardedAnnEndpoint",
+    "build_table_ann_plane",
+    "cross_chip_topk",
+    "dryrun_multichip",
+    "iter_table_vectors",
+]

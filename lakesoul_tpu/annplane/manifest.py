@@ -1,0 +1,61 @@
+"""Plane-level manifest: the atomic record of a multi-shard build.
+
+Same pointer-swap discipline as the per-shard ``ManifestStore`` (vector/
+manifest.py): every progress state is written as a fresh immutable
+``plane/plane-<gen>-<seq>.json`` blob (CRC-wrapped), then the ``PLANE``
+pointer is overwritten to name it — readers either see the previous complete
+record or the new one, never a torn write.  The builder writes one record
+per persisted shard, so the newest record doubles as the resume cursor:
+``shards[-1].row_end`` is exactly how many stream rows are durably indexed."""
+
+from __future__ import annotations
+
+import json
+
+from lakesoul_tpu.io.object_store import ensure_dir, filesystem_for
+from lakesoul_tpu.vector.manifest import _crc_unwrap, _crc_wrap
+
+POINTER = "PLANE"
+
+
+class PlaneManifestStore:
+    def __init__(self, root: str, storage_options: dict | None = None):
+        self.root = root.rstrip("/")
+        self.storage_options = storage_options or {}
+        self.fs, self.root_path = filesystem_for(
+            self.root, self.storage_options, write=True
+        )
+
+    # ------------------------------------------------------------------ write
+    def write(self, manifest: dict) -> None:
+        """Persist one progress/completion record and swap the pointer."""
+        ensure_dir(f"{self.root}/plane", self.storage_options)
+        rel = (
+            f"plane/plane-{manifest['generation']}-"
+            f"{len(manifest.get('shards', ())):05d}"
+            f"{'c' if manifest.get('complete') else ''}.json"
+        )
+        self._write_blob(rel, _crc_wrap(json.dumps(manifest).encode()))
+        self._write_blob(POINTER, _crc_wrap(rel.encode()))
+
+    def _write_blob(self, rel: str, data: bytes) -> None:
+        with self.fs.open(f"{self.root_path}/{rel}", "wb") as f:
+            f.write(data)
+
+    # ------------------------------------------------------------------- read
+    def read(self) -> dict | None:
+        """Newest durable record, or None when the plane was never written.
+        A corrupt pointer or record raises (CRC mismatch is damage, not
+        absence — silently restarting a 10M-row build hides it)."""
+        try:
+            with self.fs.open(f"{self.root_path}/{POINTER}", "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        rel = _crc_unwrap(blob, POINTER).decode()
+        with self.fs.open(f"{self.root_path}/{rel}", "rb") as f:
+            payload = f.read()
+        return json.loads(_crc_unwrap(payload, rel))
+
+    def exists(self) -> bool:
+        return self.fs.exists(f"{self.root_path}/{POINTER}")

@@ -63,6 +63,16 @@ def _bind(lib) -> None:
     ]
     lib.ls_bitpack64.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, u8p]
     lib.ls_bitunpack64.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i64p]
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.ls_ann_ragged_topk.argtypes = [
+        f32p, f32p, f32p, f32p, i64p, i64p, f32p,
+        ctypes.c_int64, ctypes.c_int64,
+        i32p, i64p, ctypes.c_int64, i32p, f32p, f32p,
+        ctypes.c_int64, f32p, i64p,
+    ]
+    lib.ls_ann_exact_rerank.argtypes = [
+        f32p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64, f32p, f32p,
+    ]
 
 
 def get_lib():
@@ -308,6 +318,51 @@ def bitunpack64(buf: np.ndarray, n: int, base: int, width: int) -> np.ndarray:
     deltas = (bits << shifts[None, :]).sum(axis=1, dtype=np.uint64)
     base_u = np.uint64(base & 0xFFFFFFFFFFFFFFFF)  # two's complement bits
     return (deltas + base_u).view(np.int64).copy()
+
+
+def ann_ragged_topk(
+    codes: np.ndarray, a: np.ndarray, b: np.ndarray, h: np.ndarray | None,
+    row_start: np.ndarray, row_count: np.ndarray, q_glob: np.ndarray,
+    grp_cluster: np.ndarray, grp_off: np.ndarray,
+    pair_query: np.ndarray, pair_csq: np.ndarray, pair_csum: np.ndarray | None,
+    s: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged ANN estimator scan + per-query top-``s`` (annplane hot path).
+    One GIL-released call per shard; returns (rows [m, s] with -1 holes,
+    est [m, s] with +inf holes), shortlist order unspecified."""
+    lib = get_lib()
+    m = len(q_glob)
+    d = q_glob.shape[1]
+    out_est = np.full((m, s), np.inf, np.float32)
+    out_rows = np.full((m, s), -1, np.int64)
+    f32 = ctypes.c_float
+    lib.ls_ann_ragged_topk(
+        _ptr(codes, f32), _ptr(a, f32), _ptr(b, f32),
+        _ptr(h, f32) if h is not None else None,
+        _ptr(row_start, ctypes.c_int64), _ptr(row_count, ctypes.c_int64),
+        _ptr(q_glob, f32), m, d,
+        _ptr(grp_cluster, ctypes.c_int32), _ptr(grp_off, ctypes.c_int64),
+        len(grp_cluster),
+        _ptr(pair_query, ctypes.c_int32), _ptr(pair_csq, f32),
+        _ptr(pair_csum, f32) if pair_csum is not None else None,
+        s, _ptr(out_est, f32), _ptr(out_rows, ctypes.c_int64),
+    )
+    return out_rows, out_est
+
+
+def ann_exact_rerank(
+    raw: np.ndarray, rows: np.ndarray, queries: np.ndarray
+) -> np.ndarray:
+    """Exact squared-L2 re-rank of shortlisted rows (rows < 0 → +inf)."""
+    lib = get_lib()
+    m, s = rows.shape
+    out = np.empty((m, s), np.float32)
+    lib.ls_ann_exact_rerank(
+        _ptr(raw, ctypes.c_float), raw.shape[1],
+        _ptr(rows, ctypes.c_int64), m, s,
+        _ptr(queries, ctypes.c_float), _ptr(out, ctypes.c_float),
+    )
+    return out
 
 
 def pack_bits(bits: np.ndarray) -> np.ndarray:

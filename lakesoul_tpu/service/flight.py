@@ -179,6 +179,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         max_inflight: int | None = None,
         max_queue: int | None = None,
         scanplane=None,
+        ann_planes: dict | None = None,
     ):
         self.catalog = catalog
         # scan-plane delivery (DoExchange "scan_stream"): a configured
@@ -186,6 +187,11 @@ class LakeSoulFlightServer(flight.FlightServerBase):
         # same-host shm fast path); None = lazily-built inline delivery, so
         # a plain gateway still serves remote scans with zero fleet setup
         self.scanplane = scanplane
+        # sharded ANN serving (action "ann_search"): plane name →
+        # AnnPlaneBinding(endpoint, namespace, table); requests RBAC-check
+        # against the indexed table and ride the endpoint's ragged
+        # micro-batching behind the same admission gate as every action
+        self.ann_planes = dict(ann_planes or {})
         self.jwt_server = JwtServer(jwt_secret) if jwt_secret else None
         self.user_registry = UserRegistry(catalog.client)
         self.rbac = RbacVerifier(catalog.client)
@@ -580,6 +586,49 @@ class LakeSoulFlightServer(flight.FlightServerBase):
                     ).encode()
                 )
             ]
+        if action.type == "ann_search":
+            # fleet-scale ANN over a sharded plane: the query joins the
+            # ShardedAnnEndpoint's current micro-batch (ragged dispatch), so
+            # concurrent gateway callers share one scoring pass per shard;
+            # a full pending queue sheds typed → UNAVAILABLE, like every
+            # other overload in this gateway
+            name = body.get("plane")
+            binding = self.ann_planes.get(name)
+            if binding is None:
+                raise flight.FlightServerError(f"unknown ann plane {name!r}")
+            self._check(context, binding.namespace, binding.table)
+            nprobe = body.get("nprobe")
+            top_k = body.get("top_k")
+            try:
+                queries = np.asarray(
+                    body["queries"] if "queries" in body else body["query"],
+                    dtype=np.float32,
+                )
+                single = queries.ndim == 1
+                if single:
+                    queries = queries[None, :]
+                # submit() validates each query's dim against the plane, so
+                # a malformed request fails HERE, typed — never inside the
+                # shared micro-batch where it would take batch-mates down
+                futs = [
+                    binding.endpoint.submit(q, nprobe=nprobe) for q in queries
+                ]
+            except OverloadedError as e:
+                raise flight.FlightUnavailableError(str(e)) from e
+            except ValueError as e:
+                raise flight.FlightServerError(f"bad ann_search query: {e}")
+            out = []
+            for fut in futs:
+                ids, dists = fut.result(timeout=120)
+                if top_k is not None:
+                    ids, dists = ids[: int(top_k)], dists[: int(top_k)]
+                out.append({
+                    "ids": [int(i) for i in ids],
+                    "distances": [float(x) for x in dists],
+                })
+            return [
+                flight.Result(json.dumps(out[0] if single else out).encode())
+            ]
         if action.type == "sql":
             # statement execution, Flight-SQL style: result as Arrow IPC bytes
             from lakesoul_tpu.sql import SqlSession
@@ -613,6 +662,7 @@ class LakeSoulFlightServer(flight.FlightServerBase):
             ("metrics", "server stream metrics snapshot"),
             ("sql", "execute a SQL statement; body: {statement, namespace?}"),
             ("vector_search", "ANN top-k; body: {table, column, query, top_k?, nprobe?, partitions?, namespace?}"),
+            ("ann_search", "sharded-plane ANN top-k; body: {plane, query | queries, top_k?, nprobe?}"),
             ("metrics_prometheus", "metrics in Prometheus exposition format"),
             ("data_assets", "per-table asset statistics as Arrow IPC"),
             ("login", "exchange authenticated identity for a bearer token"),

@@ -35,8 +35,14 @@ def extract_vectors(
     """FixedSizeList<f32>/List<f32> column + integer PK column → (vectors, ids)
     (reference: extract_vector_batch, vector/reader.rs:25)."""
     col = table.column(column).combine_chunks()
-    if isinstance(col, pa.ChunkedArray):
-        col = col.combine_chunks()
+    if col.null_count:
+        # a null row contributes no child values (variable lists) or garbage
+        # slots (fixed), so col.values would silently misalign against ids —
+        # fail typed instead of returning a corrupted index
+        raise VectorIndexError(
+            f"vector column {column!r} contains {col.null_count} null row(s);"
+            " null vectors cannot be indexed — filter or fill them first"
+        )
     t = col.type
     if pa.types.is_fixed_size_list(t):
         if t.list_size != dim:

@@ -483,20 +483,12 @@ class IvfRabitqIndex:
             raise ConfigError("tune_nprobe on an empty index")
         base = np.concatenate(raws)
         base_ids = np.concatenate(id_chunks)
-        queries = np.asarray(queries, np.float32)
-        if len(queries) > max_queries:
-            rng = np.random.default_rng(self.config.seed)
-            queries = queries[rng.choice(len(queries), max_queries, replace=False)]
-        # exact ground truth: top_k by L2 (matches the search metric) — ONE
-        # batched gram matmul for all queries, not a per-query base pass
-        d2 = (
-            np.sum(queries**2, axis=1, keepdims=True)
-            - 2.0 * queries @ base.T
-            + np.sum(base**2, axis=1)[None, :]
-        )
-        k_eff = min(top_k, d2.shape[1])
-        part = np.argpartition(d2, k_eff - 1, axis=1)[:, :k_eff]
-        truth = [set(base_ids[row].tolist()) for row in part]
+        from lakesoul_tpu.vector.oracle import exact_topk, recall_at_k, subsample_queries
+
+        # exact ground truth: top_k by L2 (matches the search metric) via the
+        # shared recall oracle — ONE batched gram matmul for all queries
+        queries = subsample_queries(queries, max_queries, self.config.seed)
+        truth = exact_topk(base, base_ids, queries, top_k)
         nlist = len(self.clusters)
         if candidates is None:
             candidates, p = [], 1
@@ -511,14 +503,10 @@ class IvfRabitqIndex:
                 top_k=top_k, nprobe=nprobe, rerank_depth=rerank_depth
             )
             got_ids, _ = self.batch_search(queries, params)
-            hits = sum(
-                len(truth[i] & {int(x) for x in got_ids[i]})
-                for i in range(len(queries))
-            )
             # denominator = achievable hits (a small index or duplicate ids
             # can make the truth sets smaller than top_k; perfect search
             # must be able to reach recall 1.0)
-            recall = hits / max(1, sum(len(t) for t in truth))
+            recall = recall_at_k(truth, got_ids)
             measured.append((nprobe, recall))
             if best is None and recall >= target_recall:
                 best = (nprobe, recall)

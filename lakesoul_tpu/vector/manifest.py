@@ -118,8 +118,21 @@ class ManifestStore:
         mpath = _crc_unwrap(self._read_blob(LATEST), "LATEST").decode()
         return json.loads(_crc_unwrap(self._read_blob(mpath), mpath))
 
+    def read_manifest_at(self, generation: int) -> dict:
+        """A PINNED generation's manifest, bypassing the LATEST pointer —
+        manifests are immutable once written, so a reader holding a
+        generation number (the ANN plane's per-shard records) is immune to
+        a concurrent rebuild swapping LATEST underneath it."""
+        mpath = f"manifests/manifest-{generation}.json"
+        return json.loads(_crc_unwrap(self._read_blob(mpath), mpath))
+
+    def read_at(self, generation: int) -> IvfRabitqIndex:
+        return self._load(self.read_manifest_at(generation))
+
     def read_latest(self) -> IvfRabitqIndex:
-        manifest = self.read_manifest()
+        return self._load(self.read_manifest())
+
+    def _load(self, manifest: dict) -> IvfRabitqIndex:
         config = VectorIndexConfig.parse(manifest["config"])
         index = IvfRabitqIndex(config)
         index.keep_raw = manifest["keep_raw"]

@@ -53,9 +53,11 @@ class AnnEndpoint:
         max_batch: int = 256,
         max_wait_ms: float = 2.0,
         max_pending: int | None = None,
+        name: str = "default",
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        self.name = name
         self.index = index
         self.params = params or SearchParams()
         self.max_batch = max_batch
@@ -65,7 +67,10 @@ class AnnEndpoint:
         )
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._pending: list[tuple[np.ndarray, Future, float]] = []
+        # (query, extra, future, submit time): ``extra`` carries per-request
+        # parameters subclasses thread through to their batch execution (the
+        # sharded endpoint's per-query nprobe); the base endpoint passes None
+        self._pending: list[tuple[np.ndarray, object, Future, float]] = []
         self._closed = False
         self._n_requests = 0
         self._n_rejected = 0
@@ -74,7 +79,13 @@ class AnnEndpoint:
         reg = registry()
         self._c_requests = reg.counter("lakesoul_ann_requests_total")
         self._c_rejected = reg.counter("lakesoul_ann_rejected_total")
-        self._h_latency = reg.histogram("lakesoul_ann_request_seconds")
+        # latency carries an endpoint= label so stats() quantiles stay
+        # per-endpoint: several endpoints in one process (serving + overload
+        # hammer + shard sweeps in the bench) must not contaminate each
+        # other's p50/p99 through the name-keyed registry
+        self._h_latency = reg.histogram(
+            "lakesoul_ann_request_seconds", endpoint=name
+        )
         self._g_pending = reg.gauge("lakesoul_ann_pending")
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -83,6 +94,9 @@ class AnnEndpoint:
     def submit(self, query: np.ndarray) -> Future:
         """Enqueue one query; the Future resolves to (ids, dists).  Raises
         :class:`OverloadedError` when the bounded pending queue is full."""
+        return self._submit(query, None)
+
+    def _submit(self, query: np.ndarray, extra) -> Future:
         q = np.asarray(query, dtype=np.float32)
         if q.ndim != 1:
             raise ValueError("submit() takes a single [d] query")
@@ -102,7 +116,7 @@ class AnnEndpoint:
                     f"ann endpoint overloaded ({len(self._pending)} queued,"
                     f" bound {self.max_pending}); retry later"
                 )
-            self._pending.append((q, fut, time.monotonic()))
+            self._pending.append((q, extra, fut, time.monotonic()))
             self._n_requests += 1
             self._c_requests.inc()
             self._g_pending.inc()
@@ -114,6 +128,11 @@ class AnnEndpoint:
         return self.submit(query).result(timeout)
 
     def stats(self) -> dict:
+        # latency quantiles come straight from the registry histogram
+        # (Histogram.quantile), so callers stop digging through snapshot
+        # buckets; the histogram takes its own lock, so read it outside ours
+        p50 = self._h_latency.quantile(0.5)
+        p99 = self._h_latency.quantile(0.99)
         with self._lock:
             return {
                 "requests": self._n_requests,
@@ -124,6 +143,8 @@ class AnnEndpoint:
                 "mean_batch": (
                     self._n_batched_requests / self._n_batches if self._n_batches else 0.0
                 ),
+                "latency_p50": p50,
+                "latency_p99": p99,
             }
 
     def close(self) -> None:
@@ -140,7 +161,14 @@ class AnnEndpoint:
         self.close()
 
     # --------------------------------------------------------------- worker
-    def _take_batch(self) -> list[tuple[np.ndarray, Future]]:
+    def _execute(self, queries: list[np.ndarray], extras: list):
+        """Run ONE fused batch; returns (ids_list, dists_list) aligned with
+        the inputs.  Subclasses override to route the batch elsewhere (the
+        sharded endpoint fuses ``extras`` — per-query nprobe — into one
+        ragged multi-shard dispatch)."""
+        return self.index.batch_search(np.stack(queries), self.params)
+
+    def _take_batch(self) -> list[tuple[np.ndarray, object, Future, float]]:
         """Block until work exists, then hold the window open for stragglers
         up to max_wait_s (or until max_batch queue up)."""
         with self._wake:
@@ -167,10 +195,11 @@ class AnnEndpoint:
             # everything below is fenced: the worker must survive ANY per-
             # batch failure (a dead worker would hang every future request)
             try:
-                queries = np.stack([q for q, _, _ in batch])
-                ids, dists = self.index.batch_search(queries, self.params)
+                ids, dists = self._execute(
+                    [q for q, _, _, _ in batch], [e for _, e, _, _ in batch]
+                )
             except Exception as e:  # fan the failure out to every waiter
-                for _, fut, _ in batch:
+                for _, _, fut, _ in batch:
                     try:
                         fut.set_exception(e)
                     except Exception:  # cancelled/raced: nobody is waiting
@@ -180,7 +209,7 @@ class AnnEndpoint:
                 self._n_batches += 1
                 self._n_batched_requests += len(batch)
             done = time.monotonic()
-            for i, (_, fut, submitted) in enumerate(batch):
+            for i, (_, _, fut, submitted) in enumerate(batch):
                 self._h_latency.observe(done - submitted)
                 try:
                     fut.set_result((ids[i], dists[i]))

@@ -642,7 +642,7 @@ class TestOverload:
         from lakesoul_tpu.vector.serving import AnnEndpoint
 
         before = registry().snapshot().get(
-            "lakesoul_ann_request_seconds", {"count": 0}
+            'lakesoul_ann_request_seconds{endpoint="default"}', {"count": 0}
         )["count"]
         ep = AnnEndpoint(
             _SlowIndex(), max_batch=4, max_wait_ms=1.0, max_pending=8
@@ -679,7 +679,9 @@ class TestOverload:
             assert stats["rejected"] == results["shed"]
             assert stats["pending"] <= stats["max_pending"] == 8
             # p50/p99 latency live in the shared obs registry
-            series = registry().snapshot()["lakesoul_ann_request_seconds"]
+            series = registry().snapshot()[
+                'lakesoul_ann_request_seconds{endpoint="default"}'
+            ]
             assert series["count"] - before == results["ok"]
             p50 = _histogram_percentile(series, 0.5)
             p99 = _histogram_percentile(series, 0.99)

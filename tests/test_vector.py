@@ -231,6 +231,48 @@ class TestManifest:
             store.read_latest()
 
 
+class TestExtractVectors:
+    def test_null_rows_raise_typed_naming_column(self):
+        from lakesoul_tpu.vector.builder import extract_vectors
+
+        dim = 4
+        table = pa.table({
+            "id": pa.array([1, 2, 3], pa.int64()),
+            "emb": pa.array([[1.0] * dim, None, [3.0] * dim],
+                            pa.list_(pa.float32())),
+        })
+        # a null row would silently misalign col.values against ids
+        with pytest.raises(VectorIndexError, match="'emb'.*null"):
+            extract_vectors(table, "emb", "id", dim)
+
+    def test_null_fixed_size_list_raises_too(self):
+        from lakesoul_tpu.vector.builder import extract_vectors
+
+        dim = 2
+        arr = pa.FixedSizeListArray.from_arrays(
+            pa.array([1.0, 2.0, 3.0, 4.0], pa.float32()), dim
+        )
+        table = pa.table({
+            "id": pa.array([1, 2], pa.int64()),
+            "emb": arr.take(pa.array([0, None], pa.int32())),
+        })
+        with pytest.raises(VectorIndexError, match="null"):
+            extract_vectors(table, "emb", "id", dim)
+
+    def test_clean_column_round_trips(self):
+        from lakesoul_tpu.vector.builder import extract_vectors
+
+        dim = 3
+        vals = np.arange(12, dtype=np.float32).reshape(4, dim)
+        table = pa.table({
+            "id": pa.array(np.arange(4), pa.int64()),
+            "emb": pa.FixedSizeListArray.from_arrays(vals.reshape(-1), dim),
+        })
+        v, i = extract_vectors(table, "emb", "id", dim)
+        np.testing.assert_array_equal(v, vals)
+        np.testing.assert_array_equal(i, np.arange(4))
+
+
 class TestTableIntegration:
     def test_e2e_build_and_search(self, tmp_warehouse):
         from lakesoul_tpu import LakeSoulCatalog
@@ -649,6 +691,8 @@ class TestAsyncAndServing:
             stats = ep.stats()
         assert stats["requests"] == 32
         assert stats["batches"] >= 1
+        # registry-histogram latency quantiles surface directly in stats
+        assert stats["latency_p99"] >= stats["latency_p50"] >= 0.0
 
     def test_endpoint_concurrent_clients(self):
         import threading
