@@ -17,6 +17,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py scanplane  # disaggregated scan: 8 clients, 1→4 workers
     python benchmarks/micro.py freshness  # ingest-to-train SLO under three-role chaos
     python benchmarks/micro.py ann_scale  # sharded ANN plane: 10M x 128d build/recall/QPS
+    python benchmarks/micro.py tensor_replay # epoch-1 stream vs epoch-2 device replay (8-dev mesh)
     python benchmarks/micro.py all
 """
 
@@ -1505,6 +1506,160 @@ def bench_ann_scale() -> None:
         )
 
 
+# tensor_replay gate: epoch-2 device replay must beat epoch-1 streaming by
+# this factor (byte-identity asserted separately).  Replay serves pinned
+# device shards — no decode, no collate, no put — so the measured margin is
+# an order of magnitude; 2.0 is the declared floor a regression (a host
+# round trip sneaking into the replay path, accidental re-collate) trips.
+TENSOR_REPLAY_FLOOR = float(os.environ.get("LAKESOUL_TENSOR_REPLAY_FLOOR", 2.0))
+
+
+def _tensor_replay_child() -> None:
+    """Runs in a subprocess with an 8-device CPU mesh (XLA_FLAGS must be
+    set BEFORE jax imports, so the parent leg spawns this).  Prints one
+    JSON result line."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — force backend init under the flags
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.tensorplane import tensor_field
+    from lakesoul_tpu.tensorplane.smoke import run_smoke
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"mesh leg needs 8 devices, got {len(devices)}"
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+
+    n_rows, width, batch = 131_072, 64, 1_024
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        catalog = LakeSoulCatalog(d)
+        schema = pa.schema([
+            ("id", pa.int64()),
+            tensor_field("emb", (width,), "float32"),
+            ("label", pa.int32()),
+        ])
+        t = catalog.create_table(
+            "tensors", schema, properties={"lakesoul.file_format": "lsf"}
+        )
+        for lo in range(0, n_rows, 32_768):
+            n = min(32_768, n_rows - lo)
+            emb = rng.normal(size=(n, width)).astype(np.float32)
+            t.write_arrow(pa.table({
+                "id": np.arange(lo, lo + n, dtype=np.int64),
+                "emb": pa.FixedSizeListArray.from_arrays(
+                    pa.array(emb.ravel()), width
+                ).cast(schema.field("emb").type),
+                "label": rng.integers(0, 10, n).astype(np.int32),
+            }, schema=schema))
+
+        def epoch_rows_per_s(it) -> tuple[float, int]:
+            start = time.perf_counter()
+            rows = 0
+            last = None
+            for b in it:
+                rows += b["emb"].shape[0]
+                last = b
+            jax.block_until_ready(last)
+            return rows / (time.perf_counter() - start), rows
+
+        def epoch_hashes(it) -> list[str]:
+            out = []
+            for b in it:
+                h = hashlib.sha256()
+                for k in sorted(b):
+                    h.update(np.asarray(b[k]).tobytes())
+                out.append(h.hexdigest())
+            return out
+
+        # --- fully-resident leg: epoch-1 stream (+pin) vs epoch-2 replay
+        it = t.scan().batch_size(batch).to_jax_iter(
+            cache="device", sharding=sharding
+        )
+        stream_rps, rows1 = epoch_rows_per_s(it)
+        assert it.stats()["replay"]["ready"]
+        replay_rps, rows2 = epoch_rows_per_s(it)
+        assert rows1 == rows2 == n_rows
+        # byte-identity: a third (replay) epoch vs a freshly streamed loader
+        replay_sha = epoch_hashes(it)
+        stream_sha = epoch_hashes(
+            t.scan().batch_size(batch).to_jax_iter(sharding=sharding)
+        )
+        assert replay_sha == stream_sha, "replay diverged from stream"
+
+        # --- budget-spill leg: half the epoch resident, tail re-streamed.
+        # The budget is PER DEVICE: a dp-sharded batch bills each of the 8
+        # chips an eighth of its host bytes
+        per_batch_dev = batch * (width * 4 + 4 + 4) // 8
+        budget = (n_rows // batch // 2) * per_batch_dev + 64
+        it_sp = t.scan().batch_size(batch).to_jax_iter(
+            cache="device", sharding=sharding, replay_budget_bytes=budget
+        )
+        spill_stream_rps, _ = epoch_rows_per_s(it_sp)
+        st = it_sp.stats()["replay"]
+        assert st["spilled"], st
+        hybrid_rps, rows_h = epoch_rows_per_s(it_sp)
+        assert rows_h == n_rows
+        assert epoch_hashes(it_sp) == stream_sha, "hybrid epoch diverged"
+
+        smoke = run_smoke()
+        print(json.dumps({
+            "rows": n_rows,
+            "tensor_width": width,
+            "batch": batch,
+            "devices": len(devices),
+            "stream_rows_per_s": round(stream_rps, 1),
+            "replay_rows_per_s": round(replay_rps, 1),
+            "replay_over_stream": round(replay_rps / stream_rps, 2),
+            "spill_resident_batches": st["resident_batches"],
+            "spill_budget_bytes": budget,
+            "hybrid_rows_per_s": round(hybrid_rps, 1),
+            "hybrid_over_stream": round(hybrid_rps / spill_stream_rps, 2),
+            "byte_identity": True,
+            "tpu_smoke": {
+                "platform": smoke["platform"],
+                "ok": smoke["ok"],
+                "untested_on_tpu": smoke["untested_on_tpu"],
+                "uncovered_kernels": smoke["kernel_enumeration"]["uncovered"],
+            },
+        }))
+
+
+def bench_tensor_replay() -> None:
+    """Epoch-1 streaming delivery vs epoch-2 device-resident replay on the
+    8-device CPU mesh (tensorplane/replay.py), with byte-identity asserted
+    per batch, a budget-spill hybrid variant, and the TPU-smoke fallback
+    record published.  FAILS when replay does not beat streaming by
+    ``TENSOR_REPLAY_FLOOR``."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "_tensor_replay_child"],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    ratio = result["replay_over_stream"]
+    _emit(
+        "tensor_replay", result["replay_rows_per_s"], "rows/s",
+        floor=TENSOR_REPLAY_FLOOR, **result,
+    )
+    assert ratio >= TENSOR_REPLAY_FLOOR, (
+        f"epoch-2 replay beat streaming only {ratio:.2f}x — below the"
+        f" declared {TENSOR_REPLAY_FLOOR} floor"
+    )
+    assert result["byte_identity"]
+    assert result["tpu_smoke"]["ok"], "smoke register failed on fallback"
+
+
 LEGS = {
     "merge": bench_merge,
     "scan_stages": bench_scan_stages,
@@ -1520,6 +1675,7 @@ LEGS = {
     "scanplane": bench_scanplane,
     "freshness": bench_freshness,
     "ann_scale": bench_ann_scale,
+    "tensor_replay": bench_tensor_replay,
 }
 
 
@@ -1556,6 +1712,9 @@ def _emit_obs(leg: str, before: dict) -> None:
 
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which == "_tensor_replay_child":
+        _tensor_replay_child()  # subprocess arm of the tensor_replay leg
+        return
     legs = list(LEGS) if which == "all" else [which]
     for leg in legs:
         before = _obs_snapshot()

@@ -37,6 +37,7 @@ from lakesoul_tpu.analysis.rules.races import (
     RacyCheckThenActRule,
     SharedStateRaceRule,
 )
+from lakesoul_tpu.analysis.rules.replay import ReplayHostRoundtripRule
 from lakesoul_tpu.analysis.rules.jaxtpu import (
     JitStaticArgShapeRule,
     PallasBlockSpecRule,
@@ -73,6 +74,7 @@ def all_rules() -> list[Rule]:
         HotPathMaterializeRule(),
         RawProcessRule(),
         UnstoppableLoopRule(),
+        ReplayHostRoundtripRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

@@ -20,11 +20,16 @@ corrupt training data.  Two rules pin it:
   borrower then outlives the slot and reads bytes a later window already
   overwrote.
 - ``ring-aliasing``: every ``_BufferRing(...)`` construction must sit
-  under a guard that excludes ``cache='device'``.  The device-resident
-  epoch KEEPS every delivered batch, and on host-backed jax devices
-  ``device_put`` may alias the host buffer — a ring under that mode would
-  overwrite the cached epoch in place.  The exclusion lives in one ``if``
-  today; this rule keeps any future ring construction honest.
+  under a guard that either excludes ``cache='device'`` or consults the
+  tensor plane's MEASURED aliasing probe
+  (``delivery_copies(...)``/``device_put_copies(...)``,
+  tensorplane/dlpack.py).  The device-resident epoch KEEPS every
+  delivered batch, and an aliasing ``device_put`` borrows the host
+  buffer — a ring under either condition would overwrite live data in
+  place.  The probe is the sanctioned hand-off: when every column's put
+  is a real copy, slot reuse cannot touch delivered (or cached) data, so
+  a probe-guarded ring is sound on any backend.  The guard lives in one
+  ``if`` today; this rule keeps any future ring construction honest.
 
 The runtime half (``analysis/racecheck.py``) closes what the lexical
 rules cannot see: its ring canary checks, at each slot hand-out, that no
@@ -186,7 +191,13 @@ class ViewEscapesReleaseRule(Rule):
 
 class RingAliasingRule(Rule):
     id = "ring-aliasing"
-    title = "_BufferRing built without the cache='device' exclusion"
+    title = "_BufferRing built without an aliasing guard"
+
+    # guard calls that measure aliasing for real (tensorplane/dlpack.py):
+    # a ring under `if delivery_copies(...)` only arms when every column's
+    # device_put is a genuine copy, which is strictly safer than the
+    # lexical cache!='device' exclusion
+    _PROBE_GUARDS = frozenset({"delivery_copies", "device_put_copies"})
 
     def __init__(self, scope: tuple = SCOPE):
         self.scope = scope
@@ -201,31 +212,67 @@ class RingAliasingRule(Rule):
             name = dotted_name(node.func)
             if (name or "").rsplit(".", 1)[-1] != _RING_CTOR:
                 continue
-            if self._device_guarded(node, parents):
+            if self._aliasing_guarded(node, parents):
                 continue
             yield Finding(
                 self.id,
                 module.relpath,
                 node.lineno,
-                "_BufferRing(...) constructed without a guard excluding "
-                "cache='device' — the device-resident epoch keeps every "
-                "delivered batch and device_put may alias host buffers, so "
-                "a reuse ring would overwrite the cached epoch in place",
+                "_BufferRing(...) constructed without an aliasing guard — "
+                "either the cache='device' exclusion or the measured "
+                "delivery_copies(...) probe: the device-resident epoch "
+                "keeps every delivered batch and an aliasing device_put "
+                "borrows host buffers, so an unguarded reuse ring would "
+                "overwrite live data in place",
             )
 
-    @staticmethod
-    def _device_guarded(call: ast.Call, parents) -> bool:
+    @classmethod
+    def _aliasing_guarded(cls, call: ast.Call, parents) -> bool:
+        prev: ast.AST = call
         node: ast.AST = call
         while node in parents:
-            node = parents[node]
+            prev, node = node, parents[node]
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 return False
             test = None
             if isinstance(node, (ast.If, ast.IfExp)):
                 test = node.test
-            if test is not None and any(
+            if test is None:
+                continue
+            if any(
                 isinstance(sub, ast.Constant) and sub.value == "device"
                 for sub in ast.walk(test)
             ):
                 return True
+            # probe guard: only sanctioned when the probe's TRUTH selects
+            # the ring — the ctor must sit in the if-BODY and the probe
+            # call must not be negated; `if not delivery_copies(...):` (or
+            # building the ring in the else branch) is the inverted-guard
+            # bug this rule exists to catch, not a guard
+            if cls._in_if_body(node, prev) and cls._unnegated_probe(test):
+                return True
+        return False
+
+    @staticmethod
+    def _in_if_body(branch: ast.AST, child: ast.AST) -> bool:
+        if isinstance(branch, ast.If):
+            return any(child is stmt for stmt in branch.body)
+        if isinstance(branch, ast.IfExp):
+            return child is branch.body
+        return False
+
+    @classmethod
+    def _unnegated_probe(cls, test: ast.expr) -> bool:
+        negated: set = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.UnaryOp) and isinstance(sub.op, ast.Not):
+                negated.update(
+                    n for n in ast.walk(sub.operand) if isinstance(n, ast.Call)
+                )
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call) and sub not in negated:
+                name = dotted_name(sub.func)
+                if name is not None and \
+                        name.rsplit(".", 1)[-1] in cls._PROBE_GUARDS:
+                    return True
         return False

@@ -38,6 +38,7 @@ import pyarrow as pa
 from lakesoul_tpu.obs import registry
 from lakesoul_tpu.obs.stages import stage_histogram
 from lakesoul_tpu.runtime import pipeline as rt_pipeline
+from lakesoul_tpu.tensorplane.dlpack import aligned_empty, delivery_copies
 
 
 class LoaderStats:
@@ -185,15 +186,22 @@ def _is_stringlike(t: pa.DataType) -> bool:
     )
 
 
-def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
+def _default_collate(
+    batch: pa.RecordBatch | pa.Table,
+    tensor_shapes: "dict[str, tuple[int, ...]] | None" = None,
+) -> dict[str, np.ndarray]:
     """Arrow → dict of numpy arrays (zero-copy where possible).  Fixed-width
     columns map directly; ``fixed_size_list`` tensor columns (token rows,
-    image pixels) collate to real 2-D fixed-width arrays; strings stay as
-    object arrays (caller should tokenize/encode upstream for TPU
-    consumption).  Anything that only lowers to dtype=object (variable
-    lists, structs, maps) fails LOUDLY: the old object-array fallback
-    survived until ``jax.device_put`` rejected the batch deep inside the
-    pipeline, with no hint of which column was responsible."""
+    image pixels) collate to real fixed-width arrays — 2-D by default, or
+    the full declared logical shape when the loader resolved one from the
+    table's tensor declarations (``tensor_shapes``, computed ONCE per
+    loader from the projected schema instead of re-probing Arrow types per
+    batch); strings stay as object arrays (caller should tokenize/encode
+    upstream for TPU consumption).  Anything that only lowers to
+    dtype=object (variable lists, structs, maps) fails LOUDLY: the old
+    object-array fallback survived until ``jax.device_put`` rejected the
+    batch deep inside the pipeline, with no hint of which column was
+    responsible."""
     from lakesoul_tpu.errors import ConfigError
 
     out: dict[str, np.ndarray] = {}
@@ -205,7 +213,8 @@ def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
             width = col.type.list_size
             flat = arr.flatten().to_numpy(zero_copy_only=False)
             if flat.dtype != object and len(flat) == len(arr) * width:
-                out[name] = flat.reshape(len(arr), width)
+                shape = (tensor_shapes or {}).get(name) or (width,)
+                out[name] = flat.reshape((len(arr),) + tuple(shape))
                 continue
         try:
             arr = col.to_numpy(zero_copy_only=False)
@@ -227,11 +236,17 @@ def _default_collate(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
     return out
 
 
-def _np_column_views(batch: pa.RecordBatch) -> dict[str, np.ndarray] | None:
+def _np_column_views(
+    batch: pa.RecordBatch,
+    tensor_shapes: "dict[str, tuple[int, ...]] | None" = None,
+) -> dict[str, np.ndarray] | None:
     """Zero-copy per-column numpy views of one record batch, or None when any
     column cannot be viewed without conversion (nulls, strings/objects,
     bit-packed bools, variable nesting) — the window then falls back to the
-    arrow-table collate path, which handles those exactly as before."""
+    arrow-table collate path, which handles those exactly as before.
+    Declared tensor columns view straight to their logical shape
+    (``(rows, *shape)``): the declaration was resolved once per loader, so
+    the hot path never re-discovers ``fixed_size_list`` per batch."""
     views: dict[str, np.ndarray] = {}
     for i, name in enumerate(batch.schema.names):
         col = batch.column(i)
@@ -241,7 +256,8 @@ def _np_column_views(batch: pa.RecordBatch) -> dict[str, np.ndarray] | None:
                 if col.null_count:
                     return None
                 flat = col.flatten().to_numpy(zero_copy_only=True)
-                views[name] = flat.reshape(len(col), t.list_size)
+                shape = (tensor_shapes or {}).get(name) or (t.list_size,)
+                views[name] = flat.reshape((len(col),) + tuple(shape))
             else:
                 if col.null_count:
                     return None
@@ -258,12 +274,13 @@ class _Window:
     per column (fast path — no intermediate table ever exists) or assembles
     a table from batch slices for the fallback/custom-collate path."""
 
-    __slots__ = ("parts", "nrows", "fast")
+    __slots__ = ("parts", "nrows", "fast", "tensor_shapes")
 
-    def __init__(self, parts, nrows: int):
+    def __init__(self, parts, nrows: int, tensor_shapes=None):
         self.parts = parts  # [(record_batch, views_or_None, start, length)]
         self.nrows = nrows
         self.fast = all(v is not None for _, v, _, _ in parts)
+        self.tensor_shapes = tensor_shapes  # declared shapes for fallbacks
 
     def __len__(self) -> int:
         return self.nrows
@@ -294,7 +311,11 @@ class _Window:
             shape = (self.nrows,) + proto.shape[1:]
             buf = None if buffers is None else buffers.get(name)
             if buf is None or buf.shape != shape or buf.dtype != proto.dtype:
-                buf = np.empty(shape, dtype=proto.dtype)
+                # 64-byte-aligned output buffers (tensorplane.dlpack): the
+                # XLA CPU client only zero-copies aligned host buffers, so
+                # alignment is what makes the DLPack/device_put hand-off
+                # provably copy-free instead of malloc-luck-dependent
+                buf = aligned_empty(shape, proto.dtype)
                 if buffers is not None:
                     buffers[name] = buf
             pos = 0
@@ -303,32 +324,36 @@ class _Window:
                 if v.dtype != proto.dtype:
                     # batches disagree on dtype (schema drift): numpy would
                     # cast silently — take the exact table path instead
-                    return _default_collate(self.to_table())
+                    return _default_collate(self.to_table(), self.tensor_shapes)
                 buf[pos : pos + ln] = v[s : s + ln]
                 pos += ln
             out[name] = buf
         return out
 
 
-def _host_backed_devices(sharding=None) -> bool:
-    """True when the loader's device_put target shares host memory (CPU):
-    there device_put may alias a dtype-matching numpy buffer instead of
-    copying, so a delivered batch can keep borrowing a collate buffer
-    indefinitely.  The target is the explicit ``sharding``'s devices when
-    one was given (a host-device sharding on an accelerator machine still
-    aliases), else the default backend."""
-    import jax
-
+def _schema_np_dtypes(scan) -> "list[np.dtype] | None":
+    """The numpy dtypes the zero-copy collate fast path can emit for this
+    scan (fixed-width columns; tensor columns contribute their element
+    dtype) — the inputs of the ``delivery_copies`` aliasing probe that
+    decides whether the reuse ring may arm.  None when the schema cannot
+    be resolved: the probe then reports "assume aliasing" and the ring
+    stays down."""
     try:
-        if sharding is not None:
-            devices = getattr(sharding, "device_set", None)
-            if devices:
-                return any(
-                    getattr(d, "platform", "cpu") == "cpu" for d in devices
-                )
-        return jax.default_backend() == "cpu"
-    except Exception:  # backend init failure: assume aliasing, stay safe
-        return True
+        schema = scan.projected_schema()
+    except Exception:
+        return None
+    out: list[np.dtype] = []
+    for field in schema:
+        t = field.type
+        if pa.types.is_fixed_size_list(t):
+            t = t.value_type
+        try:
+            dt = np.dtype(t.to_pandas_dtype())
+        except Exception:
+            continue
+        if dt != object:
+            out.append(dt)
+    return out or None
 
 
 class _BufferRing:
@@ -361,11 +386,13 @@ class _Rebatcher:
     pop rebuilt a table of everything buffered, per window); a window is a
     list of zero-copy slice descriptors resolved at collate time."""
 
-    def __init__(self, batch_size: int, *, capture_views: bool = True):
+    def __init__(self, batch_size: int, *, capture_views: bool = True,
+                 tensor_shapes: "dict[str, tuple[int, ...]] | None" = None):
         self.batch_size = batch_size
         # a custom collate_fn consumes tables, never views — skip the
         # per-batch view capture entirely on that path
         self._capture_views = capture_views
+        self._tensor_shapes = tensor_shapes
         self._pending: list[tuple[pa.RecordBatch, dict | None]] = []
         self._offset = 0  # consumed rows of the FIRST pending batch
         self._rows = 0
@@ -378,7 +405,10 @@ class _Rebatcher:
         for b in incoming:
             if len(b) == 0:
                 continue
-            views = _np_column_views(b) if self._capture_views else None
+            views = (
+                _np_column_views(b, self._tensor_shapes)
+                if self._capture_views else None
+            )
             self._pending.append((b, views))
             self._rows += len(b)
         out = []
@@ -401,7 +431,7 @@ class _Rebatcher:
             else:
                 self._offset += take
         self._rows -= n
-        return _Window(parts, n)
+        return _Window(parts, n, self._tensor_shapes)
 
     def tail(self) -> _Window | None:
         if self._rows == 0:
@@ -454,13 +484,26 @@ class JaxBatchIterator:
             (``lakesoul_scan_stage_seconds{stage=queue,consumer=...}``) —
             with several concurrent loaders (a trainer fleet on one host)
             the tag says WHICH client starved.  Default ``"local"``.
-        cache: ``"device"`` pins every delivered batch in device memory on the
-            first complete epoch; re-iterating then replays the resident
-            batches with ZERO storage/host/link traffic (the tf.data
-            ``.cache()`` role, placed in HBM where re-reads are free).  The
-            whole epoch must fit device memory — the caller opts in knowing
-            rows × bytes/row.  An epoch abandoned early leaves the cache
-            unfilled (partial replay would silently drop data).
+        cache: ``"device"`` pins delivered batches in device memory on the
+            first complete epoch via the tensor plane's
+            :class:`~lakesoul_tpu.tensorplane.replay.DeviceReplayCache`;
+            re-iterating then replays the resident shards with ZERO
+            storage/host/link traffic (the tf.data ``.cache()`` role,
+            placed in HBM where re-reads are free).  Residency is
+            budgeted per device (``replay_budget_bytes`` /
+            ``LAKESOUL_REPLAY_BUDGET_BYTES``; unset = unbounded, the
+            caller opted in knowing rows × bytes/row): past the budget
+            the cache records a typed, metered spill and later epochs
+            replay the resident prefix from HBM then re-stream only the
+            tail.  An epoch abandoned early leaves the cache unfilled
+            (partial replay would silently drop data).
+        replay_budget_bytes: per-device HBM budget for ``cache='device'``
+            (overrides ``LAKESOUL_REPLAY_BUDGET_BYTES``).
+        replay_permute: re-permute the resident epoch on device each
+            replay (seeded; batch order + on-device row permutation) —
+            only honoured while fully resident, a spilled cache replays
+            in stream order so the hybrid epoch stays position-exact.
+        replay_seed: seed pinning the permutation schedule.
     """
 
     def __init__(
@@ -477,6 +520,9 @@ class JaxBatchIterator:
         io_threads: int | None = None,
         checkpoint: "LoaderCheckpoint | None" = None,
         cache: str | None = None,
+        replay_budget_bytes: int | None = None,
+        replay_permute: bool = False,
+        replay_seed: int = 0,
         consumer: str | None = None,
         follow=None,
     ):
@@ -484,6 +530,16 @@ class JaxBatchIterator:
 
         if cache not in (None, "device"):
             raise ConfigError(f"unknown cache mode {cache!r}; expected 'device'")
+        if cache != "device" and (
+            replay_budget_bytes is not None or replay_permute or replay_seed
+        ):
+            # same contract as the other invalid combos in this
+            # constructor: a replay knob without the replay cache must not
+            # silently train un-permuted / un-budgeted
+            raise ConfigError(
+                "replay_budget_bytes/replay_permute/replay_seed require"
+                " cache='device'"
+            )
         if follow is not None and follow is not False:
             if checkpoint is not None:
                 raise ConfigError(
@@ -502,25 +558,57 @@ class JaxBatchIterator:
         if cache == "device" and not device_put:
             raise ConfigError("cache='device' requires device_put=True")
         self._cache_mode = cache
-        self._device_cached: list | None = None
+        self._replay = None
+        # exactly ONE active generator may fill the shared cache: two
+        # interleaved iterations of the same loader would both offer into
+        # it, sealing a doubled epoch (every replay batch served twice) or
+        # tripping offer()-after-seal mid-stream — the first streaming
+        # generator claims the fill, later concurrent ones stream plain
+        self._fill_claimed = False
+        if cache == "device":
+            from lakesoul_tpu.tensorplane.replay import DeviceReplayCache
+
+            self._replay = DeviceReplayCache(
+                budget_bytes=replay_budget_bytes,
+                permute=replay_permute,
+                seed=replay_seed,
+            )
         self._stats = LoaderStats()
         self._scan = scan
         self._collate = collate_fn or _default_collate
+        # declared tensor shapes, resolved ONCE from the projected schema
+        # (tensorplane/columns.py): the collate layer reshapes straight to
+        # (batch, *shape) instead of re-probing Arrow types per batch
+        try:
+            from lakesoul_tpu.tensorplane.columns import tensor_specs
+
+            self._tensor_shapes = {
+                name: spec.shape
+                for name, spec in tensor_specs(scan.projected_schema()).items()
+            } or None
+        except Exception:  # scans without resolvable schemas keep the
+            self._tensor_shapes = None  # per-type collate contract
         # opt-in collate-buffer reuse ring (see _BufferRing contract); sized
-        # to cover every window that can be live at once.  Never under
-        # cache='device' (the resident epoch KEEPS every delivered batch) and
-        # never when device_put targets a HOST-BACKED backend: there
-        # jax.device_put of an already-device-dtype column (float32/int32) is
-        # zero-copy — the jax.Array aliases the slot buffer, and the wrapped
-        # ring would overwrite live device data in place.  Found by the
-        # racecheck ring canary on a real CPU-mesh training drive; TPU/GPU
-        # device_put copies across the link, so the ring stays armed there.
+        # to cover every window that can be live at once.  The disarm
+        # condition keys on MEASURED aliasing (tensorplane/dlpack.py), not a
+        # platform guess: PR 9's ring canary caught host-backed device_put
+        # aliasing dtype-matching columns (float32 stays down on CPU), but a
+        # loader whose every column demotes (int64/float64 under disabled
+        # x64) pays a REAL copy per put — there the ring re-arms, on any
+        # backend, and under cache='device' too (a pinned batch that owns
+        # its bytes cannot be overwritten by slot reuse).  Host-consumer
+        # loaders (device_put=False) keep the old contract: the consumer
+        # copies batches out before the ring wraps, and cache='device'
+        # requires device_put anyway.
         self._ring: _BufferRing | None = None
         if (
             collate_fn is None
-            and cache != "device"
             and os.environ.get("LAKESOUL_COLLATE_REUSE") == "1"
-            and not (device_put and _host_backed_devices(sharding))
+            and (
+                (not device_put and cache != "device")
+                or (device_put and delivery_copies(_schema_np_dtypes(scan),
+                                                   sharding))
+            )
         ):
             self._ring = _BufferRing(
                 max(1, prefetch) + max(1, device_prefetch) + 2
@@ -569,8 +657,23 @@ class JaxBatchIterator:
     def stats(self) -> dict:
         """Loader telemetry snapshot: rows/batches (+ per-sec over in-epoch
         wall time), epochs, per-epoch row totals, consumer stall seconds,
-        and current producer-queue depth.  Cheap enough to read every step."""
-        return self._stats.snapshot()
+        and current producer-queue depth — plus the replay cache's
+        residency stats under ``"replay"`` in cache='device' mode.  Cheap
+        enough to read every step."""
+        snap = self._stats.snapshot()
+        if self._replay is not None:
+            snap["replay"] = self._replay.stats()
+        return snap
+
+    @property
+    def _device_cached(self):
+        """Compat view of the pinned epoch (pre-tensorplane attribute):
+        the resident (rows, batch) list while a fully-resident cache is
+        serving, else None."""
+        if self._replay is not None and self._replay.ready \
+                and not self._replay.spilled:
+            return self._replay._batches
+        return None
 
     def follow_state_json(self) -> str:
         """Resume-ready follower position covering exactly the batches this
@@ -586,16 +689,20 @@ class JaxBatchIterator:
         return self._follow_source.resume_state(self._rows_out).to_json()
 
     # ------------------------------------------------------------- pipeline
-    def _epoch_windows(self) -> "Iterator[_Window]":
+    def _epoch_windows(self, extra_skip: int = 0) -> "Iterator[_Window]":
         """Fixed-size row windows over one epoch's scan (the pipeline
         source).  Resume: the scan's unit order is deterministic, so the
         checkpoint's delivered-row count is a complete position; the scan
         skips whole units via metadata row counts without decoding them and
-        decode-discards only the residual prefix of one unit."""
-        skip = self._checkpoint.rows_delivered if self._checkpoint else 0
+        decode-discards only the residual prefix of one unit.
+        ``extra_skip`` is the spilled-replay tail resume: the resident
+        prefix rows the cache already serves from device memory."""
+        skip = (self._checkpoint.rows_delivered if self._checkpoint else 0) \
+            + extra_skip
         rb = _Rebatcher(
             self._scan._batch_size,
             capture_views=self._collate is _default_collate,
+            tensor_shapes=self._tensor_shapes,
         )
         h = self._h_rebatch
         # the batch-source seam: in-process decode, a scan-plane fleet
@@ -621,12 +728,12 @@ class JaxBatchIterator:
             if tail is not None:
                 yield tail
 
-    def _host_pipeline(self):
+    def _host_pipeline(self, extra_skip: int = 0):
         """One epoch's host pipeline on the shared runtime: scan windows →
         collate/transform → bounded prefetch pump."""
         return (
             rt_pipeline("loader")
-            .source(self._epoch_windows())
+            .source(self._epoch_windows(extra_skip))
             .map(lambda w: (len(w), self._host_batch(w)), name="collate")
             .prefetch(self._prefetch, name="prefetch")
             .run()
@@ -640,6 +747,8 @@ class JaxBatchIterator:
                 # intermediate table, no per-column combine_chunks
                 slot = self._ring.next_slot() if self._ring is not None else None
                 batch = window.collate(slot)
+            elif self._collate is _default_collate:
+                batch = _default_collate(window.to_table(), self._tensor_shapes)
             else:
                 batch = self._collate(window.to_table())
         else:
@@ -673,22 +782,56 @@ class JaxBatchIterator:
                     " of re-iterating"
                 )
             self._follow_started = True
-        if self._device_cached is not None:
-            # steady state: replay the HBM-resident epoch, no host pipeline
+        if self._replay is not None and self._replay.ready:
+            # steady state: replay the HBM-resident epoch — no storage, no
+            # host pipeline, no link traffic; a spilled cache replays its
+            # resident prefix then re-streams ONLY the tail (the offers
+            # stopped at the first budget rejection, so the prefix is
+            # contiguous and `resident_rows` is an exact resume position)
             self._stats.epoch_begin()
-            replayed = False
+            completed = False
             try:
-                for rows, b in self._device_cached:
+                for rows, b in self._replay.replay():
                     self._stats.delivered(rows, 0.0, 0)
+                    self._rows_out += rows
                     yield self._fresh_containers(b)
-                replayed = True
+                if self._replay.spilled:
+                    completed = yield from self._deliver_stream(
+                        extra_skip=self._replay.resident_rows
+                    )
+                else:
+                    completed = True
             finally:
-                self._stats.epoch_end(replayed)
+                self._stats.epoch_end(completed)
             return
-        pipe = self._host_pipeline()
         self._stats.epoch_begin()
+        completed = False
+        filling = self._replay is not None and not self._fill_claimed
+        if filling:
+            self._fill_claimed = True
+        try:
+            offer = self._replay.offer if filling else None
+            completed = yield from self._deliver_stream(offer=offer)
+            if completed and filling:
+                # only a COMPLETE epoch becomes the resident cache: an
+                # abandoned iteration (consumer break → GeneratorExit)
+                # never reaches here
+                self._replay.seal()
+        finally:
+            if filling:
+                if not self._replay.ready:
+                    self._replay.abandon()
+                self._fill_claimed = False
+            self._stats.epoch_end(completed)
+
+    def _deliver_stream(self, extra_skip: int = 0, offer=None):
+        """One streaming epoch: host pipeline → (device_put double buffer)
+        → consumer.  Returns True when the pipeline ran to exhaustion AND
+        every batch reached the consumer.  ``offer`` is the replay cache's
+        pin hook: a pinned batch is handed to the consumer as fresh
+        containers so in-place mutation cannot poison the cached epoch."""
+        pipe = self._host_pipeline(extra_skip)
         produced_all = False  # the pipeline ran to exhaustion
-        delivered_all = False  # ...AND every batch reached the consumer
 
         def host_iter():
             nonlocal produced_all
@@ -722,55 +865,49 @@ class JaxBatchIterator:
             if self._checkpoint is not None:
                 self._checkpoint.rows_delivered += rows
 
-        try:
-            if not self._device_put:
-                for rows, host_batch in host_iter():
-                    delivered(rows)  # BEFORE yield: a post-step save includes it
-                    yield host_batch
-                delivered_all = produced_all
-                return
-
-            import jax
-
-            raw_put = (
-                (lambda b: jax.device_put(b, self._sharding))
-                if self._sharding is not None
-                else jax.device_put
-            )
-            h_put = self._h_device_put
-
-            def put(b):
-                # dispatch cost only: the H2D copy itself overlaps the
-                # training step (that's the double buffering's point)
-                t0 = time.perf_counter()
-                r = raw_put(b)
-                h_put.observe(time.perf_counter() - t0)
-                return r
-            # double buffering: keep device_prefetch transfers in flight so the
-            # H2D copy of batch k+1 overlaps the step on batch k
-            fill: list | None = [] if self._cache_mode == "device" else None
-            buf: list = []
+        if not self._device_put:
             for rows, host_batch in host_iter():
-                buf.append((rows, put(host_batch)))
-                if len(buf) > self._device_prefetch:
-                    r, b = buf.pop(0)
-                    delivered(r)
-                    if fill is not None:
-                        fill.append((r, b))
-                        b = self._fresh_containers(b)  # cache keeps the pristine one
-                    yield b
-            for r, b in buf:
-                delivered(r)
-                if fill is not None:
-                    fill.append((r, b))
-                    b = self._fresh_containers(b)
-                yield b
-            # a consumer break during the tail flush raises GeneratorExit
-            # above and never reaches here: the epoch is NOT complete
-            delivered_all = produced_all
-            if fill is not None:
-                # only a COMPLETE epoch becomes the resident cache: an abandoned
-                # iteration (consumer break → GeneratorExit) never reaches here
-                self._device_cached = fill
-        finally:
-            self._stats.epoch_end(delivered_all)
+                delivered(rows)  # BEFORE yield: a post-step save includes it
+                yield host_batch
+            return produced_all
+
+        # delivery rides the tensor plane's DLPack hand-off: dtype-preserved
+        # contiguous leaves import zero-copy (the collate buffers are
+        # 64-byte aligned for exactly this) and only the device placement
+        # remains — on CPU nothing copies, on TPU only the H2D DMA does;
+        # demoted dtypes fall back to plain device_put (the cast IS the
+        # copy).  Aliasing semantics are identical to raw device_put, so
+        # the ring probe's verdict governs this path unchanged.
+        from lakesoul_tpu.tensorplane.dlpack import deliver as dlpack_deliver
+
+        sharding = self._sharding
+        raw_put = lambda b: dlpack_deliver(b, sharding)  # noqa: E731
+        h_put = self._h_device_put
+
+        def put(b):
+            # dispatch cost only: the H2D copy itself overlaps the
+            # training step (that's the double buffering's point)
+            t0 = time.perf_counter()
+            r = raw_put(b)
+            h_put.observe(time.perf_counter() - t0)
+            return r
+
+        def emit(r, b):
+            delivered(r)
+            if offer is not None and offer(r, b):
+                return self._fresh_containers(b)  # cache keeps the pristine one
+            return b
+
+        # double buffering: keep device_prefetch transfers in flight so the
+        # H2D copy of batch k+1 overlaps the step on batch k
+        buf: list = []
+        for rows, host_batch in host_iter():
+            buf.append((rows, put(host_batch)))
+            if len(buf) > self._device_prefetch:
+                r, b = buf.pop(0)
+                yield emit(r, b)
+        for r, b in buf:
+            yield emit(r, b)
+        # a consumer break during the tail flush raises GeneratorExit above
+        # and never reaches here: the epoch is NOT complete
+        return produced_all

@@ -54,6 +54,15 @@ class VectorIndexError(LakeSoulError):
     pass
 
 
+class TensorColumnError(LakeSoulError):
+    """A declared fixed-shape tensor column received data that violates its
+    declaration (wrong element dtype, wrong flattened width, nulls in the
+    list or its children, or the column missing entirely).  Raised at WRITE
+    time by the tensor-plane validation (tensorplane/columns.py) so a
+    malformed batch dies at the table boundary with the column named,
+    instead of three stages into a training run as a shape error."""
+
+
 class TransientError(LakeSoulError):
     """Marker base for failures that are expected to clear on their own
     (network blips, 5xx, races): the resilience layer

@@ -70,12 +70,28 @@ class TableWriter:
         self._buffered_rows = 0
         self._buffered_bytes = 0
         self._closed = False
+        # declared tensor columns (tensorplane/columns.py): the spec is read
+        # from the schema ONCE here, and every incoming batch is verified
+        # against it — wrong element dtype / width / nulls die at the table
+        # boundary with a typed TensorColumnError naming the column, so the
+        # on-disk fixed-width buffers are ALWAYS dense and 2-D-ready
+        from lakesoul_tpu.tensorplane.columns import tensor_specs
+
+        self._tensor_specs = tensor_specs(config.schema)
 
     # ------------------------------------------------------------------ write
     def write_batch(self, batch: pa.RecordBatch | pa.Table) -> None:
         if self._closed:
             raise IOError_("writer is closed")
         table = pa.table(batch) if isinstance(batch, pa.RecordBatch) else batch
+        if self._tensor_specs:
+            # BEFORE the uniform cast: declared tensor columns are strict —
+            # exact fixed_size_list width/dtype, no nulls at either level —
+            # so a malformed batch raises the typed error naming the
+            # column, not a bare ArrowInvalid out of pc.cast
+            from lakesoul_tpu.tensorplane.columns import validate_tensor_batch
+
+            validate_tensor_batch(table, self._tensor_specs)
         # align to declared schema (cast, fill missing nullable columns)
         from lakesoul_tpu.io.merge import uniform_table
 

@@ -292,10 +292,15 @@ def _spark_type_to_arrow(t) -> pa.DataType:
             ]
         )
     if kind == "array":
-        return pa.list_(
-            pa.field("element", _spark_type_to_arrow(t["elementType"]),
-                     t.get("containsNull", True))
-        )
+        element = pa.field("element", _spark_type_to_arrow(t["elementType"]),
+                           t.get("containsNull", True))
+        # fixed-length annotation (this repo's tensor columns): Spark has no
+        # native fixed-size array, so the JSON carries the length next to
+        # the standard ArrayType keys — readers that ignore it still see a
+        # legal variable-length array of the right element type
+        if "fixedLength" in t:
+            return pa.list_(element, int(t["fixedLength"]))
+        return pa.list_(element)
     if kind == "map":
         return pa.map_(
             _spark_type_to_arrow(t["keyType"]),
@@ -336,6 +341,18 @@ def _arrow_type_to_spark(t: pa.DataType):
             "elementType": _arrow_type_to_spark(t.value_type),
             "containsNull": t.value_field.nullable,
         }
+    if pa.types.is_fixed_size_list(t):
+        # tensor (fixed_size_list) columns get a REAL Spark spelling —
+        # ArrayType plus a fixed-length annotation — instead of the old
+        # IPC-only raw-name fallback: a Spark reader parses the standard
+        # keys (a legal variable-length array), this repo's parser restores
+        # the exact fixed_size_list, and the JSON mirror round-trips
+        return {
+            "type": "array",
+            "elementType": _arrow_type_to_spark(t.value_type),
+            "containsNull": t.value_field.nullable,
+            "fixedLength": t.list_size,
+        }
     if pa.types.is_map(t):
         return {
             "type": "map",
@@ -343,20 +360,40 @@ def _arrow_type_to_spark(t: pa.DataType):
             "valueType": _arrow_type_to_spark(t.item_type),
             "valueContainsNull": t.item_field.nullable,
         }
-    # no Spark spelling (e.g. fixed_size_list tensor columns): record the
-    # Arrow name so the JSON stays honest; the IPC column remains the
-    # full-fidelity source for such tables
+    # no Spark spelling at all (exotic types): record the Arrow name so the
+    # JSON stays honest; the IPC column remains the full-fidelity source
+    # for such tables
     return str(t)
 
 
+# tensor-declaration field-metadata key (tensorplane/columns.py defines the
+# authoritative constant; duplicated as a literal here so the base entity
+# model never imports the tensor plane)
+_TENSOR_META_KEY = b"lakesoul:tensor"
+
+
 def spark_schema_to_arrow(spark: dict | str) -> pa.Schema:
-    """Spark DataType JSON (struct) → Arrow schema."""
+    """Spark DataType JSON (struct) → Arrow schema.  Top-level fields whose
+    Spark ``metadata`` map carries a ``lakesoul:tensor`` entry get it
+    restored as Arrow field metadata, so a tensor declaration's logical
+    shape survives the JSON mirror, not only the IPC column."""
     if isinstance(spark, str):
         spark = json.loads(spark)
     if spark.get("type") != "struct":
         raise ValueError("Spark schema JSON must be a struct at top level")
     struct = _spark_type_to_arrow(spark)
-    return pa.schema(list(struct))
+    fields = []
+    meta_by_name = {
+        f["name"]: f.get("metadata") or {} for f in spark.get("fields", [])
+    }
+    for field in struct:
+        tensor = meta_by_name.get(field.name, {}).get("lakesoul:tensor")
+        if tensor is not None:
+            field = field.with_metadata(
+                {_TENSOR_META_KEY: json.dumps(tensor).encode()}
+            )
+        fields.append(field)
+    return pa.schema(fields)
 
 
 def schema_from_json(s: str) -> pa.Schema:
@@ -381,6 +418,19 @@ def schema_from_json(s: str) -> pa.Schema:
     return pa.schema(fields)
 
 
+def _field_spark_metadata(f: pa.Field) -> dict:
+    """Spark-JSON ``metadata`` map for one field: tensor declarations ride
+    it (``{"lakesoul:tensor": {"shape": [...]}}``) so the JSON mirror keeps
+    the logical shape a multi-dim declaration would otherwise lose."""
+    raw = (f.metadata or {}).get(_TENSOR_META_KEY)
+    if raw is None:
+        return {}
+    try:
+        return {"lakesoul:tensor": json.loads(raw)}
+    except ValueError:
+        return {}
+
+
 def schema_to_json(schema: pa.Schema) -> str:
     return json.dumps(
         {
@@ -390,7 +440,7 @@ def schema_to_json(schema: pa.Schema) -> str:
                     "name": f.name,
                     "type": _arrow_type_to_spark(f.type),
                     "nullable": f.nullable,
-                    "metadata": {},
+                    "metadata": _field_spark_metadata(f),
                 }
                 for f in schema
             ],

@@ -1,0 +1,69 @@
+"""Tensor plane — the device-first data plane (ROADMAP item 5).
+
+Four pieces, one goal: rows that are *tensors* (embeddings, token blocks,
+image patches) should travel from LSF buffers into a JAX training step
+without being re-discovered, re-collated, or re-copied every epoch:
+
+- :mod:`columns` — first-class fixed-shape tensor column declarations:
+  ``tensor_field("emb", (16, 16), "float32")`` builds a
+  ``fixed_size_list`` field carrying its logical shape in field metadata
+  (full-fidelity through the IPC schema the catalog stores; the Spark-JSON
+  mirror spells it as an array with ``fixedLength`` — see
+  ``meta/entity.py``).  The writer validates every declared column on
+  write with typed :class:`~lakesoul_tpu.errors.TensorColumnError`\\ s, so
+  a malformed batch dies at the table boundary, not three stages into a
+  training run; the collate layer reshapes to the declared shape from a
+  spec computed ONCE per loader instead of probing Arrow types per batch.
+- :mod:`dlpack` — zero-copy hand-off from collated host buffers into jax:
+  ``deliver()`` rides the DLPack protocol (``jax.dlpack.from_dlpack``)
+  when the dtype survives unchanged, and the empirical
+  :func:`~lakesoul_tpu.tensorplane.dlpack.delivery_copies` probe tells the
+  loader whether ``device_put`` on THIS backend actually copies — the
+  PR-9 ring-disarm rule now keys on measured aliasing, not a platform
+  guess.
+- :mod:`replay` — :class:`~lakesoul_tpu.tensorplane.replay.
+  DeviceReplayCache`: an HBM-budgeted residency manager
+  (``LAKESOUL_REPLAY_BUDGET_BYTES``) that pins epoch-1's collated,
+  device-put shards per device and serves every later epoch straight from
+  device memory — zero storage/host/link traffic — with an optional
+  seeded on-device permutation per epoch.  Past the budget it spills
+  *gracefully*: the typed, metered spill record marks the cache hybrid,
+  and epoch ≥ 2 replays the resident prefix then re-streams only the
+  tail.
+- :mod:`smoke` — the one-command TPU re-validation registry behind
+  ``tools/tpu_smoke.py``: every Pallas kernel in the repo (enumerated
+  from lakelint's device index, so the registry provably covers 100%),
+  the multichip shapes, and the tensorplane delivery/replay paths compile
+  and run on-chip when a device is reachable; on CPU fallback the report
+  carries the complete ``untested_on_tpu`` list so ONE live-tunnel
+  session re-validates every on-chip claim with zero hand work.
+"""
+
+from lakesoul_tpu.tensorplane.columns import (
+    TensorSpec,
+    tensor_field,
+    tensor_shape_of,
+    tensor_specs,
+    validate_tensor_batch,
+)
+from lakesoul_tpu.tensorplane.dlpack import (
+    aligned_empty,
+    deliver,
+    delivery_copies,
+    device_put_copies,
+)
+from lakesoul_tpu.tensorplane.replay import DeviceReplayCache, ReplaySpill
+
+__all__ = [
+    "TensorSpec",
+    "tensor_field",
+    "tensor_shape_of",
+    "tensor_specs",
+    "validate_tensor_batch",
+    "aligned_empty",
+    "deliver",
+    "delivery_copies",
+    "device_put_copies",
+    "DeviceReplayCache",
+    "ReplaySpill",
+]

@@ -1,0 +1,289 @@
+"""Device-resident dataset replay: the HBM-budgeted residency manager.
+
+Epoch 1 of a training run streams the table — decode, merge, collate,
+``device_put`` — and *offers* every delivered device batch to a
+:class:`DeviceReplayCache`.  The cache pins offered batches (per device:
+a sharded batch costs each chip only its shard) until the declared HBM
+budget (``LAKESOUL_REPLAY_BUDGET_BYTES``, per device) is reached.  From
+epoch 2 on, the loader serves the pinned shards straight from device
+memory — zero storage, host, and link traffic; the ``train_hbm`` role
+grown into a subsystem — optionally re-permuted on device each epoch
+under a pinned seed.
+
+Budget overflow is not an error: the first offer that would cross the
+budget flips the cache into *spilled* mode — a typed
+:class:`ReplaySpill` record, metered in
+``lakesoul_replay_spilled_batches_total`` /
+``lakesoul_replay_spilled_bytes_total`` — after which later epochs
+replay the resident prefix from HBM and re-stream only the tail through
+the normal streaming path (the offers stop at the first rejection, so
+the resident set is always a contiguous prefix and the tail resume
+position is exactly ``resident_rows``).
+
+State machine::
+
+    filling --offer() within budget--> filling (batch pinned)
+    filling --offer() over budget----> filling/spilled (typed + metered)
+    filling --seal()  (epoch done)---> ready          (replay serves)
+    filling --abandon() (epoch broken)-> empty        (partial replay
+                                                       would drop data)
+
+Residency accounting is *per device*: each leaf bills
+``nbytes / |sharding.device_set|`` — eight chips holding one batch-
+sharded epoch each pay an eighth of it.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from lakesoul_tpu.errors import ConfigError
+from lakesoul_tpu.obs import registry
+
+ENV_BUDGET = "LAKESOUL_REPLAY_BUDGET_BYTES"
+
+_PERMUTE_FN = None  # lazily-jitted on-device row permutation
+
+
+@dataclass(frozen=True)
+class ReplaySpill:
+    """The typed record of one cache's budget overflow: which offer
+    crossed the line and what stayed resident.  Carried by
+    :attr:`DeviceReplayCache.spill` (and logged once); later epochs keep
+    working — resident prefix from HBM, tail from the stream."""
+
+    budget_bytes: int
+    batch_rows: int
+    batch_bytes: int
+    resident_batches: int
+    resident_bytes: int
+
+
+def _batch_device_bytes(batch) -> int:
+    """Per-device residency cost of one delivered device batch: each leaf
+    bills the bytes ONE device actually holds — its shard shape, which for
+    a replicated leaf (``P()``) is the full array, not ``nbytes / ndev``
+    (dividing by the device count would under-bill replication by the
+    replication factor and turn the budget's graceful spill into an HBM
+    OOM on a real pod)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        nbytes = getattr(leaf, "nbytes", 0)
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            try:
+                import math
+
+                shard = sharding.shard_shape(leaf.shape)
+                total += math.prod(shard) * leaf.dtype.itemsize
+                continue
+            except Exception:
+                pass  # fall through to the whole-leaf conservative bill
+        total += nbytes
+    return total
+
+
+def _permute_on_device(batch, key):
+    """Row-permute every leading-dim leaf of ``batch`` on device (jitted
+    once per pytree shape): the permutation index is drawn and applied by
+    the backend — no host traffic, which is the whole point of replay."""
+    global _PERMUTE_FN
+    import jax
+
+    if _PERMUTE_FN is None:
+        def _permute(b, k):
+            leaves = jax.tree_util.tree_leaves(b)
+            n = leaves[0].shape[0] if leaves and leaves[0].ndim else 0
+            idx = jax.random.permutation(k, n)
+            return jax.tree_util.tree_map(
+                lambda x: x[idx] if x.ndim and x.shape[0] == n else x, b
+            )
+
+        _PERMUTE_FN = jax.jit(_permute)
+    return _PERMUTE_FN(batch, key)
+
+
+class DeviceReplayCache:
+    """Sharded, HBM-budgeted residency manager for one loader's epochs.
+
+    Args:
+        budget_bytes: per-device pin budget; default from
+            ``LAKESOUL_REPLAY_BUDGET_BYTES``; ``None``/unset = unbounded
+            (the caller opted into whole-epoch residency knowing
+            rows × bytes/row).
+        permute: re-permute rows *within* each resident batch on device
+            every replay epoch (seeded, deterministic); batch order is
+            shuffled too.  Only honoured while fully resident — a spilled
+            cache replays its prefix in stream order so the hybrid epoch
+            stays position-exact against the streamed tail.
+        seed: permutation seed; the (seed, epoch, batch) triple fully
+            determines every draw, so two runs under one seed deliver
+            identical epochs.
+    """
+
+    def __init__(self, *, budget_bytes: int | None = None,
+                 permute: bool = False, seed: int = 0):
+        if budget_bytes is None:
+            raw = os.environ.get(ENV_BUDGET)
+            if raw is not None:
+                try:
+                    budget_bytes = int(raw)
+                except ValueError:
+                    raise ConfigError(
+                        f"{ENV_BUDGET} must be an integer byte count, got"
+                        f" {raw!r}"
+                    )
+        if budget_bytes is not None and budget_bytes <= 0:
+            raise ConfigError(
+                f"replay budget must be positive, got {budget_bytes}"
+            )
+        self.budget_bytes = budget_bytes
+        self.permute = permute
+        self.seed = seed
+        self.ready = False
+        self.spill: ReplaySpill | None = None
+        self._batches: list[tuple[int, object]] = []  # (rows, device pytree)
+        self._resident_bytes = 0
+        self._resident_rows = 0
+        self._epochs_served = 0
+        reg = registry()
+        self._g_bytes = reg.gauge("lakesoul_replay_resident_bytes")
+        self._g_batches = reg.gauge("lakesoul_replay_resident_batches")
+        self._c_spill_b = reg.counter("lakesoul_replay_spilled_batches_total")
+        self._c_spill_bytes = reg.counter("lakesoul_replay_spilled_bytes_total")
+        self._c_epochs = reg.counter("lakesoul_replay_epochs_total")
+        self._c_rows = reg.counter("lakesoul_replay_served_rows_total")
+
+    # ------------------------------------------------------------- filling
+    @property
+    def spilled(self) -> bool:
+        return self.spill is not None
+
+    @property
+    def resident_rows(self) -> int:
+        """Rows covered by the pinned prefix — the streamed-tail resume
+        position of a spilled cache (the scan's deterministic unit order
+        makes a row count a complete position, same as the loader
+        checkpoint)."""
+        return self._resident_rows
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._resident_bytes
+
+    @property
+    def resident_batches(self) -> int:
+        return len(self._batches)
+
+    def offer(self, rows: int, batch) -> bool:
+        """Offer one delivered device batch for pinning during the filling
+        epoch.  Returns True when pinned (the cache now holds a reference;
+        the caller must hand its consumer fresh containers).  The first
+        offer past the budget records the typed spill and every later
+        offer is refused without accounting — the resident set stays a
+        contiguous prefix."""
+        if self.ready:
+            raise ConfigError("offer() after seal(): the cache is serving")
+        cost = _batch_device_bytes(batch)
+        if self.spilled:
+            # EVERY refused batch is metered, not just the one that crossed
+            # the budget: the spilled_* counters are what an operator sizes
+            # LAKESOUL_REPLAY_BUDGET_BYTES from, and counting one batch
+            # when half the epoch re-streams would read as negligible
+            self._c_spill_b.inc()
+            self._c_spill_bytes.inc(cost)
+            return False
+        if self.budget_bytes is not None and \
+                self._resident_bytes + cost > self.budget_bytes:
+            self.spill = ReplaySpill(
+                budget_bytes=self.budget_bytes,
+                batch_rows=rows,
+                batch_bytes=cost,
+                resident_batches=len(self._batches),
+                resident_bytes=self._resident_bytes,
+            )
+            self._c_spill_b.inc()
+            self._c_spill_bytes.inc(cost)
+            import logging
+
+            logging.getLogger(__name__).info(
+                "replay cache spilled: batch of %d rows (%d B/device) would"
+                " cross the %d B budget; %d batches / %d B stay resident,"
+                " later epochs re-stream the tail",
+                rows, cost, self.budget_bytes, len(self._batches),
+                self._resident_bytes,
+            )
+            return False
+        self._batches.append((rows, batch))
+        self._resident_bytes += cost
+        self._resident_rows += rows
+        self._g_bytes.set(self._resident_bytes)
+        self._g_batches.set(len(self._batches))
+        return True
+
+    def seal(self) -> None:
+        """The filling epoch completed: the cache starts serving.  A
+        spilled cache seals too — it serves its prefix; only an *abandoned*
+        epoch (consumer break) discards, partial replay would silently
+        drop data."""
+        self.ready = True
+
+    def abandon(self) -> None:
+        """The filling epoch did not complete: drop every pin (the device
+        memory comes back) and stay in streaming mode."""
+        if self.ready:
+            return
+        self._batches.clear()
+        self._resident_bytes = 0
+        self._resident_rows = 0
+        self.spill = None
+        self._g_bytes.set(0)
+        self._g_batches.set(0)
+
+    # ------------------------------------------------------------- serving
+    def replay(self):
+        """Yield ``(rows, device_batch)`` for one replay epoch, entirely
+        from device memory.  With ``permute`` on a fully-resident cache:
+        batch order is shuffled and each batch's rows are permuted on
+        device, both drawn from (seed, epoch) so replays are
+        deterministic per epoch and different across epochs."""
+        if not self.ready:
+            raise ConfigError("replay() before seal(): the cache is filling")
+        epoch = self._epochs_served
+        self._epochs_served += 1
+        self._c_epochs.inc()
+        order = range(len(self._batches))
+        do_permute = self.permute and not self.spilled
+        if do_permute:
+            import numpy as np
+
+            order = np.random.default_rng((self.seed, epoch)).permutation(
+                len(self._batches)
+            )
+        for pos in order:
+            rows, batch = self._batches[pos]
+            if do_permute:
+                import jax
+
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch),
+                    int(pos),
+                )
+                batch = _permute_on_device(batch, key)
+            self._c_rows.inc(rows)
+            yield rows, batch
+
+    def stats(self) -> dict:
+        return {
+            "ready": self.ready,
+            "spilled": self.spilled,
+            "resident_batches": len(self._batches),
+            "resident_rows": self._resident_rows,
+            "resident_bytes": self._resident_bytes,
+            "budget_bytes": self.budget_bytes,
+            "epochs_served": self._epochs_served,
+            "permute": self.permute,
+        }

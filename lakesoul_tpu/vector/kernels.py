@@ -166,8 +166,9 @@ def _packed_dot_batch_kernel(q_ref, codes_ref, out_ref):
     out_ref[:, :] = jnp.dot(planes, q_ref[:].T, preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def packed_dot_batch_pallas(packed_codes, q_rot_batch, *, tile: int = 512):
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def packed_dot_batch_pallas(packed_codes, q_rot_batch, *, tile: int = 512,
+                            interpret: bool = False):
     """bits·Q over [N, d8] packed codes × [Q, d] queries → [N, Q] f32."""
     n, d8 = packed_codes.shape
     nq = q_rot_batch.shape[0]
@@ -188,12 +189,14 @@ def packed_dot_batch_pallas(packed_codes, q_rot_batch, *, tile: int = 512):
             pl.BlockSpec((tile, d8), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((tile, nq), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
     )(q_r, packed_codes)
     return out[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def packed_dot_pallas(packed_codes, q_rot, *, tile: int = 512):
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def packed_dot_pallas(packed_codes, q_rot, *, tile: int = 512,
+                      interpret: bool = False):
     """bits·Q over [N, d8] packed codes → [N] f32 (Pallas TPU)."""
     n, d8 = packed_codes.shape
     n_pad = ((n + tile - 1) // tile) * tile
@@ -210,6 +213,7 @@ def packed_dot_pallas(packed_codes, q_rot, *, tile: int = 512):
             pl.BlockSpec((tile, d8), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
     )(q_r, packed_codes)
     return out[0, :n]
 
@@ -457,8 +461,9 @@ def _bruteforce_kernel(q_ref, x_ref, out_ref):
     out_ref[0, :] = x_sq - 2.0 * dots + q_sq
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def bruteforce_distances_pallas(vectors, query, *, tile: int = 512):
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def bruteforce_distances_pallas(vectors, query, *, tile: int = 512,
+                                interpret: bool = False):
     n, d = vectors.shape
     n_pad = ((n + tile - 1) // tile) * tile
     if n_pad != n:
@@ -473,6 +478,7 @@ def bruteforce_distances_pallas(vectors, query, *, tile: int = 512):
             pl.BlockSpec((tile, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
     )(q2, vectors)
     return out[0, :n]
 

@@ -1,0 +1,43 @@
+"""Seeded replay-host-roundtrip violations: host materializations of
+device-resident replay data — ``np.asarray`` readbacks, ``.tolist()``,
+``.to_pandas()`` — plus the legal shapes (device-side accounting and
+permutation, a pragma'd verification readback) that must stay silent."""
+
+import numpy as np
+
+
+def replay_via_host(batches):
+    out = []
+    for rows, batch in batches:
+        host = np.asarray(batch["x"])  # SEED: replay-host-roundtrip
+        out.append((rows, host))
+    return out
+
+
+def log_first_rows(batch):
+    return batch["x"].tolist()  # SEED: replay-host-roundtrip
+
+
+def inspect_as_frame(table):
+    return table.to_pandas()  # SEED: replay-host-roundtrip
+
+
+def bare_import_style(asarray, batch):
+    # an un-qualified call is the same round trip
+    return asarray(batch["x"])  # SEED: replay-host-roundtrip
+
+
+def account_on_device(batch):
+    # allowed: residency accounting reads metadata, not bytes
+    return sum(leaf.nbytes for leaf in batch.values())
+
+
+def permute_on_device(batch, key, jax):
+    # allowed: the permutation is drawn and applied by the backend
+    idx = jax.random.permutation(key, batch["x"].shape[0])
+    return {k: v[idx] for k, v in batch.items()}
+
+
+def verification_readback(got, want):
+    # allowed: pragma'd readback naming its purpose
+    return np.asarray(got) == want  # lakelint: ignore[replay-host-roundtrip] verification readback against the host twin

@@ -54,3 +54,28 @@ def make_guarded_ring(cache):
     if cache != "device":
         return _BufferRing(4)  # ok: the device-cache exclusion guards it
     return None
+
+
+def delivery_copies(dtypes):
+    return bool(dtypes)
+
+
+def make_probe_guarded_ring(dtypes):
+    if delivery_copies(dtypes):
+        return _BufferRing(4)  # ok: the measured aliasing probe guards it
+    return None
+
+
+def make_inverted_probe_ring(dtypes):
+    # the inverted-guard bug: arms the ring precisely when puts ALIAS
+    if not delivery_copies(dtypes):
+        return _BufferRing(4)  # SEED: ring-aliasing
+    return None
+
+
+def make_else_branch_probe_ring(dtypes):
+    if delivery_copies(dtypes):
+        ring = None
+    else:
+        ring = _BufferRing(4)  # SEED: ring-aliasing
+    return ring

@@ -279,11 +279,37 @@ def test_ring_aliasing_rule_line_exact():
         f for f in lint_fixture("bad_viewescape.py", rules=rules)
         if f.rule == "ring-aliasing"
     ]
-    assert len(found) == 1, found
+    assert len(found) == 3, found
     assert_seed_lines(found, "bad_viewescape.py", "ring-aliasing")
     assert "cache='device'" in found[0].message
+    assert "delivery_copies" in found[0].message
+    # the probe-guarded ring (make_probe_guarded_ring) is SANCTIONED — the
+    # measured-aliasing hand-off the tensor plane introduced — while the
+    # INVERTED guard (`if not delivery_copies(...)`) and the else-branch
+    # ring are flagged: a probe only guards when its truth selects the
+    # ring (assert_seed_lines pinned all three findings line-exactly)
     # out-of-scope default: both lifetime rules default to data/jax_iter.py
     assert lint_fixture("bad_viewescape.py") == []
+
+
+def test_replay_host_roundtrip_rule_line_exact():
+    """The 26th rule: np.asarray / .tolist() / .to_pandas() host
+    materializations inside the tensor plane are flagged line-exactly;
+    device-side accounting/permutation and the pragma'd verification
+    readback stay silent."""
+    from lakesoul_tpu.analysis.rules.replay import ReplayHostRoundtripRule
+
+    rules = [ReplayHostRoundtripRule(scope=("bad_replay.py",))]
+    found = [
+        f for f in lint_fixture("bad_replay.py", rules=rules)
+        if f.rule == "replay-host-roundtrip"
+    ]
+    assert len(found) == 4, found
+    assert_seed_lines(found, "bad_replay.py", "replay-host-roundtrip")
+    msgs = "\n".join(f.message for f in found)
+    assert "asarray" in msgs and ".tolist()" in msgs and ".to_pandas()" in msgs
+    # out-of-scope default: the rule scopes to lakesoul_tpu/tensorplane/
+    assert lint_fixture("bad_replay.py") == []
 
 
 def test_thread_root_inference_on_fixture():
@@ -584,9 +610,10 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 25 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 26 and "rbac-gate-reachability" in rule_ids
     assert "raw-process" in rule_ids
     assert "unstoppable-loop" in rule_ids
+    assert "replay-host-roundtrip" in rule_ids
     assert "pallas-blockspec" in rule_ids
     assert "shared-state-race" in rule_ids and "view-escapes-release" in rule_ids
     for r in driver["rules"]:

@@ -230,24 +230,59 @@ def test_collate_reuse_ring_stress_canary_and_byte_identity(
             assert a.keys() == b.keys()
             for k in a:
                 assert a[k].tobytes() == b[k].tobytes(), k
-        # device leg under prefetch + device_prefetch: on a HOST-BACKED
-        # backend (this CI) device_put aliases dtype-matching columns, so
-        # the loader must refuse to arm the ring — the canary caught the
-        # aliased-overwrite live on a real training drive (TPU/GPU copies
-        # across the link, so the ring arms there); device dtypes are the
-        # 32-bit demotions, so compare after the deterministic cast
+        # device leg under prefetch + device_prefetch: the disarm condition
+        # keys on MEASURED aliasing (tensorplane delivery_copies probe) —
+        # THIS table's columns are int64/float64, which the host backend
+        # demotes to 32-bit on device_put, so every put is a REAL copy and
+        # the ring stays ARMED even on CPU (the PR-9 platform guess kept it
+        # down); the canary proves the copies finish before slot reuse and
+        # device dtypes are the 32-bit demotions, so compare after the
+        # deterministic cast
         for _ in range(2):
             it = t.scan().batch_size(256).to_jax_iter(
                 device_put=True, prefetch=4, device_prefetch=2,
                 drop_remainder=False,
             )
-            assert it._ring is None  # host-backed aliasing exclusion
+            assert it._ring is not None  # every column's put is a real copy
             dev = [{k: np.asarray(v) for k, v in b.items()} for b in it]
             assert len(dev) == len(baseline)
             for a, b in zip(dev, baseline):
                 for k in a:
                     assert np.array_equal(a[k], b[k].astype(a[k].dtype)), k
     assert w.violations == [], "\n".join(v.render() for v in w.violations)
+
+
+def test_collate_reuse_ring_disarms_on_measured_aliasing(
+    tmp_warehouse, monkeypatch, clean_racecheck
+):
+    """The other half of the probe-keyed contract: a table with a
+    device-dtype (float32) column CAN alias on a host backend — device_put
+    zero-copies aligned dtype-matching buffers — so the loader must still
+    refuse to arm the ring there (the original PR-9 aliased-overwrite
+    find, now pinned through the measurement instead of the platform)."""
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.tensorplane.dlpack import device_put_copies
+
+    catalog = LakeSoulCatalog(str(tmp_warehouse))
+    schema = pa.schema([("id", pa.int64()), ("v", pa.float32())])
+    t = catalog.create_table("ring_alias", schema)
+    rng = np.random.default_rng(11)
+    t.write_arrow(pa.table({
+        "id": np.arange(4_000, dtype=np.int64),
+        "v": rng.normal(size=4_000).astype(np.float32),
+    }, schema=schema))
+    assert not device_put_copies(np.float32)  # the measured premise (CPU CI)
+    assert device_put_copies(np.int64)  # demotion = real copy
+    monkeypatch.setenv("LAKESOUL_COLLATE_REUSE", "1")
+    it = t.scan().batch_size(256).to_jax_iter(
+        device_put=True, prefetch=4, device_prefetch=2, drop_remainder=False
+    )
+    assert it._ring is None  # one aliasing column disarms the whole ring
+    # host-consumer loaders keep the old contract (consumer copies out)
+    it2 = t.scan().batch_size(256).to_jax_iter(device_put=False)
+    assert it2._ring is not None
+    list(it)
+    list(it2)
 
 
 def test_collate_reuse_ring_stress_catches_hoarding_consumer(

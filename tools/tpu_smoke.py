@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""One-command TPU re-validation (ROADMAP item 5).
+
+Runs the tensorplane smoke register (``lakesoul_tpu/tensorplane/smoke.py``)
+— every Pallas kernel in the repo (enumerated from lakelint's device index,
+so coverage is machine-checked), the multichip shapes, and the tensorplane
+delivery/replay paths — and prints one JSON report:
+
+    python tools/tpu_smoke.py                 # report to stdout
+    python tools/tpu_smoke.py --out smoke.json
+    python tools/tpu_smoke.py --heavy         # run the model dryruns on CPU too
+
+On a reachable TPU every case compiles and runs ON CHIP with per-case
+pass/fail + wall seconds.  On CPU fallback every kernel still runs in
+Pallas interpret mode against its jnp twin, and the report carries the
+complete ``untested_on_tpu: [...]`` list — the to-do a live-tunnel session
+burns down with this exact command, zero hand work.
+
+Exit status: 0 when every executed case passed AND the register covers
+100% of the enumerated Pallas kernels; 1 otherwise (an unregistered kernel
+is a failure — on-chip claims must not silently fall out of the sweep).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", help="also write the JSON report to this path")
+    ap.add_argument(
+        "--heavy", action="store_true",
+        help="run heavy cases (parallel model dryruns) even on CPU fallback",
+    )
+    args = ap.parse_args(argv)
+
+    from lakesoul_tpu.tensorplane.smoke import run_smoke
+
+    report = run_smoke(force_heavy=args.heavy)
+    payload = json.dumps(report, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    if not report["ok"]:
+        uncovered = report["kernel_enumeration"]["uncovered"]
+        if uncovered:
+            print(
+                f"FAIL: {len(uncovered)} Pallas kernel(s) not in the smoke"
+                f" register: {uncovered}", file=sys.stderr,
+            )
+        failed = [c["name"] for c in report["cases"] if c["status"] == "fail"]
+        if failed:
+            print(f"FAIL: cases failed: {failed}", file=sys.stderr)
+        return 1
+    if not report["on_tpu"]:
+        print(
+            f"note: CPU fallback — {len(report['untested_on_tpu'])} on-chip"
+            " claims recorded in untested_on_tpu; rerun on a TPU host to"
+            " clear them", file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
