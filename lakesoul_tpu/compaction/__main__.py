@@ -37,6 +37,7 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     from lakesoul_tpu import LakeSoulCatalog
     from lakesoul_tpu.compaction.service import LeasedCompactionService
+    from lakesoul_tpu.obs import fleet
 
     catalog = LakeSoulCatalog(args.warehouse, db_path=args.db_path)
     svc = LeasedCompactionService(
@@ -47,6 +48,7 @@ def main(argv=None) -> int:
         min_file_num=args.min_file_num,
         version_gap=args.version_gap,
     )
+    fleet.arm("compactor", service_id=svc.service_id)
     if args.once:
         print(json.dumps(svc.poll_once()), flush=True)
         return 0

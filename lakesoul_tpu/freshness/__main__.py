@@ -41,8 +41,12 @@ def run_writer(args) -> dict:
     import pyarrow as pa
 
     from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.obs import fleet, registry
+    from lakesoul_tpu.obs.tracing import span
     from lakesoul_tpu.streaming.cdc import CheckpointedWriter
 
+    fleet.arm("freshness-writer")
+    c_rows = registry().counter("lakesoul_writer_rows_total")
     catalog = LakeSoulCatalog(args.warehouse, db_path=args.db_path)
     schema = pa.schema([
         ("id", pa.int64()),
@@ -78,11 +82,16 @@ def run_writer(args) -> dict:
             kinds.append("insert" if seq < args.keyspace else "update")
             rows.append((seq, id_, v))
             seq += 1
-        writer.write(pa.table(
-            {"id": ids, "seq": seqs, "v": vals, cdc_col: kinds},
-            schema=table.schema,
-        ))
-        writer.checkpoint(ckpt)
+        # the COMMIT leg of the end-to-end trace: a root span joins the
+        # spawning harness's trace via LAKESOUL_TRACE_ID, so the fleet
+        # spool can assemble commit → worker-decode → client-delivery
+        with span("freshness.commit", ckpt=ckpt, rows=args.rows_per_commit):
+            writer.write(pa.table(
+                {"id": ids, "seq": seqs, "v": vals, cdc_col: kinds},
+                schema=table.schema,
+            ))
+            writer.checkpoint(ckpt)
+        c_rows.inc(len(ids))
         commit_ts.append(int(time.time() * 1000))
         if args.interval_s > 0 and ckpt + 1 < args.commits:
             time.sleep(args.interval_s)

@@ -209,6 +209,9 @@ class FreshFollower:
         reg = registry()
         self._c_polls = reg.counter("lakesoul_follow_polls_total")
         self._c_units = reg.counter("lakesoul_follow_units_total")
+        # delivered source rows: the follower's contribution to the fleet
+        # aggregate-rows/s north star
+        self._c_rows = reg.counter("lakesoul_follow_rows_total")
 
     # ----------------------------------------------------------------- state
     def _stopped(self) -> bool:
@@ -387,6 +390,7 @@ class FreshFollower:
                     else:
                         state.rows_into_current = rows_done - len(nxt)
                     self._rows_total += len(buffered)
+                    self._c_rows.inc(len(buffered))
                     if boundary:
                         # snapshot per unit boundary, not per batch: the
                         # clone is O(cursors + pending), and intra-unit

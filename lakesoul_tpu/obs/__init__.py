@@ -10,6 +10,11 @@ Pure-stdlib (cheap to import from any layer, no pyarrow/jax).  Three pieces:
   (``x-trace-id`` over Flight).
 - :mod:`lakesoul_tpu.obs.logging` — ``LAKESOUL_LOG_FORMAT=json``
   structured formatter that stamps the active trace id on every record.
+- :mod:`lakesoul_tpu.obs.fleet` — cross-process plane: every role
+  publishes snapshots + a flight-recorder ring to a shared spool
+  (``LAKESOUL_OBS_SPOOL``); :class:`FleetAggregator` merges them into one
+  fleet view with staleness, north-star rows/s, fleet-wide SLOs, traces,
+  and crash postmortems.
 
 Instrumentation contract (see ARCHITECTURE.md "Observability"): metric
 names are ``lakesoul_<layer>_<name>``; hot paths fetch their metric once
@@ -17,6 +22,17 @@ and update it, never format strings per row.
 """
 
 from lakesoul_tpu.obs.exporter import serve_prometheus
+from lakesoul_tpu.obs.fleet import (
+    FleetAggregator,
+    FleetPublisher,
+    FlightRecorder,
+    arm,
+    child_env,
+    flush_now,
+    identity_labels,
+    process_identity,
+    record_event,
+)
 from lakesoul_tpu.obs.logging import JsonLogFormatter, configure_logging
 from lakesoul_tpu.obs.stages import (
     SCAN_STAGES,
@@ -33,10 +49,12 @@ from lakesoul_tpu.obs.metrics import (
     Histogram,
     MetricsRegistry,
     StreamMetrics,
+    parse_series_key,
     registry,
 )
 from lakesoul_tpu.obs.tracing import (
     Span,
+    ambient_trace_id,
     current_span,
     current_trace_id,
     new_trace_id,
@@ -52,13 +70,24 @@ __all__ = [
     "MetricsRegistry",
     "StreamMetrics",
     "registry",
+    "parse_series_key",
     "Span",
     "span",
+    "ambient_trace_id",
     "current_span",
     "current_trace_id",
     "new_trace_id",
     "recent_spans",
     "sanitize_trace_id",
+    "FleetAggregator",
+    "FleetPublisher",
+    "FlightRecorder",
+    "arm",
+    "child_env",
+    "flush_now",
+    "identity_labels",
+    "process_identity",
+    "record_event",
     "JsonLogFormatter",
     "configure_logging",
     "serve_prometheus",

@@ -19,6 +19,7 @@ so hot paths pay one dict lookup + one lock per update.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import weakref
 from dataclasses import dataclass, field
@@ -29,6 +30,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "registry",
+    "parse_series_key",
     "StreamMetrics",
     "DEFAULT_TIME_BUCKETS",
 ]
@@ -45,6 +47,26 @@ def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in labels)
     return "{" + inner + "}"
+
+
+# the inverse of _fmt_labels: snapshot keys are the fleet's cross-process
+# wire format, so they must parse back exactly (label values in this repo
+# are bounded identifiers — worker ids, stage names, roles — never quoted
+# or comma-bearing strings)
+_SERIES_KEY_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?$")
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"')
+
+
+def parse_series_key(key: str) -> "tuple[str, dict] | tuple[None, None]":
+    """Split a ``snapshot()`` series key back into (name, labels_dict);
+    (None, None) when the key is not a well-formed series."""
+    m = _SERIES_KEY_RE.match(key)
+    if m is None:
+        return None, None
+    name, raw = m.group(1), m.group(2)
+    if not raw:
+        return name, {}
+    return name, {k: v for k, v in _LABEL_RE.findall(raw)}
 
 
 class Counter:
@@ -154,6 +176,33 @@ class Histogram:
         idx = bisect.bisect_left(self.bounds, total_s / count)
         with self._lock:
             self._counts[idx] += count
+            self._sum += total_s
+            self._count += count
+
+    def merge_dist(self, buckets: dict, total_s: float, count: int) -> None:
+        """Fold an external CUMULATIVE bucket distribution (a remote
+        histogram's ``value["buckets"]``, possibly JSON-round-tripped with
+        string bounds) into this one.  Each source bucket's count lands at
+        the first local bound >= the source bound — exact when the bound
+        grids match (the common fleet case: every process registers the
+        same family with the same buckets), conservative otherwise.
+        Observations beyond the last source bound go to +Inf."""
+        if count <= 0:
+            return
+        items = sorted((float(b), int(c)) for b, c in buckets.items())
+        add = [0] * (len(self.bounds) + 1)
+        prev = 0
+        for bound, cum in items:
+            c = cum - prev
+            prev = cum
+            if c > 0:
+                add[bisect.bisect_left(self.bounds, bound)] += c
+        tail = count - prev  # the source's implicit +Inf bucket
+        if tail > 0:
+            add[len(self.bounds)] += tail
+        with self._lock:
+            for i, c in enumerate(add):
+                self._counts[i] += c
             self._sum += total_s
             self._count += count
 
@@ -290,6 +339,87 @@ class MetricsRegistry:
                 if n == name
             ]
         return items
+
+    # ----------------------------------------------------------- aggregation
+    def kinds(self) -> dict[str, str]:
+        """Metric-family → kind, covering registered metrics AND collector
+        samples — shipped alongside ``snapshot()`` so a consumer (the fleet
+        aggregator) can merge scalars correctly: counters sum, gauges keep
+        per-process labels."""
+        with self._lock:
+            out = dict(self._kinds)
+        for name, kind, _value, _labels in self._collected():
+            out.setdefault(name, kind)
+        return out
+
+    def merge_snapshot(
+        self,
+        snap: dict,
+        *,
+        kinds: dict | None = None,
+        labels: dict | None = None,
+        gauge_labels: dict | None = None,
+    ) -> int:
+        """Fold another process's ``snapshot()``-shaped series into this
+        registry — the fleet-aggregation primitive (and the scan-plane
+        client's sidecar stage merge rides the same path):
+
+        - histogram values (``{buckets?, count, sum}``) merge bucket-aware
+          when the source ships bounds (:meth:`Histogram.merge_dist`),
+          else at the delta mean (:meth:`Histogram.merge`);
+        - counters SUM (``inc`` by the remote value — callers aggregating
+          repeatedly must merge into a fresh registry, counters are
+          monotonic);
+        - gauges SET per-series, so distinguishing processes needs
+          ``gauge_labels`` (the per-process identity: role, service_id) —
+          counters/histograms keep their source labels and sum across the
+          fleet.
+
+        ``kinds`` is the source registry's :meth:`kinds` map; scalar series
+        without an entry default to counter.  ``labels`` merge into EVERY
+        series key (e.g. ``worker=`` on sidecar stage deltas).  A series
+        whose name/kind/buckets clash with a local registration is skipped
+        — one bad member must not sink the aggregate.  Returns the number
+        of series merged."""
+        kinds = kinds or {}
+        merged = 0
+        for key, value in snap.items():
+            name, series_labels = parse_series_key(str(key))
+            if name is None:
+                continue
+            if labels:
+                series_labels.update(labels)
+            try:
+                if isinstance(value, dict):
+                    buckets = value.get("buckets") or {}
+                    total = float(value.get("sum", 0.0))
+                    count = int(value.get("count", 0))
+                    if buckets:
+                        try:
+                            h = self.histogram(
+                                name,
+                                buckets=tuple(float(b) for b in buckets),
+                                **series_labels,
+                            )
+                        except ValueError:
+                            # local series exists with other bounds: fall
+                            # back to the existing grid, conservative merge
+                            h = self.histogram(name, **series_labels)
+                        h.merge_dist(buckets, total, count)
+                    else:
+                        self.histogram(name, **series_labels).merge(total, count)
+                else:
+                    kind = kinds.get(name, "counter")
+                    if kind == "gauge":
+                        if gauge_labels:
+                            series_labels.update(gauge_labels)
+                        self.gauge(name, **series_labels).set(value)
+                    else:
+                        self.counter(name, **series_labels).inc(value)
+            except (TypeError, ValueError):
+                continue
+            merged += 1
+        return merged
 
     # ------------------------------------------------------------ exposition
     def _collected(self) -> list[tuple[str, str, float, dict]]:

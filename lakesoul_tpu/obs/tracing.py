@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextvars
 import logging
+import os
 import re
 import threading
 import time
@@ -30,14 +31,21 @@ from collections import deque
 from lakesoul_tpu.obs.metrics import registry
 
 __all__ = [
+    "ENV_TRACE_ID",
     "Span",
     "span",
+    "ambient_trace_id",
     "current_span",
     "current_trace_id",
     "new_trace_id",
     "recent_spans",
     "sanitize_trace_id",
 ]
+
+# the spawn-boundary handoff: a parent that pins this var in a child's
+# environment makes every root span in the child join the parent's trace
+# (x-trace-id covers Flight hops; this covers subprocess hops)
+ENV_TRACE_ID = "LAKESOUL_TRACE_ID"
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +75,14 @@ def sanitize_trace_id(raw) -> str | None:
             return None
     raw = str(raw)
     return raw if _TRACE_ID_RE.match(raw) else None
+
+
+def ambient_trace_id() -> str | None:
+    """The trace id handed across a process-spawn boundary
+    (``LAKESOUL_TRACE_ID``), sanitized — root spans (and Flight clients)
+    in a spawned role default to it, so one chaos run's commit →
+    worker-decode → client-delivery path shares a single trace."""
+    return sanitize_trace_id(os.environ.get(ENV_TRACE_ID))
 
 
 class Span:
@@ -110,7 +126,9 @@ class Span:
             if self.trace_id is None:
                 self.trace_id = parent.trace_id
         if self.trace_id is None:
-            self.trace_id = new_trace_id()
+            # a spawned role's root spans join the parent's trace when the
+            # spawn handed one over; otherwise a fresh trace starts here
+            self.trace_id = ambient_trace_id() or new_trace_id()
         self.started = time.perf_counter()
         if not self._detached:
             self._token = _CURRENT.set(self)
@@ -125,6 +143,10 @@ class Span:
             self.duration_s
         )
         record = self.to_dict()
+        # wall-clock end stamp: cross-process trace assembly (the fleet
+        # aggregator merging several processes' span exports) needs an
+        # absolute ordering key; perf_counter timebases don't compare
+        record["t_unix"] = round(time.time(), 3)
         if exc_type is not None:
             record["error"] = exc_type.__name__
         with _RECENT_LOCK:

@@ -29,7 +29,10 @@ def _add_store_args(p: argparse.ArgumentParser) -> None:
 
 
 def _cmd_service(args) -> int:
+    from lakesoul_tpu.obs import fleet
     from lakesoul_tpu.scanplane.service import ScanPlaneService
+
+    fleet.arm("scanplane-service")
 
     svc = ScanPlaneService(
         args.warehouse,
@@ -50,6 +53,7 @@ def _cmd_service(args) -> int:
 
 def _cmd_worker(args) -> int:
     from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.obs import fleet
     from lakesoul_tpu.scanplane.worker import ScanPlaneWorker
 
     catalog = LakeSoulCatalog(args.warehouse, db_path=args.db_path)
@@ -60,6 +64,7 @@ def _cmd_worker(args) -> int:
         lease_ttl_s=args.lease_ttl_s,
         poll_interval_s=args.poll_s,
     )
+    fleet.arm("scanplane-worker", service_id=worker.worker_id)
     if args.once:
         print(json.dumps(worker.poll_once()), flush=True)
         return 0
@@ -76,8 +81,11 @@ def _cmd_worker(args) -> int:
 
 
 def _cmd_drive(args) -> int:
+    from lakesoul_tpu.obs import fleet
+    from lakesoul_tpu.obs.tracing import span
     from lakesoul_tpu.scanplane.client import ScanPlaneClient
 
+    fleet.arm("scanplane-drive")
     client = ScanPlaneClient(
         args.location,
         token=args.token,
@@ -96,19 +104,24 @@ def _cmd_drive(args) -> int:
     # clocks are one host's)
     started_unix = time.time()
     start = time.perf_counter()
-    for batch in client.iter_batches(
-        request, rank=args.rank, world=args.world
-    ):
-        # hash the batch CONTENT in a layout-independent way: IPC bytes of
-        # a freshly-serialized batch are deterministic for equal contents
-        import pyarrow as pa
+    # a root span here joins the spawning parent's trace via
+    # LAKESOUL_TRACE_ID (ambient), so the fleet spool sees the DELIVERY
+    # leg of the commit → decode → delivery path from this process
+    with span("scanplane.drive.deliver", table=args.table, rank=args.rank):
+        for batch in client.iter_batches(
+            request, rank=args.rank, world=args.world
+        ):
+            # hash the batch CONTENT in a layout-independent way: IPC bytes
+            # of a freshly-serialized batch are deterministic for equal
+            # contents
+            import pyarrow as pa
 
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, batch.schema) as w:
-            w.write_batch(batch)
-        digest.update(sink.getvalue().to_pybytes())
-        rows += batch.num_rows
-        batches += 1
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, batch.schema) as w:
+                w.write_batch(batch)
+            digest.update(sink.getvalue().to_pybytes())
+            rows += batch.num_rows
+            batches += 1
     elapsed = time.perf_counter() - start
     print(json.dumps({
         "rows": rows,

@@ -30,7 +30,8 @@ import time
 
 import pyarrow as pa
 
-from lakesoul_tpu.obs import registry, stage_merge
+from lakesoul_tpu.obs import registry
+from lakesoul_tpu.obs.stages import STAGE_FAMILY
 from lakesoul_tpu.runtime.resilience import RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -98,6 +99,9 @@ class ScanPlaneClient:
             for m in ("shm", "socket")
         }
         self._c_reconnects = reg.counter("lakesoul_scanplane_client_reconnects_total")
+        # delivered rows: the scan plane's contribution to the fleet
+        # aggregate-rows/s north star (obs.fleet sums *_rows_total families)
+        self._c_rows = reg.counter("lakesoul_scanplane_client_rows_total")
 
     # ------------------------------------------------------------------ api
     def login(self, **kw) -> str:
@@ -146,6 +150,7 @@ class ScanPlaneClient:
                     merged_stage_ranges, pin,
                 ):
                     if event == "batch":
+                        self._c_rows.inc(payload.num_rows)
                         yield payload
                         pos_batch += 1
                         made_progress = True
@@ -309,13 +314,27 @@ class ScanPlaneClient:
                 worker = "other"
             else:
                 self._worker_labels.add(worker)
+        # the sidecar deltas are a remote snapshot in miniature: shape them
+        # as snapshot() series and ride the SAME merge_snapshot path the
+        # fleet aggregator uses (no-bucket histogram values fold via
+        # Histogram.merge, so the published
+        # lakesoul_scan_stage_seconds{stage=,worker=} series stay
+        # byte-identical to the old hand-rolled stage_merge loop)
+        snap = {}
         for stage, delta in stages.items():
             try:
-                stage_merge(
-                    stage, float(delta["s"]), int(delta["count"]), worker=worker
-                )
+                snap[f'{STAGE_FAMILY}{{stage="{stage}"}}'] = {
+                    "sum": float(delta["s"]),
+                    "count": int(delta["count"]),
+                }
             except (KeyError, TypeError, ValueError):
                 continue
+        if snap:
+            registry().merge_snapshot(
+                snap,
+                kinds={STAGE_FAMILY: "histogram"},
+                labels={"worker": worker},
+            )
 
 
 def _read_meta(reader) -> dict:

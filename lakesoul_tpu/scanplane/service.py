@@ -89,11 +89,17 @@ class ScanPlaneService:
         return argv
 
     def spawn_workers(self) -> None:
+        from lakesoul_tpu.obs import fleet
+
+        # fleet.child_env pins the obs spool + active trace id into each
+        # worker's environment: the children publish into the SAME fleet
+        # and their spans join the service's trace
+        env = fleet.child_env()
         for i in range(self.workers):
             # children must not inherit our stdout: the first-line JSON
             # handle contract belongs to the SERVICE stream alone
             self._children.append(subprocess.Popen(
-                self.worker_argv(i), stdout=subprocess.DEVNULL,
+                self.worker_argv(i), stdout=subprocess.DEVNULL, env=env,
             ))
         if self._children:
             logger.info(

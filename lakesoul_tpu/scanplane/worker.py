@@ -31,6 +31,8 @@ import os
 import time
 
 from lakesoul_tpu.obs import registry, stage_counts, stage_seconds
+from lakesoul_tpu.obs import fleet
+from lakesoul_tpu.obs.tracing import span
 from lakesoul_tpu.runtime import faults
 from lakesoul_tpu.runtime.resilience import _env_float
 from lakesoul_tpu.scanplane import session as sess
@@ -181,12 +183,26 @@ class ScanPlaneWorker:
                 # the previous holder published between our listing and the
                 # acquire — nothing to do
                 return "raced"
+            # pin the lease-acquire to the obs spool BEFORE entering the
+            # crash window below: if a SIGKILL lands mid-range, the
+            # postmortem's last event names the session/range/fence held
+            fleet.record_event(
+                "scanplane.range.lease",
+                session=session.session_id, range=index,
+                fence=lease.fencing_token, flush=True,
+            )
             # chaos point: a worker hung (or SIGKILLed) here still holds
             # the lease — the takeover tests kill inside this window
             faults.maybe_inject("scanplane.range")
             spool.sweep_tmp_debris(sdir, index)
             started = time.perf_counter()
-            self._produce(session, sdir, index, lease.fencing_token, heartbeat)
+            with span(
+                "scanplane.range.produce",
+                session=session.session_id, range=index,
+            ):
+                self._produce(
+                    session, sdir, index, lease.fencing_token, heartbeat
+                )
             self._h_range.observe(time.perf_counter() - started)
             return "produced"
         except LeaseFencedError:
@@ -198,6 +214,7 @@ class ScanPlaneWorker:
             logger.exception(
                 "%s failed producing range %s", self.worker_id, key
             )
+            fleet.flush_now(reason="scanplane.range_error")
             return "errors"
         finally:
             heartbeat.stop()

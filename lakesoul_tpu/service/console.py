@@ -65,6 +65,8 @@ class Console:
             "  clean                        run the cleaner (TTLs, discard list)\n"
             "  cache-stats                  page cache counters (via the obs registry)\n"
             "  obs-stats [prefix]           full metrics-registry snapshot\n"
+            "  fleet-status [spool]         aggregated fleet view of an obs spool\n"
+            "                               (default LAKESOUL_OBS_SPOOL)\n"
             "  lint [--rule ID] [--format text|json|sarif]\n"
             "                               lakelint static analysis over the package\n"
             "  user-add <name> <pw> [group] register a gateway/proxy user\n"
@@ -180,6 +182,54 @@ class Console:
             else:
                 lines.append(f"{name} {value}")
         return "\n".join(lines) or "(no metrics recorded)"
+
+    def cmd_fleet_status(self, args) -> str:
+        """Aggregate an obs spool (``fleet-status [spool-dir]``; default
+        ``LAKESOUL_OBS_SPOOL``): members with heartbeat staleness, the
+        north-star rows/s figures, fleet-wide SLO state, and any crash
+        postmortems recoverable from the spool."""
+        import os
+
+        from lakesoul_tpu.obs import FleetAggregator
+
+        spool = args[0] if args else os.environ.get("LAKESOUL_OBS_SPOOL", "")
+        if not spool:
+            return "fleet-status: no spool (pass a dir or set LAKESOUL_OBS_SPOOL)"
+        agg = FleetAggregator(spool)
+        doc = agg.aggregate()
+        if not doc["members"]:
+            return f"fleet-status: no members published under {spool}"
+        lines = [f"fleet @ {spool} ({len(doc['members'])} members,"
+                 f" stale after {doc['stale_after_s']}s):"]
+        for m in sorted(doc["members"], key=lambda m: (m["role"], m["service_id"])):
+            mark = "STALE" if m["stale"] else "live"
+            lines.append(
+                f"  {m['role']:<18} {m['service_id']:<28} pid={m['pid']}"
+                f" heartbeat_age={m['heartbeat_age_s']:.1f}s [{mark}]"
+            )
+        f = doc["fleet"]
+        lines.append(
+            f"north star: {f['rows']} rows / {f['window_s']}s ="
+            f" {f['rows_per_s']} rows/s"
+            + (f" ({f['rows_per_s_per_chip']} rows/s/chip on {f['chips']}"
+               f" chips)" if f["chips"] else " (no chips reported)")
+        )
+        fr = doc["slos"]["freshness"]
+        lines.append(
+            f"freshness SLO: {fr['violations']}/{fr['count']} over"
+            f" {fr['target_s']}s target (allowed {fr['allowed_violations']})"
+            f" → {'IN BUDGET' if fr['in_budget'] else 'BREACHED'}"
+            f" p50={fr['p50_s']}s p99={fr['p99_s']}s"
+        )
+        pms = agg.postmortems()
+        for pm in pms:
+            last = pm["events"][-1] if pm["events"] else None
+            lines.append(
+                f"postmortem: {pm['role']} {pm['service_id']} (pid {pm['pid']})"
+                f" — {len(pm['events'])} events, {len(pm['spans'])} spans;"
+                f" last event: {last['name'] if last else '(none)'}"
+            )
+        return "\n".join(lines)
 
     def cmd_lint(self, args) -> str:
         """Run lakelint (the project-native static analysis) over the

@@ -680,8 +680,12 @@ class LakeSoulFlightClient:
         basic_auth: tuple[str, str] | None = None,
         trace_id: str | None = None,
     ):
+        from lakesoul_tpu.obs.tracing import ambient_trace_id
+
         self._client = flight.FlightClient(location)
-        self._trace_id = trace_id
+        # no explicit id → the spawn-boundary ambient one, so a child
+        # process's Flight calls ride the parent's trace end to end
+        self._trace_id = sanitize_trace_id(trace_id) or ambient_trace_id()
         self._options = None
         if token:
             self._set_auth_header(b"authorization", f"Bearer {token}".encode())
@@ -689,7 +693,7 @@ class LakeSoulFlightClient:
             user, password = basic_auth
             cred = base64.b64encode(f"{user}:{password}".encode()).decode()
             self._set_auth_header(b"authorization", f"Basic {cred}".encode())
-        elif trace_id is not None:
+        elif self._trace_id is not None:
             self._set_auth_header(None, None)
 
     def _set_auth_header(self, name: bytes | None, value: bytes | None) -> None:

@@ -1,0 +1,18 @@
+"""Seeded fleet-identity-label violations: hand-rolled identity strings."""
+
+from lakesoul_tpu.obs import registry, stage_merge
+from lakesoul_tpu.obs.fleet import identity_labels, process_identity
+
+
+def record(n):
+    registry().gauge("lakesoul_widget_up", role="scanworker").set(n)  # SEED: fleet-identity-label (literal role)
+    registry().counter("lakesoul_widget_jobs_total", service_id=f"w-{n}").inc()  # SEED: fleet-identity-label (f-string service_id)
+    stage_merge("decode", 0.5, 2, worker="worker-7")  # SEED: fleet-identity-label (literal worker)
+    # sanctioned spellings: values traced to the ONE registered identity
+    ident = process_identity(role="scanworker")
+    registry().gauge("lakesoul_widget_up", **identity_labels()).set(n)  # allowed
+    registry().counter(
+        "lakesoul_widget_jobs_total", service_id=ident.service_id
+    ).inc()  # allowed
+    stage_merge("decode", 0.5, 2, worker=ident.service_id)  # allowed
+    registry().gauge("lakesoul_widget_depth", stage="fill").set(n)  # allowed (not identity)

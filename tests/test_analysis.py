@@ -456,6 +456,20 @@ def test_metric_name_rule_scheme_suffixes_and_kind_clash():
     assert len(found) == 4, found
 
 
+def test_fleet_identity_label_rule_seed_exact():
+    """Literal and f-string identity labels (role=/service_id=/worker=) at
+    metric/stage call sites are flagged line-exactly; values routed through
+    the obs.fleet identity helpers (or any variable/attribute) pass."""
+    findings = [
+        f for f in lint_fixture("bad_identity.py")
+        if f.rule == "fleet-identity-label"
+    ]
+    assert_seed_lines(findings, "bad_identity.py", "fleet-identity-label")
+    msgs = "\n".join(f.message for f in findings)
+    assert "role=" in msgs and "service_id=" in msgs and "worker=" in msgs
+    assert all("identity_labels()" in f.message for f in findings)
+
+
 def test_sqlite_scope_rule():
     found = [f for f in lint_fixture("bad_sqlite.py") if f.rule == "sqlite-scope"]
     assert len(found) >= 2  # import + connect (cursor heuristic is a bonus)
@@ -610,10 +624,11 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 26 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 27 and "rbac-gate-reachability" in rule_ids
     assert "raw-process" in rule_ids
     assert "unstoppable-loop" in rule_ids
     assert "replay-host-roundtrip" in rule_ids
+    assert "fleet-identity-label" in rule_ids
     assert "pallas-blockspec" in rule_ids
     assert "shared-state-race" in rule_ids and "view-escapes-release" in rule_ids
     for r in driver["rules"]:

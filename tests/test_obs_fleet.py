@@ -1,0 +1,692 @@
+"""Fleet observability plane: cross-process aggregation, traces, postmortems.
+
+Tier-1 legs exercise the whole plane in-process against real spool files
+(the publisher's fsync+rename output IS the wire format); the slow leg
+SIGKILLs a REAL scanplane worker mid-range and recovers its flight
+recorder + last snapshot from the spool — the crash-postmortem acceptance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lakesoul_tpu.obs import fleet
+from lakesoul_tpu.obs.exporter import serve_prometheus
+from lakesoul_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    parse_series_key,
+    registry,
+)
+
+
+@pytest.fixture()
+def spool(tmp_path):
+    d = tmp_path / "obs-spool"
+    d.mkdir()
+    return str(d)
+
+
+def _member(
+    spool_dir,
+    *,
+    role,
+    service_id,
+    snapshot,
+    kinds=None,
+    heartbeat_unix=None,
+    started_unix=None,
+    chips=0,
+    host="h1",
+    pid=1234,
+):
+    now = time.time()
+    doc = {
+        "role": role,
+        "service_id": service_id,
+        "pid": pid,
+        "host": host,
+        "started_unix": now - 10.0 if started_unix is None else started_unix,
+        "heartbeat_unix": now if heartbeat_unix is None else heartbeat_unix,
+        "chips": chips,
+        "kinds": kinds or {},
+        "snapshot": snapshot,
+    }
+    with open(os.path.join(spool_dir, f"member-{service_id}.json"), "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def _recorder(spool_dir, *, role, service_id, events=(), spans=(), pid=1234):
+    doc = {
+        "role": role,
+        "service_id": service_id,
+        "pid": pid,
+        "heartbeat_unix": time.time(),
+        "reason": "test",
+        "events": list(events),
+        "spans": list(spans),
+    }
+    with open(os.path.join(spool_dir, f"recorder-{service_id}.json"), "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+# ------------------------------------------------------------ wire format
+
+
+class TestSeriesKeyParsing:
+    def test_round_trips_snapshot_keys(self):
+        reg = MetricsRegistry()
+        reg.counter("lakesoul_x_total", stage="decode", worker="w-1").inc(3)
+        reg.gauge("lakesoul_x_depth").set(7)
+        for key in reg.snapshot():
+            name, labels = parse_series_key(key)
+            assert name is not None
+        name, labels = parse_series_key(
+            'lakesoul_x_total{stage="decode",worker="w-1"}'
+        )
+        assert name == "lakesoul_x_total"
+        assert labels == {"stage": "decode", "worker": "w-1"}
+        assert parse_series_key("lakesoul_plain") == ("lakesoul_plain", {})
+        assert parse_series_key("{broken") == (None, None)
+
+
+class TestHistogramMergeDist:
+    def test_same_grid_is_exact(self):
+        src = Histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            src.observe(v)
+        dst = Histogram("h", buckets=(0.1, 1.0, 10.0))
+        sv = src.value
+        dst.merge_dist(sv["buckets"], sv["sum"], sv["count"])
+        assert dst.value == sv
+
+    def test_json_string_bounds_and_coarser_grid(self):
+        src = Histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            src.observe(v)
+        # a JSON round trip turns bucket bounds into strings
+        wire = json.loads(json.dumps(src.value))
+        dst = Histogram("h", buckets=(1.0, 10.0))
+        dst.merge_dist(wire["buckets"], wire["sum"], wire["count"])
+        v = dst.value
+        assert v["count"] == 4 and v["sum"] == pytest.approx(wire["sum"])
+        # <=0.1 and <=1.0 both land in the <=1.0 bucket; 50.0 rides +Inf
+        assert v["buckets"][1.0] == 2
+        assert v["buckets"][10.0] == 3
+
+
+class TestMergeSnapshot:
+    def test_counters_sum_gauges_keep_identity_histograms_merge(self):
+        a = MetricsRegistry()
+        a.counter("lakesoul_w_rows_total").inc(100)
+        a.gauge("lakesoul_w_depth").set(3)
+        a.histogram("lakesoul_w_seconds", buckets=(1.0, 10.0)).observe(0.5)
+        b = MetricsRegistry()
+        b.counter("lakesoul_w_rows_total").inc(40)
+        b.gauge("lakesoul_w_depth").set(9)
+        b.histogram("lakesoul_w_seconds", buckets=(1.0, 10.0)).observe(5.0)
+
+        out = MetricsRegistry()
+        for reg, sid in ((a, "p1"), (b, "p2")):
+            n = out.merge_snapshot(
+                reg.snapshot(), kinds=reg.kinds(),
+                gauge_labels={"service_id": sid},
+            )
+            assert n == 3
+        snap = out.snapshot()
+        assert snap["lakesoul_w_rows_total"] == 140  # counters SUM
+        # gauges keep per-process identity labels instead of clobbering
+        assert snap['lakesoul_w_depth{service_id="p1"}'] == 3
+        assert snap['lakesoul_w_depth{service_id="p2"}'] == 9
+        h = snap["lakesoul_w_seconds"]
+        assert h["count"] == 2 and h["sum"] == pytest.approx(5.5)
+        assert h["buckets"][1.0] == 1 and h["buckets"][10.0] == 2  # bucket-aware
+
+    def test_no_bucket_histogram_value_folds_at_mean(self):
+        out = MetricsRegistry()
+        out.merge_snapshot(
+            {'lakesoul_scan_stage_seconds{stage="decode"}': {
+                "sum": 0.3, "count": 3,
+            }},
+            kinds={"lakesoul_scan_stage_seconds": "histogram"},
+            labels={"worker": "wX"},
+        )
+        series = out.series("lakesoul_scan_stage_seconds")
+        assert len(series) == 1
+        labels, h = series[0]
+        assert labels == {"stage": "decode", "worker": "wX"}
+        assert h.value["count"] == 3 and h.value["sum"] == pytest.approx(0.3)
+
+    def test_kind_clash_and_garbage_series_skipped_not_fatal(self):
+        out = MetricsRegistry()
+        out.counter("lakesoul_w_clash_total").inc(1)
+        merged = out.merge_snapshot(
+            {
+                "lakesoul_w_clash_total": {"sum": 1.0, "count": 1},  # kindclash
+                "{not a series}": 5,
+                "lakesoul_w_ok_total": 2,
+            },
+            kinds={},
+        )
+        assert merged == 1  # only the good series
+        assert out.snapshot()["lakesoul_w_ok_total"] == 2
+        assert out.snapshot()["lakesoul_w_clash_total"] == 1  # untouched
+
+
+# --------------------------------------------------------------- exporter
+
+
+class _RaisingSource:
+    def prometheus_text(self):
+        raise RuntimeError("collector exploded")
+
+    def snapshot(self):
+        raise RuntimeError("collector exploded")
+
+
+class _DocSource:
+    def prometheus_text(self):
+        return "# TYPE lakesoul_t_total counter\nlakesoul_t_total 1\n"
+
+    def snapshot(self):
+        return {"lakesoul_t_total": 1}
+
+
+class TestExporter:
+    def _get(self, port, path, accept=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            headers={"Accept": accept} if accept else {},
+        )
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+    def test_content_negotiation_health_and_500_body(self):
+        srv = serve_prometheus(_DocSource(), port=0, host="127.0.0.1")
+        try:
+            port = srv.server_address[1]
+            status, ctype, body = self._get(port, "/metrics")
+            assert status == 200 and ctype.startswith("text/plain")
+            assert "lakesoul_t_total 1" in body
+            status, ctype, body = self._get(
+                port, "/metrics", accept="application/json"
+            )
+            assert status == 200 and ctype == "application/json"
+            assert json.loads(body) == {"lakesoul_t_total": 1}
+            fleet.process_identity(role="exporter-test")
+            status, _, body = self._get(port, "/healthz")
+            doc = json.loads(body)
+            assert status == 200 and doc["status"] == "ok"
+            assert doc["role"] == "exporter-test" and doc["pid"] == os.getpid()
+        finally:
+            srv.shutdown()
+
+    def test_raising_source_returns_500_body_not_dropped_socket(self):
+        srv = serve_prometheus(_RaisingSource(), port=0, host="127.0.0.1")
+        try:
+            port = srv.server_address[1]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/metrics")
+            assert ei.value.code == 500
+            body = ei.value.read().decode()
+            assert "RuntimeError" in body and "collector exploded" in body
+            # liveness stays up even when metrics production is broken
+            status, _, _ = self._get(port, "/healthz")
+            assert status == 200
+        finally:
+            srv.shutdown()
+
+
+# ------------------------------------------------------- identity + publish
+
+
+class TestIdentityAndPublisher:
+    def test_arm_without_spool_stamps_identity_gauges_only(self, monkeypatch):
+        monkeypatch.delenv(fleet.ENV_SPOOL, raising=False)
+        pub = fleet.arm("unit-test-role", service_id="unit-test-1")
+        assert pub is None
+        snap = registry().snapshot()
+        build = [
+            k for k in snap
+            if k.startswith("lakesoul_build_info")
+            and 'role="unit-test-role"' in k
+            and 'service_id="unit-test-1"' in k
+        ]
+        assert build and snap[build[0]] == 1
+        start = [
+            k for k in snap
+            if k.startswith("lakesoul_process_start_time_seconds")
+            and 'service_id="unit-test-1"' in k
+        ]
+        assert start and snap[start[0]] == pytest.approx(time.time(), abs=120)
+        labels = fleet.identity_labels(worker="w")
+        assert labels["role"] == "unit-test-role"
+        assert labels["service_id"] == "unit-test-1"
+        assert labels["worker"] == "w"
+
+    def test_publisher_flush_writes_member_and_recorder_docs(self, spool):
+        fleet.process_identity(role="pubtest", service_id="pubtest-1")
+        src = MetricsRegistry()
+        src.counter("lakesoul_pub_rows_total").inc(12)
+        pub = fleet.FleetPublisher(spool, flush_s=60.0, source=src)
+        fleet.record_event("pubtest.step", detail="x")
+        pub.flush(reason="unit")
+        member = json.load(open(os.path.join(spool, "member-pubtest-1.json")))
+        assert member["role"] == "pubtest"
+        assert member["pid"] == os.getpid()
+        assert member["snapshot"]["lakesoul_pub_rows_total"] == 12
+        assert member["kinds"]["lakesoul_pub_rows_total"] == "counter"
+        assert member["heartbeat_unix"] == pytest.approx(time.time(), abs=60)
+        rec = json.load(open(os.path.join(spool, "recorder-pubtest-1.json")))
+        assert rec["reason"] == "unit"
+        assert any(e["name"] == "pubtest.step" for e in rec["events"])
+        # flush cost is metered (the bench budgets it)
+        flush_h = src.histogram(fleet.FLUSH_FAMILY).value
+        assert flush_h["count"] >= 1
+
+    def test_periodic_flush_and_stop(self, spool):
+        fleet.process_identity(role="pubtest", service_id="pubtest-2")
+        src = MetricsRegistry()
+        pub = fleet.FleetPublisher(spool, flush_s=0.05, source=src)
+        pub.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            path = os.path.join(spool, "member-pubtest-2.json")
+            first = json.load(open(path))["heartbeat_unix"]
+            beat = first
+            while time.monotonic() < deadline and beat <= first:
+                time.sleep(0.05)
+                beat = json.load(open(path))["heartbeat_unix"]
+            assert beat > first, "periodic flush never advanced the heartbeat"
+        finally:
+            pub.stop()
+
+    def test_child_env_pins_trace_and_spool(self, spool, monkeypatch):
+        from lakesoul_tpu.obs.tracing import ENV_TRACE_ID, span
+
+        monkeypatch.delenv(ENV_TRACE_ID, raising=False)
+        monkeypatch.delenv(fleet.ENV_SPOOL, raising=False)
+        monkeypatch.setattr(fleet, "_PUBLISHER", None)
+        env = fleet.child_env()
+        assert ENV_TRACE_ID not in env and fleet.ENV_SPOOL not in env
+        with span("parent.op") as s:
+            env = fleet.child_env()
+            assert env[ENV_TRACE_ID] == s.trace_id
+        env = fleet.child_env(trace_id="pinned-id-1")
+        assert env[ENV_TRACE_ID] == "pinned-id-1"
+        pub = fleet.FleetPublisher(spool, flush_s=60.0, source=MetricsRegistry())
+        monkeypatch.setattr(fleet, "_PUBLISHER", pub)
+        assert fleet.child_env()[fleet.ENV_SPOOL] == spool
+
+
+# ------------------------------------------------------------- aggregation
+
+
+class TestFleetAggregator:
+    def test_one_snapshot_from_many_members_with_staleness(self, spool):
+        now = time.time()
+        _member(
+            spool, role="scanplane-worker", service_id="w1",
+            snapshot={
+                "lakesoul_scanplane_client_rows_total": 600,
+                'lakesoul_build_info{role="scanplane-worker",service_id="w1",version="0.1.0"}': 1,
+            },
+            kinds={
+                "lakesoul_scanplane_client_rows_total": "counter",
+                "lakesoul_build_info": "gauge",
+            },
+            started_unix=now - 10.0, chips=2,
+        )
+        _member(
+            spool, role="scanplane-worker", service_id="w2",
+            snapshot={"lakesoul_scanplane_client_rows_total": 400},
+            kinds={"lakesoul_scanplane_client_rows_total": "counter"},
+            started_unix=now - 5.0, chips=2,
+        )
+        _member(
+            spool, role="compactor", service_id="c1",
+            snapshot={"lakesoul_compaction_jobs_total": 3},
+            kinds={"lakesoul_compaction_jobs_total": "counter"},
+            heartbeat_unix=now - 60.0, started_unix=now - 90.0,
+        )
+        agg = fleet.FleetAggregator(spool, stale_after_s=5.0)
+        doc = agg.aggregate(now=now)
+        assert len(doc["members"]) == 3
+        by_sid = {m["service_id"]: m for m in doc["members"]}
+        assert not by_sid["w1"]["stale"] and not by_sid["w2"]["stale"]
+        assert by_sid["c1"]["stale"]
+        snap = doc["snapshot"]
+        # counters SUM across the fleet into one series
+        assert snap["lakesoul_scanplane_client_rows_total"] == 1000
+        # per-role series survive via identity labels on gauges
+        assert any(
+            "lakesoul_build_info" in k and 'role="scanplane-worker"' in k
+            for k in snap
+        )
+        # north star: rows over the fleet window (oldest member started 90s
+        # ago), chips = per-host max (both workers see the same 2 devices)
+        assert doc["fleet"]["rows"] == 1000
+        assert doc["fleet"]["window_s"] == pytest.approx(90.0, abs=1.0)
+        assert doc["fleet"]["chips"] == 2
+        assert doc["fleet"]["rows_per_s_per_chip"] == pytest.approx(
+            doc["fleet"]["rows_per_s"] / 2, rel=1e-3
+        )
+        assert snap["lakesoul_fleet_members"] == 3
+        assert snap["lakesoul_fleet_stale_members"] == 1
+        # prometheus view serves the same merged registry
+        text = agg.prometheus_text()
+        assert "lakesoul_fleet_members 3" in text
+        assert "lakesoul_scanplane_client_rows_total 1000" in text
+
+    def test_fleet_wide_freshness_slo(self, spool):
+        from lakesoul_tpu.freshness.slo import (
+            FRESHNESS_BUCKETS,
+            FRESHNESS_FAMILY,
+            VIOLATIONS_FAMILY,
+        )
+
+        src = MetricsRegistry()
+        h = src.histogram(FRESHNESS_FAMILY, buckets=FRESHNESS_BUCKETS)
+        for v in (0.5, 1.0, 2.0, 3.0):
+            h.observe(v)
+        src.counter(VIOLATIONS_FAMILY, slo="freshness_10.0s").inc(0)
+        _member(
+            spool, role="follower", service_id="f1",
+            snapshot=json.loads(json.dumps(src.snapshot())),
+            kinds=src.kinds(),
+        )
+        doc = fleet.FleetAggregator(spool, stale_after_s=30.0).aggregate()
+        fr = doc["slos"]["freshness"]
+        assert fr["count"] == 4 and fr["violations"] == 0
+        assert fr["in_budget"] is True
+        assert fr["mean_s"] == pytest.approx(6.5 / 4)
+        assert 0.0 < fr["p50_s"] <= fr["p99_s"]
+        tp = doc["slos"]["throughput"]
+        assert tp["ok"] is None  # no floor requested
+        doc = fleet.FleetAggregator(spool, stale_after_s=30.0).aggregate(
+            min_rows_per_s=10.0**9
+        )
+        assert doc["slos"]["throughput"]["ok"] is False
+
+    def test_trace_assembly_across_members(self, spool):
+        tid = "trace-abc"
+        _recorder(
+            spool, role="freshness-writer", service_id="fw", pid=10,
+            spans=[
+                {"name": "freshness.commit", "trace_id": tid, "t_unix": 1.0},
+                {"name": "unrelated", "trace_id": "other", "t_unix": 1.5},
+            ],
+        )
+        _recorder(
+            spool, role="scanplane-worker", service_id="sw", pid=20,
+            spans=[{
+                "name": "scanplane.range.produce", "trace_id": tid,
+                "t_unix": 2.0,
+            }],
+        )
+        _recorder(
+            spool, role="scanplane-drive", service_id="dr", pid=30,
+            spans=[{
+                "name": "scanplane.drive.deliver", "trace_id": tid,
+                "t_unix": 3.0,
+            }],
+        )
+        trace = fleet.FleetAggregator(spool).trace(tid)
+        assert [s["name"] for s in trace] == [
+            "freshness.commit", "scanplane.range.produce",
+            "scanplane.drive.deliver",
+        ]
+        assert [s["pid"] for s in trace] == [10, 20, 30]
+        assert len({s["pid"] for s in trace}) >= 2  # spans ≥ 2 processes
+
+    def test_postmortem_recovers_killed_members_last_moments(self, spool):
+        """The in-process SIGKILL leg: a member whose heartbeat stopped is
+        stale, and its flight-recorder dump + last-flushed snapshot are
+        recoverable from the spool."""
+        fleet.process_identity(role="victim-role", service_id="victim-1")
+        src = MetricsRegistry()
+        src.counter("lakesoul_victim_rows_total").inc(77)
+        pub = fleet.FleetPublisher(spool, flush_s=60.0, source=src)
+        fleet.record_event(
+            "scanplane.range.lease", session="s1", range=4, fence=1
+        )
+        pub.flush(reason="scanplane.range.lease")
+        # no further flushes — the process is "SIGKILLed" here
+        time.sleep(0.06)
+        agg = fleet.FleetAggregator(spool, stale_after_s=0.05)
+        stale = agg.stale_members()
+        assert [m["service_id"] for m in stale] == ["victim-1"]
+        pms = agg.postmortems()
+        assert len(pms) == 1
+        pm = pms[0]
+        assert pm["role"] == "victim-role"
+        last = [e for e in pm["events"] if e["name"] == "scanplane.range.lease"]
+        assert last and last[-1]["attrs"]["range"] == 4
+        assert pm["last_snapshot"]["lakesoul_victim_rows_total"] == 77
+
+    def test_torn_or_garbage_files_are_skipped(self, spool):
+        with open(os.path.join(spool, "member-torn.json"), "w") as f:
+            f.write('{"role": "x", ')
+        with open(os.path.join(spool, "member-list.json"), "w") as f:
+            f.write("[1, 2]")
+        _member(spool, role="ok", service_id="ok1", snapshot={})
+        doc = fleet.FleetAggregator(spool, stale_after_s=30.0).aggregate()
+        assert [m["service_id"] for m in doc["members"]] == ["ok1"]
+
+
+# ------------------------------------------------- client stage-merge dedup
+
+
+class TestClientStageMergeCompat:
+    def _client(self):
+        from lakesoul_tpu.scanplane.client import ScanPlaneClient
+
+        return ScanPlaneClient("grpc://127.0.0.1:1")
+
+    def test_series_byte_compatible_with_stage_merge(self):
+        from lakesoul_tpu.obs.stages import STAGE_FAMILY, stage_merge
+
+        c = self._client()
+        c._merge_stages(
+            {"range": 0, "worker": "compatA",
+             "stages": {"decode": {"s": 0.25, "count": 5},
+                        "merge": {"s": 0.1, "count": 5}}},
+            set(),
+        )
+        # the OLD hand-rolled path, distinct worker label, same deltas
+        stage_merge("decode", 0.25, 5, worker="compatB")
+        stage_merge("merge", 0.1, 5, worker="compatB")
+        snap = registry().snapshot()
+        for stage in ("decode", "merge"):
+            new_key = f'{STAGE_FAMILY}{{stage="{stage}",worker="compatA"}}'
+            old_key = f'{STAGE_FAMILY}{{stage="{stage}",worker="compatB"}}'
+            assert new_key in snap, sorted(
+                k for k in snap if k.startswith(STAGE_FAMILY)
+            )
+            assert snap[new_key] == snap[old_key]  # identical series values
+
+    def test_worker_label_folding_and_dedup_by_range(self):
+        c = self._client()
+        c._worker_labels = {f"w{i}" for i in range(c.MAX_WORKER_LABELS)}
+        merged: set = set()
+        c._merge_stages(
+            {"range": 1, "worker": "overflow-worker",
+             "stages": {"decode": {"s": 0.5, "count": 1}}},
+            merged,
+        )
+        snap = registry().snapshot()
+        assert any('worker="other"' in k for k in snap)
+        assert not any("overflow-worker" in k for k in snap)
+        # a redelivered range's sidecar must not double-count
+        before = dict(snap)
+        c._merge_stages(
+            {"range": 1, "worker": "overflow-worker",
+             "stages": {"decode": {"s": 0.5, "count": 1}}},
+            merged,
+        )
+        after = {
+            k: v for k, v in registry().snapshot().items() if k in before
+        }
+        assert after == before
+
+
+# ---------------------------------------------------------------- console
+
+
+class TestConsoleFleetStatus:
+    def test_fleet_status_renders_members_north_star_and_postmortems(
+        self, tmp_warehouse, spool
+    ):
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.service.console import Console
+
+        now = time.time()
+        _member(
+            spool, role="scanplane-worker", service_id="w1",
+            snapshot={"lakesoul_scanplane_client_rows_total": 500},
+            kinds={"lakesoul_scanplane_client_rows_total": "counter"},
+            started_unix=now - 10.0,
+        )
+        _member(
+            spool, role="compactor", service_id="dead1",
+            snapshot={}, heartbeat_unix=now - 120.0, started_unix=now - 200.0,
+        )
+        _recorder(
+            spool, role="compactor", service_id="dead1",
+            events=[{"t_unix": now - 130.0, "name": "compaction.lease"}],
+        )
+        c = Console(LakeSoulCatalog(str(tmp_warehouse)))
+        out = c.execute(f"fleet-status {spool}")
+        assert "2 members" in out
+        assert "scanplane-worker" in out and "[live]" in out
+        assert "[STALE]" in out
+        assert "north star" in out and "rows/s" in out
+        assert "freshness SLO" in out
+        assert "postmortem: compactor dead1" in out
+        assert "compaction.lease" in out
+        assert "fleet-status" in c.execute("help")
+
+    def test_fleet_status_without_spool_or_members(self, tmp_warehouse, spool, monkeypatch):
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.service.console import Console
+
+        monkeypatch.delenv("LAKESOUL_OBS_SPOOL", raising=False)
+        c = Console(LakeSoulCatalog(str(tmp_warehouse)))
+        assert "no spool" in c.execute("fleet-status")
+        assert "no members" in c.execute(f"fleet-status {spool}")
+
+
+# --------------------------------------------------- slow: real SIGKILL leg
+
+
+@pytest.mark.slow
+class TestSigkillPostmortemSubprocess:
+    def test_killed_worker_leaves_recoverable_postmortem(self, tmp_path):
+        """SIGKILL a REAL scanplane worker mid-range (holding its lease):
+        its flight-recorder dump and last-flushed snapshot are recoverable
+        from the obs spool, and heartbeat age marks it stale."""
+        import pathlib
+        import signal
+        import subprocess
+        import sys
+
+        import numpy as np
+        import pyarrow as pa
+
+        from lakesoul_tpu import LakeSoulCatalog
+        from lakesoul_tpu.scanplane.session import ScanSession
+
+        repo = str(pathlib.Path(__file__).resolve().parent.parent)
+        wh, db = str(tmp_path / "wh"), str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        schema = pa.schema([("id", pa.int64()), ("v", pa.float64())])
+        t = catalog.create_table("t", schema, primary_keys=["id"],
+                                 hash_bucket_num=2)
+        rng = np.random.default_rng(5)
+        ids = np.sort(rng.choice(40_000, 8_000, replace=False)).astype(np.int64)
+        t.upsert(pa.table(
+            {"id": ids, "v": rng.normal(size=len(ids))}, schema=schema
+        ))
+
+        spool = str(tmp_path / "spool")
+        obs_spool = str(tmp_path / "obs")
+        os.makedirs(spool)
+        session = ScanSession.plan(catalog, {"table": "t", "batch_size": 4096})
+        session.publish(spool)
+
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo,
+            "LAKESOUL_FAULTS": "scanplane.range:1:hang:300",
+            "LAKESOUL_OBS_SPOOL": obs_spool,
+            "LAKESOUL_OBS_FLUSH_S": "0.2",
+        })
+        victim = subprocess.Popen(
+            [
+                sys.executable, "-m", "lakesoul_tpu.scanplane", "worker",
+                "--warehouse", wh, "--db-path", db, "--spool", spool,
+                "--lease-ttl-s", "2.0", "--poll-s", "0.05",
+                "--worker-id", "victim",
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=repo,
+        )
+        try:
+            store = catalog.client.store
+            keys = [
+                f"scanplane/{session.session_id}/{i}"
+                for i in range(len(session.ranges))
+            ]
+            held = None
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and held is None:
+                for k in keys:
+                    lease = store.get_lease(k)
+                    if lease is not None and lease.holder == "victim":
+                        held = k
+                        break
+                if victim.poll() is not None:
+                    _, err = victim.communicate(timeout=10.0)
+                    pytest.fail(f"victim exited early: {err[-2000:]}")
+                time.sleep(0.05)
+            assert held is not None, "victim never leased a range"
+            held_index = int(held.rsplit("/", 1)[-1])
+            # the record_event(flush=True) at lease-acquire must already
+            # have pinned the recorder before the hang window
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(10.0)
+
+            time.sleep(0.5)  # let heartbeat age past stale_after below
+            agg = fleet.FleetAggregator(obs_spool, stale_after_s=0.4)
+            stale = agg.stale_members()
+            assert any(
+                m["service_id"] == "victim" for m in stale
+            ), [m.get("service_id") for m in agg.members()]
+            pms = agg.postmortems()
+            pm = next(p for p in pms if p["service_id"] == "victim")
+            assert pm["role"] == "scanplane-worker"
+            lease_events = [
+                e for e in pm["events"]
+                if e["name"] == "scanplane.range.lease"
+            ]
+            assert lease_events, pm["events"]
+            assert lease_events[-1]["attrs"]["range"] == held_index
+            assert lease_events[-1]["attrs"]["session"] == session.session_id
+            # the last-flushed snapshot rides along: the worker had stamped
+            # its build info before dying
+            assert any(
+                k.startswith("lakesoul_build_info")
+                for k in pm["last_snapshot"]
+            )
+        finally:
+            if victim.poll() is None:
+                victim.kill()
