@@ -19,6 +19,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py ann_scale  # sharded ANN plane: 10M x 128d build/recall/QPS
     python benchmarks/micro.py tensor_replay # epoch-1 stream vs epoch-2 device replay (8-dev mesh)
     python benchmarks/micro.py obs_fleet  # fleet obs: 3-role chaos, 1 snapshot, traces, postmortems
+    python benchmarks/micro.py fleet      # multi-host trainers: 1→2→4 emulated hosts + kill-a-host
     python benchmarks/micro.py all
 """
 
@@ -2054,6 +2055,318 @@ def bench_obs_fleet(
                 pub.stop()
 
 
+# the fleet leg's scaling gate: aggregate trainer rows/s must grow at
+# least this factor from 1 → 2 emulated hosts (near-linear modulo fixed
+# session/connect overheads); the leg FAILS below it
+FLEET_SCALE_FLOOR = float(os.environ.get("LAKESOUL_FLEET_SCALE_FLOOR", 1.7))
+
+
+def bench_fleet(
+    n_rows: int = 2_000_000, n_buckets: int = 16, ttl_s: float = 2.0,
+    total_devices: int = 8, step_s: float = 0.15,
+) -> None:
+    """Multi-host training surface at fleet shape (ROADMAP item 2): N
+    emulated hosts — each a REAL gateway process plus a REAL trainer
+    process (``python -m lakesoul_tpu.fleet train`` under
+    ``LAKESOUL_FLEET_PROCESS_INDEX/_COUNT``, bound to a disjoint device
+    subset via ``xla_force_host_platform_device_count``) — consume one
+    table through the scan fabric on the forced ``stream`` transport (the
+    no-shared-medium cross-host floor).  Three claims, all asserted:
+
+    - **per-rank sha identity**: every rank's collated-host-array sha256
+      equals the single-process ``scan.shard(rank, world)`` stream;
+    - **scaling**: aggregate trainer rows/s grows ≥``FLEET_SCALE_FLOOR``
+      from 1 → 2 hosts (4-host figure emitted alongside) over a warm
+      spool with an emulated fixed per-batch training step (``step_s`` —
+      each host's devices are busy per batch, the realistic consumption
+      shape): N hosts step over disjoint shards concurrently, so the
+      fabric's aggregate feed rate must scale with hosts.  Production is
+      bench_scanplane's axis;
+    - **kill-a-host chaos**: SIGKILL one host's gateway AND one
+      autoscaler-owned worker mid-run → the surviving rank completes
+      exactly-once, the autoscaler backfills the dead worker within one
+      lease TTL, and the orphaned rank relaunched against the surviving
+      gateway completes the same session exactly-once."""
+    import hashlib
+    import signal
+    import subprocess
+    import threading
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.fleet.multihost import digest_batch
+    from lakesoul_tpu.scanplane.session import ScanSession
+    from lakesoul_tpu.scanplane.worker import ScanPlaneWorker
+
+    rng = np.random.default_rng(0)
+    schema = pa.schema([
+        ("id", pa.int64()), ("label", pa.int32()),
+        ("f0", pa.float32()), ("f1", pa.float32()),
+        ("f2", pa.float32()), ("f3", pa.float32()),
+    ])
+    batch_size = 65_536
+
+    def child_env(**extra) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+            "LAKESOUL_RETRY_SEED": "7", "LAKESOUL_RETRY_CAP_S": "0.5",
+        })
+        env.update(extra)
+        return env
+
+    def spawn_gateway(wh, db, spool):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lakesoul_tpu.scanplane", "service",
+             "--warehouse", wh, "--db-path", db, "--spool", spool,
+             "--workers", "0"],
+            env=child_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+        )
+        handle = proc.stdout.readline()
+        assert handle, "gateway died before printing its handle"
+        return proc, json.loads(handle)["location"]
+
+    def spawn_trainer(wh, db, location, rank, world, step_s=0.0):
+        # each emulated host owns a DISJOINT device subset of the mesh
+        return subprocess.Popen(
+            [sys.executable, "-m", "lakesoul_tpu.fleet", "train",
+             "--warehouse", wh, "--db-path", db, "--table", "t",
+             "--batch-size", str(batch_size), "--location", location,
+             "--step-s", str(step_s)],
+            env=child_env(
+                LAKESOUL_FLEET_PROCESS_INDEX=str(rank),
+                LAKESOUL_FLEET_PROCESS_COUNT=str(world),
+                LAKESOUL_FLEET_TRANSPORT="stream",
+                XLA_FLAGS=(
+                    "--xla_force_host_platform_device_count="
+                    f"{total_devices // world}"
+                ),
+            ),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+    def finish(proc, *, timeout=600.0) -> dict:
+        out, err = proc.communicate(timeout=timeout)
+        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        assert proc.returncode == 0 and lines, err[-2000:]
+        return json.loads(lines[-1])
+
+    with tempfile.TemporaryDirectory() as d:
+        wh, db = os.path.join(d, "wh"), os.path.join(d, "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table(
+            "t", schema, primary_keys=["id"], hash_bucket_num=n_buckets,
+        )
+        t.write_arrow(pa.table({
+            "id": np.arange(n_rows, dtype=np.int64),
+            "label": rng.integers(0, 10, n_rows).astype(np.int32),
+            **{f"f{j}": rng.normal(size=n_rows).astype(np.float32)
+               for j in range(4)},
+        }, schema=schema))
+        ids = np.sort(
+            rng.choice(n_rows, n_rows // 4, replace=False)
+        ).astype(np.int64)
+        t.upsert(pa.table({
+            "id": ids,
+            "label": rng.integers(0, 10, len(ids)).astype(np.int32),
+            **{f"f{j}": rng.normal(size=len(ids)).astype(np.float32)
+               for j in range(4)},
+        }, schema=schema))
+
+        # single-process shard-scan oracles, hashed EXACTLY as the train
+        # role hashes (collated host arrays through digest_batch)
+        def shard_sha(rank: int, world: int) -> "tuple[str, int]":
+            scan = t.scan().batch_size(batch_size)
+            if world > 1:
+                scan = scan.shard(rank, world)
+            digest = hashlib.sha256()
+            rows = 0
+            for batch in scan.to_jax_iter(
+                device_put=False, drop_remainder=False
+            ):
+                rows += digest_batch(digest, batch)
+            return digest.hexdigest(), rows
+
+        oracle = {
+            world: {r: shard_sha(r, world) for r in range(world)}
+            for world in (1, 2, 4)
+        }
+        total_rows = sum(rows for _, rows in oracle[1].values())
+
+        # warm spool for the scaling legs: production (bench_scanplane's
+        # axis) runs once up front; the measured window is pure delivery —
+        # gateway stream + collate + hash per host
+        spool_base = "/dev/shm" if os.path.isdir("/dev/shm") else d
+        spool = tempfile.mkdtemp(prefix="lsf-", dir=spool_base)
+        try:
+            ScanSession.plan(
+                catalog, {"table": "t", "batch_size": batch_size}
+            ).publish(spool)
+            ScanPlaneWorker(catalog, spool, lease_ttl_s=30).poll_once()
+
+            rates = {}
+            for world in (1, 2, 4):
+                gws = []
+                try:
+                    gws = [spawn_gateway(wh, db, spool) for _ in range(world)]
+                    trainers = [
+                        spawn_trainer(wh, db, gws[r][1], r, world,
+                                      step_s=step_s)
+                        for r in range(world)
+                    ]
+                    outs = [finish(p) for p in trainers]
+                    for rank, doc in enumerate(outs):
+                        sha, rows = oracle[world][rank]
+                        assert doc["rows"] == rows, (world, rank)
+                        assert doc["sha256"] == sha, (
+                            f"rank {rank}/{world} diverged from the"
+                            " single-process shard scan"
+                        )
+                        assert doc["local_devices"] == total_devices // world
+                    window = max(o["ended_unix"] for o in outs) \
+                        - min(o["started_unix"] for o in outs)
+                    rates[world] = total_rows / window
+                finally:
+                    for gw, _ in gws:
+                        gw.terminate()
+                    for gw, _ in gws:
+                        try:
+                            gw.wait(10.0)
+                        except subprocess.TimeoutExpired:
+                            gw.kill()
+            scale2 = rates[2] / rates[1]
+            scale4 = rates[4] / rates[1]
+        finally:
+            shutil.rmtree(spool, ignore_errors=True)
+
+        # kill-a-host chaos: COLD spool, the worker fleet owned by a real
+        # autoscaler; SIGKILL host B's gateway + one autoscaler child
+        spool = tempfile.mkdtemp(prefix="lsf-", dir=spool_base)
+        events = []
+        worker_pids = set()
+        procs = []
+        backfill_s = None
+        try:
+            gw_a, loc_a = spawn_gateway(wh, db, spool)
+            procs.append(gw_a)
+            gw_b, loc_b = spawn_gateway(wh, db, spool)
+            procs.append(gw_b)
+            scaler = subprocess.Popen(
+                [sys.executable, "-m", "lakesoul_tpu.fleet", "autoscale",
+                 "--warehouse", wh, "--db-path", db, "--spool", spool,
+                 "--min-workers", "2", "--max-workers", "4",
+                 "--lease-ttl-s", str(ttl_s), "--poll-s", "0.1",
+                 "--worker-lease-ttl-s", str(ttl_s),
+                 "--worker-poll-s", "0.05"],
+                env=child_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True,
+            )
+            procs.append(scaler)
+
+            def pump():
+                for line in scaler.stdout:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    ev["_at"] = time.monotonic()
+                    if ev.get("event") == "spawn":
+                        worker_pids.add(ev["pid"])
+                    events.append(ev)
+
+            threading.Thread(target=pump, daemon=True).start()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and len(worker_pids) < 2:
+                assert scaler.poll() is None, "autoscaler exited early"
+                time.sleep(0.05)
+            assert len(worker_pids) >= 2, "autoscaler never reached min"
+
+            rank0 = spawn_trainer(wh, db, loc_a, 0, 2)
+            procs.append(rank0)
+            rank1 = spawn_trainer(wh, db, loc_b, 1, 2)
+            procs.append(rank1)
+            time.sleep(1.0)
+            victim_pid = sorted(worker_pids)[0]
+            gw_b.send_signal(signal.SIGKILL)
+            os.kill(victim_pid, signal.SIGKILL)
+            killed_at = time.monotonic()
+
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and backfill_s is None:
+                snap = list(events)
+                for i, ev in enumerate(snap):
+                    if ev.get("event") == "worker_exit" \
+                            and ev.get("pid") == victim_pid:
+                        later = [e for e in snap[i + 1:]
+                                 if e.get("event") == "spawn"]
+                        if later:
+                            backfill_s = later[0]["_at"] - killed_at
+                        break
+                time.sleep(0.05)
+            assert backfill_s is not None, "autoscaler never backfilled"
+            assert backfill_s < ttl_s, (
+                f"backfill took {backfill_s:.2f}s — one lease TTL is {ttl_s}s"
+            )
+
+            doc0 = finish(rank0)
+            sha, rows = oracle[2][0]
+            assert doc0["rows"] == rows and doc0["sha256"] == sha, (
+                "surviving rank diverged through the kill"
+            )
+            # the orphaned rank, relaunched against the SURVIVING gateway,
+            # completes the same session exactly-once (delivered state
+            # lives in the spool fabric, not the dead gateway)
+            try:
+                rank1.communicate(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                rank1.kill()
+                rank1.communicate(timeout=10.0)
+            relaunched = spawn_trainer(wh, db, loc_a, 1, 2)
+            procs.append(relaunched)
+            doc1 = finish(relaunched)
+            sha, rows = oracle[2][1]
+            assert doc1["rows"] == rows and doc1["sha256"] == sha, (
+                "relaunched rank diverged after the gateway kill"
+            )
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(10.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            for pid in worker_pids:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            shutil.rmtree(spool, ignore_errors=True)
+
+        _emit(
+            "fleet", rates[2], "rows/s",
+            rows=total_rows,
+            transport="stream",
+            hosts_1_rows_per_s=round(rates[1], 1),
+            hosts_2_rows_per_s=round(rates[2], 1),
+            hosts_4_rows_per_s=round(rates[4], 1),
+            scale_1_to_2=round(scale2, 2),
+            scale_1_to_4=round(scale4, 2),
+            scale_floor=FLEET_SCALE_FLOOR,
+            devices_per_host={w: total_devices // w for w in (1, 2, 4)},
+            per_rank_sha_identical=True,
+            emulated_step_s=step_s,
+            chaos_backfill_s=round(backfill_s, 3),
+            chaos_exactly_once=True,
+            lease_ttl_s=ttl_s,
+        )
+        assert scale2 >= FLEET_SCALE_FLOOR, (
+            f"fleet scaled only {scale2:.2f}x from 1→2 hosts —"
+            f" floor is {FLEET_SCALE_FLOOR}x"
+        )
+
+
 LEGS = {
     "merge": bench_merge,
     "scan_stages": bench_scan_stages,
@@ -2071,6 +2384,7 @@ LEGS = {
     "ann_scale": bench_ann_scale,
     "tensor_replay": bench_tensor_replay,
     "obs_fleet": bench_obs_fleet,
+    "fleet": bench_fleet,
 }
 
 

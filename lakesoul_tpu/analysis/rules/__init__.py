@@ -26,6 +26,7 @@ from lakesoul_tpu.analysis.rules.conventions import (
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.endpoint import HardcodedEndpointRule
 from lakesoul_tpu.analysis.rules.identity import FleetIdentityLabelRule
 from lakesoul_tpu.analysis.rules.lifetime import (
     RingAliasingRule,
@@ -77,6 +78,7 @@ def all_rules() -> list[Rule]:
         UnstoppableLoopRule(),
         ReplayHostRoundtripRule(),
         FleetIdentityLabelRule(),
+        HardcodedEndpointRule(),
         # interprocedural (call graph + dataflow)
         RbacGateReachabilityRule(),
         TaintPathSegmentsRule(),

@@ -15,6 +15,8 @@ Allowed homes:
 
 - ``scanplane/`` — the process-topology layer itself (worker children,
   supervised spawning);
+- ``fleet/`` — the fleet plane (the autoscaler spawns and supervises
+  scanplane worker children under its lease);
 - ``runtime/`` — the execution runtime (owns parallelism policy);
 - the existing serving entries: ``obs/exporter.py`` (the /metrics HTTP
   endpoint) and ``service/storage_proxy.py`` (the storage-proxy HTTP
@@ -48,6 +50,7 @@ from lakesoul_tpu.analysis.engine import Finding, Module, Rule, dotted_name
 # module-path fragments where process/socket primitives are legitimate
 _ALLOWED = (
     "/scanplane/",
+    "/fleet/",
     "/runtime/",
     "obs/exporter.py",
     "service/storage_proxy.py",

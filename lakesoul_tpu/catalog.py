@@ -726,12 +726,17 @@ class LakeSoulScan:
         return self._replace(_rank=rank, _world=world_size)
 
     def auto_shard(self) -> "LakeSoulScan":
-        """Shard by JAX process — the TPU-native analogue of the reference's
-        torch.distributed auto-detection (arrow/dataset.py:353)."""
-        import jax
+        """Shard by this process's position on the data axis — the
+        TPU-native analogue of the reference's torch.distributed
+        auto-detection (arrow/dataset.py:353).  The axis resolves through
+        the fleet plane (``jax.process_index()/process_count()``, with the
+        ``LAKESOUL_FLEET_PROCESS_INDEX``/``_COUNT`` emulation override), so
+        every consumer — jax, torch, ray — shards identically."""
+        from lakesoul_tpu.fleet.multihost import process_axis
 
-        if jax.process_count() > 1:
-            return self.shard(jax.process_index(), jax.process_count())
+        index, count = process_axis()
+        if count > 1:
+            return self.shard(index, count)
         return self
 
     def batch_size(self, n: int) -> "LakeSoulScan":

@@ -504,6 +504,13 @@ class JaxBatchIterator:
             only honoured while fully resident, a spilled cache replays
             in stream order so the hybrid epoch stays position-exact.
         replay_seed: seed pinning the permutation schedule.
+        multihost: shard the scan by this process's position on the data
+            axis (``jax.process_index()/process_count()``, overridable via
+            ``LAKESOUL_FLEET_PROCESS_INDEX``/``_COUNT`` for emulated
+            multi-host) before the pipeline resolves it — N hosts then
+            consume disjoint, union-complete shards, and ``cache='device'``
+            pins exactly the local shard.  A scan already ``shard()``-ed
+            the same way passes through; a conflicting shard raises.
     """
 
     def __init__(
@@ -525,8 +532,21 @@ class JaxBatchIterator:
         replay_seed: int = 0,
         consumer: str | None = None,
         follow=None,
+        multihost: bool = False,
     ):
         from lakesoul_tpu.errors import ConfigError
+
+        if multihost:
+            # shard BEFORE anything else resolves the scan: the batch
+            # source, plan digest, replay cache and checkpoint must all
+            # see the local host's shard, never the global table.  The
+            # process axis comes from jax.process_index()/process_count()
+            # (LAKESOUL_FLEET_PROCESS_INDEX/COUNT override for emulated
+            # multi-host); a consistently pre-sharded scan passes through,
+            # a conflicting one raises (fleet/multihost.py).
+            from lakesoul_tpu.fleet.multihost import shard_scan
+
+            scan = shard_scan(scan)
 
         if cache not in (None, "device"):
             raise ConfigError(f"unknown cache mode {cache!r}; expected 'device'")

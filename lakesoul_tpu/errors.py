@@ -63,6 +63,44 @@ class TensorColumnError(LakeSoulError):
     instead of three stages into a training run as a shape error."""
 
 
+class ScanPlaneWaitTimeout(LakeSoulError):
+    """A ``scan_stream`` exchange exhausted ``LAKESOUL_SCANPLANE_WAIT_S``
+    waiting for a worker to produce a range.  Carries the session id and
+    the range index so an operator can tell WHICH shard starved (no
+    workers against the spool, or a fleet too small for the backlog) —
+    the generic Flight error this used to surface said neither.  The
+    message format is part of the wire contract: the client re-raises the
+    typed form from the marker the gateway's error string carries."""
+
+    MARKER = "scanplane wait exhausted"
+
+    def __init__(self, session: str, range_index: int, wait_s: float):
+        self.session = session
+        self.range_index = int(range_index)
+        self.wait_s = float(wait_s)
+        super().__init__(
+            f"{self.MARKER}: session={session} range={range_index} after"
+            f" {wait_s:.0f}s — are scanplane workers running against this"
+            " spool?"
+        )
+
+    @classmethod
+    def from_message(cls, message: str) -> "ScanPlaneWaitTimeout | None":
+        """Re-raise surface for the client: recover the typed error from a
+        Flight error string that carries the marker (gateway errors cross
+        the wire as text).  Returns ``None`` for unrelated messages."""
+        import re
+
+        m = re.search(
+            r"scanplane wait exhausted: session=(\S+) range=(\d+) after"
+            r" (\d+)s",
+            message,
+        )
+        if m is None:
+            return None
+        return cls(m.group(1), int(m.group(2)), float(m.group(3)))
+
+
 class TransientError(LakeSoulError):
     """Marker base for failures that are expected to clear on their own
     (network blips, 5xx, races): the resilience layer

@@ -1,0 +1,43 @@
+"""Fleet plane: the cross-host tier composing the existing planes.
+
+Three concerns, one package (ROADMAP item 2 — the pod-scale data fabric):
+
+- :mod:`~lakesoul_tpu.fleet.transport` — the spool-segment transport seam.
+  The PR-11 shm fast path stays the same-host lane; an object-store
+  **spill** transport persists sealed segments (fsync+rename, CRC
+  sidecars, pruned with the session) for cross-host pulls through the
+  resilient fs; the Flight **stream** transport is the always-works
+  floor.  Negotiation extends the shm probe: prove-you-can-read → shm,
+  else prove-you-can-read-the-spill-prefix → spill, else stream.
+- :mod:`~lakesoul_tpu.fleet.autoscale` — a leased controller that watches
+  the spool backlog and the FleetAggregator merged view and spawns /
+  retires scanplane workers between a declared min/max; a SIGKILLed
+  controller fails over fenced via the PR-7 lease table.
+- :mod:`~lakesoul_tpu.fleet.multihost` — the process-indexed training
+  surface: ``to_jax_iter(multihost=True)`` shards the scan by
+  ``jax.process_index()/process_count()`` (env-overridable for emulated
+  multi-host), so N hosts consume disjoint, union-complete shards and
+  the replay cache pins exactly the local host's shard.
+
+``python -m lakesoul_tpu.fleet`` exposes the ``autoscale`` and ``train``
+roles — the processes the chaos suite SIGKILLs.
+"""
+
+from __future__ import annotations
+
+from lakesoul_tpu.fleet.multihost import process_axis, shard_scan
+from lakesoul_tpu.fleet.transport import (
+    TRANSPORTS,
+    forced_transport,
+    meter_range,
+    negotiated,
+)
+
+__all__ = [
+    "TRANSPORTS",
+    "forced_transport",
+    "meter_range",
+    "negotiated",
+    "process_axis",
+    "shard_scan",
+]

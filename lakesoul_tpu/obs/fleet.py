@@ -488,6 +488,37 @@ def child_env(base: dict | None = None, *, trace_id: str | None = None) -> dict:
 # --------------------------------------------------------------- aggregation
 
 
+_TRANSPORT_BYTES_FAMILY = "lakesoul_fleet_transport_bytes_total"
+
+
+def _member_transport(snapshot: dict) -> "tuple[str | None, int]":
+    """(negotiated transport, bytes moved) for one member's snapshot: the
+    rung that carried the most bytes, total across all rungs.  ``(None,
+    0)`` for members that never used the transport seam (writers, the
+    compactor)."""
+    best = None
+    best_bytes = -1
+    total = 0
+    for key, value in snapshot.items():
+        if not key.startswith(_TRANSPORT_BYTES_FAMILY + "{"):
+            continue
+        if isinstance(value, dict):
+            continue
+        labels = key[key.index("{") + 1:-1]
+        name = None
+        for part in labels.split(","):
+            k, _, v = part.partition("=")
+            if k == "transport":
+                name = v.strip('"')
+        if name is None:
+            continue
+        nbytes = int(value)
+        total += nbytes
+        if nbytes > best_bytes:
+            best, best_bytes = name, nbytes
+    return best, total
+
+
 class FleetAggregator:
     """Merge an obs spool's member snapshots into ONE fleet view.
 
@@ -554,6 +585,7 @@ class FleetAggregator:
             except (TypeError, ValueError):
                 continue
             age = max(0.0, now - hb)
+            transport, moved = _member_transport(doc.get("snapshot") or {})
             member = {
                 "role": role,
                 "service_id": service_id,
@@ -563,6 +595,12 @@ class FleetAggregator:
                 "started_unix": doc.get("started_unix"),
                 "heartbeat_age_s": round(age, 3),
                 "stale": age > self.stale_after_s,
+                # the member's negotiated fleet transport (its dominant
+                # rung by bytes moved) — console fleet-status's transport
+                # column; the per-rung counters themselves sum into the
+                # merged snapshot below
+                "transport": transport,
+                "transport_bytes": moved,
             }
             reg.merge_snapshot(
                 doc.get("snapshot") or {},

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import sys
 import time
 
 import pyarrow as pa
@@ -59,8 +60,12 @@ class ScanPlaneClient:
         token / basic_auth / trace_id: same auth surface as
             :class:`~lakesoul_tpu.service.flight.LakeSoulFlightClient`.
         shm: ``"auto"`` (probe, use when the spool is readable here),
-            ``True`` (require the probe to pass), ``False`` (always pull
-            batches over the socket).
+            ``True`` (require the probe to pass), ``False`` (never map the
+            spool — pull ranges over the negotiated non-shm transport).
+        transport: force one rung of the transport ladder (``"shm"`` /
+            ``"spill"`` / ``"stream"``; default ``LAKESOUL_FLEET_TRANSPORT``
+            or auto-negotiate).  A forced shm/spill whose probe fails
+            raises instead of silently downgrading.
         max_attempts: reconnect budget per silent stretch — any delivered
             batch resets it (a long stream should not die because it hit
             N sheds spread over an hour).
@@ -74,8 +79,10 @@ class ScanPlaneClient:
         basic_auth: tuple[str, str] | None = None,
         trace_id: str | None = None,
         shm: "bool | str" = "auto",
+        transport: str | None = None,
         max_attempts: int | None = None,
     ):
+        from lakesoul_tpu.fleet import transport as fleet_transport
         from lakesoul_tpu.service.flight import LakeSoulFlightClient
 
         self.location = location
@@ -85,6 +92,9 @@ class ScanPlaneClient:
             location, token=token, basic_auth=basic_auth, trace_id=trace_id
         )
         self._shm = shm
+        # resolved once so a typo'd LAKESOUL_FLEET_TRANSPORT fails at
+        # construction, not deep inside the first exchange
+        self._transport = fleet_transport.forced_transport(transport)
         # projected schema of the last exchange (set at handshake): lets
         # consumers of empty slices still build schema-correct tables
         self.last_schema = None
@@ -96,8 +106,11 @@ class ScanPlaneClient:
         reg = registry()
         self._c_ranges = {
             m: reg.counter("lakesoul_scanplane_client_ranges_total", mode=m)
-            for m in ("shm", "socket")
+            for m in ("shm", "socket", "spill")
         }
+        self._c_wait_exhausted = reg.counter(
+            "lakesoul_scanplane_wait_exhausted_total"
+        )
         self._c_reconnects = reg.counter("lakesoul_scanplane_client_reconnects_total")
         # delivered rows: the scan plane's contribution to the fleet
         # aggregate-rows/s north star (obs.fleet sums *_rows_total families)
@@ -162,6 +175,17 @@ class ScanPlaneClient:
                         return
                 return
             except BaseException as e:  # noqa: BLE001 — classify() filters
+                from lakesoul_tpu.errors import ScanPlaneWaitTimeout
+
+                # the gateway's wait-exhausted error crosses the wire as a
+                # Flight error STRING carrying the typed marker: re-raise
+                # the typed form (naming session + range) and meter it —
+                # an unproduced range is a fleet-sizing fact, not a
+                # transient to burn the reconnect budget on
+                typed = ScanPlaneWaitTimeout.from_message(str(e))
+                if typed is not None:
+                    self._c_wait_exhausted.inc()
+                    raise typed from e
                 if not self._policy.classify(e):
                     raise
                 if made_progress:
@@ -194,7 +218,7 @@ class ScanPlaneClient:
     ):
         import pyarrow.flight as flight
 
-        from lakesoul_tpu.scanplane.delivery import probe_matches
+        from lakesoul_tpu.fleet import transport as fleet_transport
         from lakesoul_tpu.scanplane.session import canonical_request
 
         req = dict(canonical_request(request))
@@ -213,7 +237,7 @@ class ScanPlaneClient:
             json.dumps(req).encode()
         )
         writer, reader = self._fl.exchange(descriptor)
-        with writer:
+        try:
             hello = _read_meta(reader)
             if hello.get("kind") != "hello":
                 raise flight.FlightServerError(
@@ -221,19 +245,15 @@ class ScanPlaneClient:
                 )
             if pin.get("session") is None:
                 pin["session"] = hello.get("session")
-            offer = hello.get("shm")
-            use_shm = False
-            if self._shm in (True, "auto"):
-                use_shm = probe_matches(offer)
-                if self._shm is True and not use_shm:
-                    from lakesoul_tpu.errors import ConfigError
-
-                    raise ConfigError(
-                        "shm=True but the server's spool is not readable"
-                        " from this process (different host or mount)"
-                    )
+            offers = hello.get("transports") or {
+                "shm": hello.get("shm"), "spill": None, "stream": True,
+            }
+            chosen = self._negotiate(offers)
+            fleet_transport.negotiated(chosen)
             writer.write_metadata(json.dumps({
-                "kind": "mode", "shm": use_shm,
+                "kind": "mode",
+                "shm": chosen == "shm",
+                "transport": chosen,
             }).encode())
             try:
                 # the server begins the stream right after the mode reply;
@@ -245,6 +265,18 @@ class ScanPlaneClient:
 
             first_range = True  # start_batch applies only to the first one
             in_range = False  # a socket-mode range is currently streaming
+            # per-range stream-transport accounting (bytes that actually
+            # crossed the data plane + wall time to drain them)
+            stream_bytes = 0
+            stream_t0 = 0.0
+
+            def _close_stream_range():
+                self._c_ranges["socket"].inc()
+                fleet_transport.meter_range(
+                    "stream", stream_bytes,
+                    time.perf_counter() - stream_t0,
+                )
+
             while True:
                 try:
                     chunk = reader.read_chunk()
@@ -259,6 +291,7 @@ class ScanPlaneClient:
                     meta = json.loads(chunk.app_metadata.to_pybytes().decode())
                 if chunk.data is not None:
                     # socket mode: the SERVER already skipped start_batch
+                    stream_bytes += chunk.data.nbytes
                     yield ("batch", chunk.data)
                 if meta is None:
                     continue
@@ -266,7 +299,7 @@ class ScanPlaneClient:
                 if kind == "range":
                     if in_range:
                         yield ("range_done", None)
-                        self._c_ranges["socket"].inc()
+                        _close_stream_range()
                         in_range = False
                     self._merge_stages(meta, merged_stage_ranges)
                     if meta.get("path"):
@@ -277,23 +310,103 @@ class ScanPlaneClient:
                         yield from self._yield_segment(meta, skip)
                         yield ("range_done", None)
                         self._c_ranges["shm"].inc()
+                    elif meta.get("spill"):
+                        # spill rung: pull the sealed segment back off the
+                        # object store (CRC-verified); like shm, only this
+                        # control message crossed the socket
+                        skip = start_batch if first_range else 0
+                        yield from self._yield_spilled(meta, skip)
+                        yield ("range_done", None)
+                        self._c_ranges["spill"].inc()
                     else:
                         in_range = True
+                        stream_bytes = 0
+                        stream_t0 = time.perf_counter()
                     first_range = False
                 elif kind == "end":
                     if in_range:
                         yield ("range_done", None)
-                        self._c_ranges["socket"].inc()
+                        _close_stream_range()
                     yield ("end", None)
                     return
+        finally:
+            # close the writer ourselves instead of `with writer:` — when
+            # the body is already raising (a forced transport whose probe
+            # failed, a consumer abandoning the generator), the server's
+            # resulting broken-stream error at close time must not MASK
+            # that exception; on a clean exit the close error still
+            # propagates (same contract as the context manager)
+            try:
+                writer.close()
+            except Exception:
+                if sys.exc_info()[0] is None:
+                    raise
+
+    def _negotiate(self, offers: dict) -> str:
+        """Pick the transport rung for one exchange.  A forced rung
+        (ctor kwarg / ``LAKESOUL_FLEET_TRANSPORT``, with the legacy
+        ``shm=True/False`` knob folded in) must hold — its probe failing
+        raises.  Auto descends the ladder: prove-you-can-read the spool →
+        shm, prove-you-can-read the spill prefix → spill, else stream."""
+        from lakesoul_tpu.errors import ConfigError
+        from lakesoul_tpu.fleet import transport as fleet_transport
+        from lakesoul_tpu.scanplane.delivery import probe_matches
+
+        forced = self._transport
+        if forced is None and self._shm is True:
+            forced = "shm"
+        if forced == "shm":
+            if not probe_matches(offers.get("shm")):
+                raise ConfigError(
+                    "shm transport required but the server's spool is not"
+                    " readable from this process (different host or mount)"
+                )
+            return "shm"
+        if forced == "spill":
+            if not fleet_transport.spill_probe_matches(offers.get("spill")):
+                raise ConfigError(
+                    "spill transport required but the server's spill prefix"
+                    " is not readable from this process (no store access or"
+                    " no LAKESOUL_FLEET_SPILL on the gateway)"
+                )
+            return "spill"
+        if forced == "stream":
+            return "stream"
+        # auto: cheapest rung that proves readable (shm=False skips the
+        # mapping rung entirely — the legacy socket-only knob)
+        if self._shm is not False and probe_matches(offers.get("shm")):
+            return "shm"
+        if fleet_transport.spill_probe_matches(offers.get("spill")):
+            return "spill"
+        return "stream"
 
     def _yield_segment(self, meta, skip: int):
+        from lakesoul_tpu.fleet import transport as fleet_transport
         from lakesoul_tpu.scanplane.spool import read_range
         import os
 
         sdir, name = os.path.split(meta["path"])
         index = int(name[len("range-"):-len(".arrow")])
+        t0 = time.perf_counter()
         _, batches = read_range(sdir, index)
+        try:
+            nbytes = os.path.getsize(meta["path"])
+        except OSError:
+            nbytes = 0
+        fleet_transport.meter_range(
+            "shm", nbytes, time.perf_counter() - t0
+        )
+        for b in batches[skip:]:
+            yield ("batch", b)
+
+    def _yield_spilled(self, meta, skip: int):
+        from lakesoul_tpu.fleet import transport as fleet_transport
+
+        t0 = time.perf_counter()
+        nbytes, batches = fleet_transport.fetch_spilled(meta["spill"])
+        fleet_transport.meter_range(
+            "spill", nbytes, time.perf_counter() - t0
+        )
         for b in batches[skip:]:
             yield ("batch", b)
 

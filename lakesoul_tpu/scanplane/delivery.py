@@ -17,13 +17,21 @@ modes, one wire protocol:
 Wire protocol (all metadata is JSON):
 
 ==============  ==========================================================
-``hello`` →     ``{kind, session, nranges, shm: {probe, token} | null}``
-← ``mode``      ``{kind, shm: bool}`` — client ALWAYS answers (symmetric
-                read, no sniffing); truthy only after the probe verified
+``hello`` →     ``{kind, session, nranges, shm: {probe, token} | null,
+                transports: {shm, spill, stream}}`` — each transport key
+                carries its offer (probe + token) or null; ``stream`` is
+                always ``true``.  The legacy top-level ``shm`` key is the
+                same offer, kept for older clients.
+← ``mode``      ``{kind, shm: bool, transport: "shm"|"spill"|"stream"}`` —
+                client ALWAYS answers (symmetric read, no sniffing); a
+                non-stream transport only after its probe verified.
+                Older clients send only ``shm``.
 ``range`` →     ``{kind, range, rows, batches, worker?, fence?, stages?,
-                path?}`` — ``path`` present = shm fast path, no data
-                messages follow for this range; absent = the range's
-                record batches follow on the data plane
+                path?, spill?}`` — ``path`` present = shm fast path,
+                ``spill`` present = ``{path, crc32, nbytes}`` on the
+                object store; either way no data messages follow for this
+                range.  Neither = the range's record batches follow on
+                the data plane (the ``stream`` transport).
 ``end`` →       ``{kind, ranges}``
 ==============  ==========================================================
 
@@ -66,7 +74,11 @@ class ScanPlaneDelivery:
         *,
         wait_s: float | None = None,
         offer_shm: bool | None = None,
+        spill_prefix: str | None = None,
     ):
+        from lakesoul_tpu.fleet import transport as fleet_transport
+        from lakesoul_tpu.obs import registry
+
         self.catalog = catalog
         self.spool_dir = spool_dir
         self.wait_s = _env_float(ENV_WAIT_S, 120.0) if wait_s is None else float(wait_s)
@@ -74,6 +86,16 @@ class ScanPlaneDelivery:
             (_shm_enabled() and spool_dir is not None)
             if offer_shm is None
             else bool(offer_shm)
+        )
+        # the object-store spill rung is offered only when a prefix is
+        # configured (LAKESOUL_FLEET_SPILL) AND this head runs a spool —
+        # spilling re-publishes sealed spool segments, inline mode has none
+        self.spill_prefix = (
+            fleet_transport.spill_prefix() if spill_prefix is None
+            else (spill_prefix or None)
+        )
+        self._c_wait_exhausted = registry().counter(
+            "lakesoul_scanplane_wait_exhausted_total"
         )
 
     # ------------------------------------------------------------- sessions
@@ -130,6 +152,8 @@ class ScanPlaneDelivery:
             # distributed adapters (ray) fan out over
             pending = pending[: max(0, int(request["max_ranges"]))]
 
+        from lakesoul_tpu.fleet import transport as fleet_transport
+
         shm_offer = None
         if self.offer_shm and self.spool_dir is not None:
             # the probe is the manifest itself: a client that can read it
@@ -141,12 +165,30 @@ class ScanPlaneDelivery:
                 ),
                 "token": session.session_id,
             }
+        spill_offer = None
+        if self.spill_prefix is not None and self.spool_dir is not None:
+            try:
+                spill_offer = fleet_transport.write_spill_probe(
+                    self.spill_prefix, session.session_id
+                )
+            except Exception:
+                # an unreachable spill store degrades the OFFER, not the
+                # stream — the ladder still has shm and stream rungs
+                logger.warning(
+                    "spill probe publication failed; not offering spill",
+                    exc_info=True,
+                )
         writer.write_metadata(json.dumps({
             "kind": "hello",
             "session": session.session_id,
             "nranges": len(indices),
             "version_digest": session.version_digest,
             "shm": shm_offer,
+            "transports": {
+                "shm": shm_offer,
+                "spill": spill_offer,
+                "stream": True,
+            },
         }).encode())
 
         # symmetric negotiation: the client always answers with its mode
@@ -154,7 +196,15 @@ class ScanPlaneDelivery:
         mode = {}
         if chunk.app_metadata is not None:
             mode = json.loads(chunk.app_metadata.to_pybytes().decode())
-        use_shm = bool(mode.get("shm")) and shm_offer is not None
+        transport = mode.get("transport") or (
+            "shm" if mode.get("shm") else "stream"
+        )
+        # a claimed rung the server never offered falls to the floor: the
+        # stream transport serves any client
+        if transport == "shm" and shm_offer is None:
+            transport = "stream"
+        if transport == "spill" and spill_offer is None:
+            transport = "stream"
 
         scan = sess.scan_for_request(self.catalog, session.request)
         writer.begin(sess.projected_schema(scan))
@@ -165,7 +215,7 @@ class ScanPlaneDelivery:
             skip = start_batch if seq == 0 else 0
             if self.spool_dir is not None:
                 rows_total += self._serve_spooled(
-                    session, index, skip, use_shm, writer, metrics
+                    session, index, skip, transport, writer, metrics
                 )
             else:
                 rows_total += self._serve_inline(
@@ -178,24 +228,29 @@ class ScanPlaneDelivery:
         return {"rows": rows_total, "ranges": served}
 
     # ---------------------------------------------------------- spool mode
-    def _wait_ready(self, sdir: str, index: int) -> None:
+    def _wait_ready(self, session_id: str, sdir: str, index: int) -> None:
+        from lakesoul_tpu.errors import ScanPlaneWaitTimeout
+
         deadline = time.monotonic() + self.wait_s
         delay = 0.002
         while not spool.range_ready(sdir, index):
             if time.monotonic() >= deadline:
-                raise TimeoutError(
-                    f"range {index} not produced within {self.wait_s:.0f}s —"
-                    " are scanplane workers running against this spool?"
-                )
+                # typed + metered: the operator learns WHICH shard starved
+                # (and the autoscaler's merged view sees the starvation),
+                # instead of a generic Flight stream error
+                self._c_wait_exhausted.inc()
+                raise ScanPlaneWaitTimeout(session_id, index, self.wait_s)
             time.sleep(delay)
             # cap the poll low: this wait sits on the client's critical
             # path once per range, and a produced range is typically only
             # milliseconds away (tmpfs rename)
             delay = min(delay * 1.5, 0.02)
 
-    def _serve_spooled(self, session, index, skip, use_shm, writer, metrics) -> int:
+    def _serve_spooled(self, session, index, skip, transport, writer, metrics) -> int:
+        from lakesoul_tpu.fleet import transport as fleet_transport
+
         sdir = session.dir(self.spool_dir)
-        self._wait_ready(sdir, index)
+        self._wait_ready(session.session_id, sdir, index)
         # a stream can outlive the session TTL (slow trainer, huge shard):
         # every served range freshens the manifest so the pruner never
         # sweeps a session mid-delivery
@@ -210,15 +265,25 @@ class ScanPlaneDelivery:
             "fence": sidecar.get("fence"),
             "stages": sidecar.get("stages") or {},
         }
-        if use_shm:
-            meta["path"] = spool.segment_path(sdir, index)
+        if transport in ("shm", "spill"):
+            if transport == "shm":
+                meta["path"] = spool.segment_path(sdir, index)
+            else:
+                # persist the sealed segment to the spill prefix
+                # (idempotent; CRC sidecar is the publication barrier) and
+                # hand the client the object's coordinates — the data
+                # plane carries nothing for this range
+                meta["spill"] = fleet_transport.spill_range(
+                    self.spill_prefix, session.session_id, sdir, index
+                )
             writer.write_metadata(json.dumps(meta).encode())
             rows = int(sidecar.get("rows", 0))
             if skip:
-                # a resumed range: the client maps the segment and skips
-                # locally, so meter only what it will actually consume —
-                # sidecar batch_rows keeps this JSON arithmetic (older
-                # sidecars without it fall back to a zero-copy peek)
+                # a resumed range: the client maps (or fetches) the whole
+                # segment and skips locally, so meter only what it will
+                # actually consume — sidecar batch_rows keeps this JSON
+                # arithmetic (older sidecars without it fall back to a
+                # zero-copy peek)
                 per_batch = sidecar.get("batch_rows")
                 if per_batch is None:
                     _, segs = spool.read_range(sdir, index)

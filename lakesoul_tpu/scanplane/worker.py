@@ -33,6 +33,7 @@ import time
 from lakesoul_tpu.obs import registry, stage_counts, stage_seconds
 from lakesoul_tpu.obs import fleet
 from lakesoul_tpu.obs.tracing import span
+from lakesoul_tpu.fleet import transport
 from lakesoul_tpu.runtime import faults
 from lakesoul_tpu.runtime.resilience import _env_float
 from lakesoul_tpu.scanplane import session as sess
@@ -282,6 +283,13 @@ class ScanPlaneWorker:
                     logger.info(
                         "%s pruned %d expired spool sessions",
                         self.worker_id, pruned,
+                    )
+                # the spill mirrors the spool's lifecycle: sessions the
+                # pruner retired take their object-store copies with them
+                spill = transport.spill_prefix()
+                if spill:
+                    transport.prune_spill(
+                        spill, set(sess.list_sessions(self.spool_dir))
                     )
                 last_prune = time.monotonic()
             self._stop.wait(self.poll_interval_s)

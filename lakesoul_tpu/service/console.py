@@ -203,9 +203,17 @@ class Console:
                  f" stale after {doc['stale_after_s']}s):"]
         for m in sorted(doc["members"], key=lambda m: (m["role"], m["service_id"])):
             mark = "STALE" if m["stale"] else "live"
+            # transport column: which fleet-transport rung this member
+            # negotiated (dominant by bytes) and how much it moved — "-"
+            # for members that never touched the seam
+            via = (
+                f"{m['transport']}:{m['transport_bytes']}B"
+                if m.get("transport") else "-"
+            )
             lines.append(
                 f"  {m['role']:<18} {m['service_id']:<28} pid={m['pid']}"
-                f" heartbeat_age={m['heartbeat_age_s']:.1f}s [{mark}]"
+                f" heartbeat_age={m['heartbeat_age_s']:.1f}s"
+                f" transport={via} [{mark}]"
             )
         f = doc["fleet"]
         lines.append(

@@ -1,0 +1,15 @@
+"""Seeded hardcoded-endpoint violations: literal network addresses."""
+
+import os
+
+
+def connect():
+    gateway = "grpc://10.0.0.5:8815"  # SEED: hardcoded-endpoint (literal IP endpoint)
+    metrics = "localhost:9090"  # SEED: hardcoded-endpoint (bare localhost:port)
+    dashboard = "http://localhost/status"  # SEED: hardcoded-endpoint (loopback URI, no port)
+    broker = "broker.prod.internal:5432"  # SEED: hardcoded-endpoint (dotted hostname:port)
+    # allowed spellings: ephemeral binds, config resolution, plain labels
+    bind = "grpc://127.0.0.1:0"  # allowed (port 0 = bind-me-anywhere)
+    configured = os.environ.get("LAKESOUL_SCANPLANE_SPOOL", "localhost:9090")  # allowed (env default IS config)
+    label = "attempt:3"  # allowed (word:digits label, not an address)
+    return gateway, metrics, dashboard, broker, bind, configured, label

@@ -470,6 +470,20 @@ def test_fleet_identity_label_rule_seed_exact():
     assert all("identity_labels()" in f.message for f in findings)
 
 
+def test_hardcoded_endpoint_rule_seed_exact():
+    """Literal endpoints (URI with nonzero port, bare host:port with a
+    real host, loopback URIs) are flagged line-exactly; port-0 ephemeral
+    binds, env-lookup defaults, and word:digits labels pass."""
+    findings = [
+        f for f in lint_fixture("bad_endpoint.py")
+        if f.rule == "hardcoded-endpoint"
+    ]
+    assert_seed_lines(findings, "bad_endpoint.py", "hardcoded-endpoint")
+    msgs = "\n".join(f.message for f in findings)
+    assert "grpc://10.0.0.5:8815" in msgs
+    assert all("configuration" in f.message for f in findings)
+
+
 def test_sqlite_scope_rule():
     found = [f for f in lint_fixture("bad_sqlite.py") if f.rule == "sqlite-scope"]
     assert len(found) >= 2  # import + connect (cursor heuristic is a bonus)
@@ -624,11 +638,12 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 27 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 28 and "rbac-gate-reachability" in rule_ids
     assert "raw-process" in rule_ids
     assert "unstoppable-loop" in rule_ids
     assert "replay-host-roundtrip" in rule_ids
     assert "fleet-identity-label" in rule_ids
+    assert "hardcoded-endpoint" in rule_ids
     assert "pallas-blockspec" in rule_ids
     assert "shared-state-race" in rule_ids and "view-escapes-release" in rule_ids
     for r in driver["rules"]:

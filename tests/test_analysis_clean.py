@@ -1,10 +1,10 @@
-"""CI gate: the repo must lint clean — under ALL 27 rules: the 14
+"""CI gate: the repo must lint clean — under ALL 28 rules: the 15
 per-function ones (incl. ad-hoc-retry, wall-clock-lease,
 hot-path-materialize, raw-process, unstoppable-loop,
-replay-host-roundtrip and fleet-identity-label), the 4 interprocedural
-ones (call graph + dataflow), the 5 device-pack ones (jit/pallas trace
-safety), and the 4 concurrency-pack ones (thread-root locksets + buffer
-lifetimes).
+replay-host-roundtrip, fleet-identity-label and hardcoded-endpoint), the
+4 interprocedural ones (call graph + dataflow), the 5 device-pack ones
+(jit/pallas trace safety), and the 4 concurrency-pack ones (thread-root
+locksets + buffer lifetimes).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -21,12 +21,13 @@ EXPECTED_RULES = {
     # wall-clock-lease with the lease table, hot-path-materialize with the
     # zero-copy scan path, raw-process with the scan-plane topology,
     # unstoppable-loop with the freshness follower, replay-host-roundtrip
-    # with the tensor plane, fleet-identity-label with the fleet obs plane)
+    # with the tensor plane, fleet-identity-label with the fleet obs
+    # plane, hardcoded-endpoint with the fleet transport plane)
     "raw-thread", "lock-held-call", "stage-nondeterminism",
     "unclosed-reader", "undocumented-env", "metric-name", "sqlite-scope",
     "ad-hoc-retry", "wall-clock-lease", "hot-path-materialize",
     "raw-process", "unstoppable-loop", "replay-host-roundtrip",
-    "fleet-identity-label",
+    "fleet-identity-label", "hardcoded-endpoint",
     # interprocedural
     "rbac-gate-reachability", "taint-path-segments",
     "transitive-lock-held-call", "interprocedural-unclosed-reader",
@@ -49,13 +50,13 @@ CONCURRENCY_RULES = {
 }
 
 
-def test_all_twenty_seven_rules_registered():
+def test_all_twenty_eight_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 27
+    assert len(ids) == len(set(ids)) == 28
     assert set(ids) == EXPECTED_RULES
 
 

@@ -1,0 +1,612 @@
+"""Fleet plane: transport negotiation, worker autoscaling, multi-host axis.
+
+Three surfaces, one contract — the fleet gets bigger without the data
+changing:
+
+- **transport matrix**: the same scan forced through each rung (shm /
+  spill / stream) delivers byte-identical batches, and each rung meters
+  its own bytes/ranges into the obs registry;
+- **autoscaler**: the leased controller is a deterministic machine under
+  an injected clock — scale-up tracks backlog, scale-down waits out idle
+  polls, a lapsed lease is taken over with a BUMPED fencing token and the
+  zombie demotes itself (retiring its own children);
+- **multihost**: ``to_jax_iter(multihost=True)`` ranks are disjoint,
+  their union is the whole table, and each rank's stream matches a plain
+  ``scan.shard(rank, world)``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+from lakesoul_tpu.errors import ConfigError, ScanPlaneWaitTimeout
+from lakesoul_tpu.fleet import multihost, transport
+from lakesoul_tpu.fleet.autoscale import (
+    AutoscalePolicy,
+    AutoscaleSignals,
+    WorkerAutoscaler,
+    WorkerSpawner,
+    collect_signals,
+    lease_key,
+    spool_backlog,
+)
+from lakesoul_tpu.obs import fleet as obs_fleet
+from lakesoul_tpu.obs import registry
+from lakesoul_tpu.scanplane.client import ScanPlaneClient
+from lakesoul_tpu.scanplane.delivery import ScanPlaneDelivery
+from lakesoul_tpu.scanplane.session import ScanSession
+from lakesoul_tpu.scanplane.worker import ScanPlaneWorker
+from lakesoul_tpu.service.flight import LakeSoulFlightServer
+
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("f", pa.float32())])
+
+
+def _make_table(tmp_path, *, rows=12_000, commits=3, name="t"):
+    catalog = LakeSoulCatalog(
+        str(tmp_path / "wh"), db_path=str(tmp_path / "meta.db")
+    )
+    t = catalog.create_table(
+        name, SCHEMA, primary_keys=["id"], hash_bucket_num=2
+    )
+    rng = np.random.default_rng(7)
+    per = rows // commits
+    for _ in range(commits):
+        ids = np.sort(rng.choice(rows * 2, per, replace=False)).astype(np.int64)
+        t.upsert(pa.table({
+            "id": ids,
+            "v": rng.normal(size=per),
+            "f": rng.normal(size=per).astype(np.float32),
+        }, schema=SCHEMA))
+    return catalog, t
+
+
+class _Plane:
+    """In-process fleet: spool delivery gateway + worker threads, with the
+    object-store spill rung armed under ``tmp_path/spill_store``."""
+
+    def __init__(self, catalog, tmp_path, *, workers=1, wait_s=30.0,
+                 start_workers=True, spill=True):
+        self.spool = str(tmp_path / "spool")
+        os.makedirs(self.spool, exist_ok=True)
+        self.spill_prefix = str(tmp_path / "spill_store") if spill else None
+        self.delivery = ScanPlaneDelivery(
+            catalog, self.spool, wait_s=wait_s,
+            spill_prefix=self.spill_prefix or "",
+        )
+        self.server = LakeSoulFlightServer(
+            catalog, "grpc://127.0.0.1:0", scanplane=self.delivery
+        )
+        threading.Thread(target=self.server.serve, daemon=True).start()
+        self.location = f"grpc://127.0.0.1:{self.server.port}"
+        self._stops = []
+        self.workers = [
+            ScanPlaneWorker(
+                catalog, self.spool, lease_ttl_s=10.0,
+                poll_interval_s=0.02, worker_id=f"w{i}",
+            )
+            for i in range(workers)
+        ]
+        if start_workers:
+            for w in self.workers:
+                stop = threading.Event()
+                self._stops.append(stop)
+                threading.Thread(
+                    target=w.run_forever, kwargs={"stop_event": stop},
+                    daemon=True,
+                ).start()
+
+    def close(self):
+        for s in self._stops:
+            s.set()
+        self.server.shutdown()
+
+
+def _counter(snapshot, family, **labels):
+    key = family
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        key = f"{family}{{{inner}}}"
+    return snapshot.get(key, 0)
+
+
+# -------------------------------------------------------- transport seam
+
+
+class TestTransportConfig:
+    def test_forced_transport_resolution(self, monkeypatch):
+        monkeypatch.delenv(transport.ENV_TRANSPORT, raising=False)
+        assert transport.forced_transport() is None
+        assert transport.forced_transport("auto") is None
+        assert transport.forced_transport("") is None
+        assert transport.forced_transport("spill") == "spill"
+        monkeypatch.setenv(transport.ENV_TRANSPORT, "stream")
+        assert transport.forced_transport() == "stream"
+        # the explicit value (client kwarg) beats the env
+        assert transport.forced_transport("shm") == "shm"
+        with pytest.raises(ConfigError, match="unknown fleet transport"):
+            transport.forced_transport("carrier-pigeon")
+
+    def test_typoed_env_fails_at_client_construction(self, monkeypatch):
+        monkeypatch.setenv(transport.ENV_TRANSPORT, "hsm")
+        with pytest.raises(ConfigError, match="unknown fleet transport"):
+            ScanPlaneClient("grpc://127.0.0.1:0")
+
+    def test_spill_publication_crc_and_prune(self, tmp_path):
+        prefix = str(tmp_path / "store")
+        offer = transport.write_spill_probe(prefix, "sess-a")
+        assert transport.spill_probe_matches(offer)
+        assert not transport.spill_probe_matches(None)
+        assert not transport.spill_probe_matches(
+            {**offer, "token": "some-other-session"}
+        )
+        # publish one sealed segment and pull it back, CRC-verified
+        from lakesoul_tpu.scanplane import spool as spool_mod
+
+        sdir = str(tmp_path / "spool-sess")
+        os.makedirs(sdir)
+        t = pa.table({"x": np.arange(512, dtype=np.int64)})
+        spool_mod.write_range(
+            sdir, 0, t.schema, iter(t.to_batches(max_chunksize=128)),
+            holder="w0",
+        )
+        doc = transport.spill_range(prefix, "sess-a", sdir, 0)
+        assert doc["nbytes"] > 0
+        # idempotent: the CRC sidecar short-circuits the re-publish
+        assert transport.spill_range(prefix, "sess-a", sdir, 0) == doc
+        nbytes, batches = transport.fetch_spilled(doc)
+        assert nbytes == doc["nbytes"]
+        assert pa.Table.from_batches(batches).equals(t)
+        # a torn object must fail loudly, never decode silently wrong
+        with open(doc["path"], "r+b") as f:
+            f.write(b"\x00\x00torn")
+        from lakesoul_tpu.errors import IOError_
+
+        with pytest.raises(IOError_, match="failed verification"):
+            transport.fetch_spilled(doc)
+        # pruning follows the session manifest lifecycle
+        assert transport.prune_spill(prefix, {"sess-a"}) == 0
+        assert transport.prune_spill(prefix, set()) == 1
+        assert not os.path.exists(doc["path"])
+        assert not os.path.exists(
+            transport.spill_probe_path(prefix, "sess-a")
+        )
+
+
+class TestTransportMatrix:
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        catalog, t = _make_table(tmp_path)
+        p = _Plane(catalog, tmp_path, workers=1)
+        yield catalog, t, p
+        p.close()
+
+    @pytest.mark.parametrize("rung", ["shm", "spill", "stream"])
+    def test_forced_rung_sha_identical_and_metered(self, plane, rung):
+        _, t, p = plane
+        want = list(t.scan().batch_size(4096).to_batches())
+        before = registry().snapshot()
+        client = ScanPlaneClient(p.location, transport=rung)
+        got = list(client.iter_batches({"table": "t", "batch_size": 4096}))
+        assert len(got) == len(want)
+        for a, b in zip(got, want):
+            assert a.equals(b)
+        after = registry().snapshot()
+        # the negotiated rung and its per-range delivery were metered
+        fam = "lakesoul_fleet_transport_negotiated_total"
+        assert _counter(after, fam, transport=rung) \
+            > _counter(before, fam, transport=rung)
+        fam = "lakesoul_fleet_transport_ranges_total"
+        moved = _counter(after, fam, transport=rung) \
+            - _counter(before, fam, transport=rung)
+        assert moved > 0
+        fam = "lakesoul_fleet_transport_bytes_total"
+        assert _counter(after, fam, transport=rung) \
+            > _counter(before, fam, transport=rung)
+        mode = {"shm": "shm", "spill": "spill", "stream": "socket"}[rung]
+        fam = "lakesoul_scanplane_client_ranges_total"
+        assert _counter(after, fam, mode=mode) \
+            - _counter(before, fam, mode=mode) == moved
+
+    def test_env_forced_spill_rank_stream(self, plane, monkeypatch):
+        # the env knob (not the kwarg) forces the rung, on a sharded scan
+        _, t, p = plane
+        monkeypatch.setenv(transport.ENV_TRANSPORT, "spill")
+        client = ScanPlaneClient(p.location)
+        want = list(t.scan().batch_size(4096).shard(1, 2).to_batches())
+        got = list(client.iter_batches(
+            {"table": "t", "batch_size": 4096}, rank=1, world=2
+        ))
+        assert len(got) == len(want)
+        assert all(a.equals(b) for a, b in zip(got, want))
+        # the spill store now mirrors this session's served ranges
+        sessions = os.listdir(p.spill_prefix)
+        assert any(s.startswith("probe-") for s in sessions)
+        assert any(not s.startswith("probe-") for s in sessions)
+
+    def test_auto_negotiation_prefers_shm_then_spill(self, plane):
+        _, t, p = plane
+        # same host: the spool probe passes → shm wins the ladder
+        before = registry().snapshot()
+        client = ScanPlaneClient(p.location)
+        list(client.iter_batches({"table": "t", "batch_size": 8192}))
+        after = registry().snapshot()
+        fam = "lakesoul_fleet_transport_negotiated_total"
+        assert _counter(after, fam, transport="shm") \
+            > _counter(before, fam, transport="shm")
+        # shm=False drops the mapping rung: spill is the next rung down
+        before = after
+        client = ScanPlaneClient(p.location, shm=False)
+        list(client.iter_batches({"table": "t", "batch_size": 8192}))
+        after = registry().snapshot()
+        assert _counter(after, fam, transport="spill") \
+            > _counter(before, fam, transport="spill")
+
+    def test_forced_rung_without_offer_raises(self, tmp_path):
+        catalog, _ = _make_table(tmp_path)
+        p = _Plane(catalog, tmp_path, workers=1, spill=False)
+        try:
+            p.delivery.offer_shm = False  # emulate a cross-host gateway
+            client = ScanPlaneClient(p.location, transport="shm")
+            with pytest.raises(ConfigError, match="shm transport required"):
+                list(client.iter_batches({"table": "t"}))
+            client = ScanPlaneClient(p.location, transport="spill")
+            with pytest.raises(ConfigError, match="spill transport required"):
+                list(client.iter_batches({"table": "t"}))
+        finally:
+            p.close()
+
+
+# ------------------------------------------------------ typed wait timeout
+
+
+class TestWaitTimeout:
+    def test_from_message_round_trip(self):
+        e = ScanPlaneWaitTimeout("sess-42", 7, 1.5)
+        assert "session=sess-42" in str(e) and "range=7" in str(e)
+        typed = ScanPlaneWaitTimeout.from_message(
+            f"gateway said: {e}"
+        )
+        assert isinstance(typed, ScanPlaneWaitTimeout)
+        assert "sess-42" in str(typed) and "range=7" in str(typed)
+        assert ScanPlaneWaitTimeout.from_message("range timed out") is None
+
+    def test_client_raises_typed_and_meters(self, tmp_path):
+        catalog, _ = _make_table(tmp_path, rows=4000)
+        # no workers: every range waits until the gateway's budget burns
+        p = _Plane(catalog, tmp_path, workers=0, start_workers=False,
+                   wait_s=0.3)
+        try:
+            before = registry().snapshot()
+            client = ScanPlaneClient(p.location, transport="stream")
+            with pytest.raises(ScanPlaneWaitTimeout) as ei:
+                list(client.iter_batches({"table": "t", "batch_size": 4096}))
+            # the typed error names the session and range — the operator's
+            # first question ("which scan, how far in") answered inline
+            assert "session=" in str(ei.value)
+            assert "range=0" in str(ei.value)
+            assert "workers running" in str(ei.value)
+            after = registry().snapshot()
+            fam = "lakesoul_scanplane_wait_exhausted_total"
+            # both sides meter: the gateway when its wait burns, the
+            # client when the typed marker crosses the wire
+            assert _counter(after, fam) - _counter(before, fam) >= 2
+        finally:
+            p.close()
+
+
+# ----------------------------------------------------------- autoscaler
+
+
+class _FakeSpawner:
+    """A spawner whose children are list entries, not processes."""
+
+    def __init__(self):
+        self._children = []
+        self._dead = []
+        self._seq = 0
+        self.stopped = 0
+
+    @property
+    def count(self):
+        return len(self._children)
+
+    def spawn(self):
+        self._seq += 1
+        child = {"worker_id": f"fake-{self._seq}", "pid": 40_000 + self._seq}
+        self._children.append(child)
+        return child
+
+    def retire(self):
+        if not self._children:
+            return None
+        return {"pid": self._children.pop()["pid"]}
+
+    def kill_one(self):
+        self._dead.append(self._children.pop(0))
+
+    def reap(self):
+        dead = [{"pid": c["pid"], "returncode": -9} for c in self._dead]
+        self._dead = []
+        return dead
+
+    def stop_all(self, timeout=10.0):
+        self.stopped += 1
+        self._children = []
+
+
+class TestAutoscalePolicy:
+    def test_backlog_maps_to_workers(self):
+        p = AutoscalePolicy(1, 8, ranges_per_worker=4)
+        assert p.target(AutoscaleSignals(backlog=1), current=0) == 1
+        assert p.target(AutoscaleSignals(backlog=9), current=1) == 3
+        assert p.target(AutoscaleSignals(backlog=100), current=1) == 8
+
+    def test_slo_breach_with_backlog_jumps_to_max(self):
+        p = AutoscalePolicy(1, 6)
+        sig = AutoscaleSignals(backlog=2, slo_breached=True)
+        assert p.target(sig, current=1) == 6
+
+    def test_never_shrinks_under_live_backlog(self):
+        p = AutoscalePolicy(1, 8, ranges_per_worker=4)
+        # 5 workers mid-drain, tail backlog of 2 ranges: hold, don't churn
+        assert p.target(AutoscaleSignals(backlog=2), current=5) == 5
+
+    def test_scale_down_waits_out_idle_polls(self):
+        p = AutoscalePolicy(1, 8, idle_polls_to_scale_down=3)
+        idle = AutoscaleSignals(backlog=0)
+        assert p.target(idle, current=4) == 4
+        assert p.target(idle, current=4) == 4
+        assert p.target(idle, current=4) == 1  # third consecutive idle poll
+        # any backlog resets the idle streak
+        p2 = AutoscalePolicy(1, 8, idle_polls_to_scale_down=2)
+        assert p2.target(idle, current=3) == 3
+        assert p2.target(AutoscaleSignals(backlog=4), current=3) == 3
+        assert p2.target(idle, current=3) == 3  # streak restarted at 1
+        assert p2.target(idle, current=3) == 1
+
+    def test_invalid_bounds_rejected(self):
+        with pytest.raises(ConfigError, match="invalid autoscale bounds"):
+            AutoscalePolicy(5, 2)
+        with pytest.raises(ConfigError, match="invalid autoscale bounds"):
+            AutoscalePolicy(-1, 2)
+
+
+class TestAutoscalerSignals:
+    def test_spool_backlog_counts_unproduced_ranges(self, tmp_path):
+        catalog, _ = _make_table(tmp_path, rows=6000)
+        spool_dir = str(tmp_path / "spool")
+        assert spool_backlog(spool_dir) == (0, 0)
+        session = ScanSession.plan(catalog, {"table": "t"})
+        session.publish(spool_dir)
+        backlog, sessions = spool_backlog(spool_dir)
+        assert backlog == len(session.ranges) and sessions == 1
+        # a worker drains it: backlog falls to zero
+        ScanPlaneWorker(catalog, spool_dir, lease_ttl_s=10).poll_once()
+        assert spool_backlog(spool_dir) == (0, 0)
+
+    def test_collect_signals_without_obs_spool(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(obs_fleet.ENV_SPOOL, raising=False)
+        sig = collect_signals(str(tmp_path / "nope"))
+        assert sig.backlog == 0 and not sig.slo_breached
+
+
+class TestWorkerAutoscaler:
+    def _controller(self, store, spool_dir, *, cid, min_w=1, max_w=4,
+                    ttl_s=10.0):
+        return WorkerAutoscaler(
+            store, _FakeSpawner(), spool_dir=spool_dir,
+            min_workers=min_w, max_workers=max_w, controller_id=cid,
+            lease_ttl_s=ttl_s, heartbeat=False,
+        )
+
+    def test_leader_scales_to_backlog_then_down(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(obs_fleet.ENV_SPOOL, raising=False)
+        catalog, _ = _make_table(tmp_path, rows=6000)
+        spool_dir = str(tmp_path / "spool")
+        session = ScanSession.plan(catalog, {"table": "t"})
+        session.publish(spool_dir)
+        backlog = len(session.ranges)
+        ctl = self._controller(
+            catalog.client.store, spool_dir, cid="A", min_w=1, max_w=4
+        )
+        ctl.policy.idle_polls_to_scale_down = 2
+        now = 1_000_000
+        events = ctl.step(now_ms=now)
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "leader" and not events[0]["takeover"]
+        assert ctl.state == "leader" and ctl.fencing_token == 1
+        want = min(4, max(1, math.ceil(backlog / 4)))
+        assert kinds.count("spawn") == want
+        assert events[-1]["backlog"] == backlog
+        # the backlog drains (a worker produced everything): after the
+        # idle-poll debounce the fleet returns to min
+        ScanPlaneWorker(catalog, spool_dir, lease_ttl_s=10).poll_once()
+        ctl.step(now_ms=now + 1000)
+        events = ctl.step(now_ms=now + 2000)
+        assert ctl.spawner.count == 1
+        if want > 1:
+            assert any(e["event"] == "retire" for e in events)
+        ctl.stop()
+
+    def test_sigkilled_worker_backfilled_next_tick(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(obs_fleet.ENV_SPOOL, raising=False)
+        catalog, _ = _make_table(tmp_path, rows=6000)
+        spool_dir = str(tmp_path / "spool")
+        ScanSession.plan(catalog, {"table": "t"}).publish(spool_dir)
+        ctl = self._controller(
+            catalog.client.store, spool_dir, cid="A", min_w=2, max_w=4
+        )
+        now = 1_000_000
+        ctl.step(now_ms=now)
+        had = ctl.spawner.count
+        assert had >= 2
+        ctl.spawner.kill_one()  # SIGKILL from outside
+        events = ctl.step(now_ms=now + 1000)
+        kinds = [e["event"] for e in events]
+        assert "worker_exit" in kinds and "spawn" in kinds
+        assert ctl.spawner.count == had
+        ctl.stop()
+
+    def test_fenced_takeover_bumps_token_and_demotes_zombie(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.delenv(obs_fleet.ENV_SPOOL, raising=False)
+        catalog, _ = _make_table(tmp_path, rows=4000)
+        spool_dir = str(tmp_path / "spool")
+        store = catalog.client.store
+        a = self._controller(store, spool_dir, cid="A", ttl_s=10.0)
+        b = self._controller(store, spool_dir, cid="B", ttl_s=10.0)
+        assert a.key == b.key == lease_key(spool_dir)
+        t0 = 1_000_000
+        assert a.step(now_ms=t0)[0]["event"] == "leader"
+        # B contends while A's lease is live: standby, nothing spawned
+        events = b.step(now_ms=t0 + 500)
+        assert events == [{"event": "standby", "controller": "B"}]
+        assert b.spawner.count == 0
+        # A goes silent (SIGKILL emulated: no renewals); one TTL later B
+        # takes the lease over with a BUMPED fencing token
+        events = b.step(now_ms=t0 + 10_001)
+        assert events[0]["event"] == "leader"
+        assert events[0]["takeover"] is True and events[0]["fence"] == 2
+        assert b.state == "leader" and b.spawner.count >= 1
+        # the zombie wakes: its renewal fails against the bumped token —
+        # it demotes itself and retires its own children
+        a_children = a.spawner.count
+        assert a_children >= 1
+        events = a.step(now_ms=t0 + 10_500)
+        assert events == [{"event": "fenced", "controller": "A"}]
+        assert a.state == "standby" and a.fencing_token is None
+        assert a.spawner.count == 0 and a.spawner.stopped >= 1
+        # B keeps leading undisturbed
+        assert b.step(now_ms=t0 + 11_000)[-1]["state"] == "leader"
+        b.stop()
+        a.stop()
+
+    def test_stop_releases_lease_for_immediate_successor(self, tmp_path,
+                                                         monkeypatch):
+        monkeypatch.delenv(obs_fleet.ENV_SPOOL, raising=False)
+        catalog, _ = _make_table(tmp_path, rows=4000)
+        spool_dir = str(tmp_path / "spool")
+        store = catalog.client.store
+        a = self._controller(store, spool_dir, cid="A")
+        t0 = 1_000_000
+        a.step(now_ms=t0)
+        a.stop()  # clean shutdown: release, don't wait out the TTL
+        b = self._controller(store, spool_dir, cid="B")
+        events = b.step(now_ms=t0 + 100)
+        assert events[0]["event"] == "leader"
+        b.stop()
+
+
+class TestWorkerSpawner:
+    def test_worker_argv_is_the_real_entry(self, tmp_path):
+        sp = WorkerSpawner(
+            str(tmp_path / "wh"), str(tmp_path / "spool"),
+            db_path=str(tmp_path / "meta.db"), lease_ttl_s=2.0, poll_s=0.05,
+        )
+        argv = sp.worker_argv("fleet-1-1")
+        assert argv[1:4] == ["-m", "lakesoul_tpu.scanplane", "worker"]
+        assert "--worker-id" in argv and "fleet-1-1" in argv
+        assert "--lease-ttl-s" in argv and "2.0" in argv
+        assert "--db-path" in argv
+
+
+# ------------------------------------------------------------- multihost
+
+
+class TestProcessAxis:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv(multihost.ENV_INDEX, "1")
+        monkeypatch.setenv(multihost.ENV_COUNT, "3")
+        assert multihost.process_axis() == (1, 3)
+
+    def test_env_vars_required_together(self, monkeypatch):
+        monkeypatch.setenv(multihost.ENV_INDEX, "1")
+        monkeypatch.delenv(multihost.ENV_COUNT, raising=False)
+        with pytest.raises(ConfigError, match="must be set together"):
+            multihost.process_axis()
+
+    @pytest.mark.parametrize("idx,cnt", [("x", "2"), ("0", "y")])
+    def test_non_integer_rejected(self, monkeypatch, idx, cnt):
+        monkeypatch.setenv(multihost.ENV_INDEX, idx)
+        monkeypatch.setenv(multihost.ENV_COUNT, cnt)
+        with pytest.raises(ConfigError, match="non-integer"):
+            multihost.process_axis()
+
+    @pytest.mark.parametrize("idx,cnt", [("3", "3"), ("-1", "2"), ("0", "0")])
+    def test_out_of_range_rejected(self, monkeypatch, idx, cnt):
+        monkeypatch.setenv(multihost.ENV_INDEX, idx)
+        monkeypatch.setenv(multihost.ENV_COUNT, cnt)
+        with pytest.raises(ConfigError, match="invalid process axis"):
+            multihost.process_axis()
+
+    def test_single_host_default(self, monkeypatch):
+        monkeypatch.delenv(multihost.ENV_INDEX, raising=False)
+        monkeypatch.delenv(multihost.ENV_COUNT, raising=False)
+        assert multihost.process_axis() == (0, 1)
+
+
+class TestShardScan:
+    def test_applies_axis_and_passes_through(self, tmp_path, monkeypatch):
+        _, t = _make_table(tmp_path, rows=4000)
+        monkeypatch.setenv(multihost.ENV_INDEX, "1")
+        monkeypatch.setenv(multihost.ENV_COUNT, "3")
+        sharded = multihost.shard_scan(t.scan())
+        assert (sharded._rank, sharded._world) == (1, 3)
+        # a scan already sharded CONSISTENTLY passes through untouched
+        pre = t.scan().shard(1, 3)
+        assert multihost.shard_scan(pre) is pre
+        # an inconsistent explicit shard is a loud configuration conflict
+        with pytest.raises(ConfigError, match="already sharded"):
+            multihost.shard_scan(t.scan().shard(0, 3))
+
+    def test_single_host_is_identity(self, tmp_path, monkeypatch):
+        _, t = _make_table(tmp_path, rows=4000)
+        monkeypatch.delenv(multihost.ENV_INDEX, raising=False)
+        monkeypatch.delenv(multihost.ENV_COUNT, raising=False)
+        scan = t.scan()
+        assert multihost.shard_scan(scan) is scan
+
+
+class TestMultihostIter:
+    def test_ranks_disjoint_and_union_complete(self, tmp_path, monkeypatch):
+        _, t = _make_table(tmp_path, rows=8000)
+        all_ids = set()
+        for b in t.scan().to_batches():
+            all_ids.update(b.column("id").to_pylist())
+        world = 3
+        per_rank = []
+        for rank in range(world):
+            monkeypatch.setenv(multihost.ENV_INDEX, str(rank))
+            monkeypatch.setenv(multihost.ENV_COUNT, str(world))
+            ids = []
+            it = t.scan().batch_size(2048).to_jax_iter(
+                multihost=True, drop_remainder=False
+            )
+            for batch in it:
+                ids.extend(np.asarray(batch["id"]).tolist())
+            # the emulated rank matches a plain single-process shard scan
+            want = []
+            for b in t.scan().batch_size(2048).shard(rank, world).to_batches():
+                want.extend(b.column("id").to_pylist())
+            assert ids == want
+            per_rank.append(set(ids))
+        union = set().union(*per_rank)
+        assert union == all_ids
+        for i in range(world):
+            for j in range(i + 1, world):
+                assert per_rank[i].isdisjoint(per_rank[j])
+
+    def test_conflicting_explicit_shard_raises(self, tmp_path, monkeypatch):
+        _, t = _make_table(tmp_path, rows=4000)
+        monkeypatch.setenv(multihost.ENV_INDEX, "0")
+        monkeypatch.setenv(multihost.ENV_COUNT, "2")
+        with pytest.raises(ConfigError, match="already sharded"):
+            t.scan().shard(1, 2).to_jax_iter(multihost=True)

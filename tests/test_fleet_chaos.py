@@ -1,0 +1,252 @@
+"""Process-level chaos for the fleet plane (slow tier; the deterministic
+unit machine of the same controller runs in test_fleet.py).
+
+Two emulated hosts front one warehouse + spool fabric: each host is a
+real gateway process (``python -m lakesoul_tpu.scanplane service``) plus
+one trainer rank (``python -m lakesoul_tpu.fleet train`` under
+``LAKESOUL_FLEET_PROCESS_INDEX/_COUNT``); the worker fleet is owned by a
+real autoscaler process (``python -m lakesoul_tpu.fleet autoscale``)
+emitting JSON-line events.  The acceptance contract, proven by SIGKILL:
+
+- kill one host's gateway AND one autoscaler-owned worker mid-run → the
+  surviving rank completes with **exactly-once** delivery (sha-identical
+  to the single-process shard scan), the autoscaler notices the dead
+  worker and backfills it within ~one controller poll + worker boot;
+- the orphaned rank relaunched against the surviving gateway completes
+  the SAME session exactly-once — the spool fabric, not the gateway,
+  owns delivered state.
+
+Everything killed here is the REAL deployed entry point — what is
+tested is what deploys."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu import LakeSoulCatalog
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+SCHEMA = pa.schema([("id", pa.int64()), ("v", pa.float64()), ("p", pa.string())])
+TTL_S = 2.0
+WORLD = 2
+BATCH = 4096
+
+pytestmark = pytest.mark.slow
+
+
+def _child_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "LAKESOUL_RETRY_SEED": "7",
+        "LAKESOUL_RETRY_CAP_S": "0.5",
+    })
+    env.update(extra)
+    return env
+
+
+def _spawn(argv, **extra_env) -> subprocess.Popen:
+    return subprocess.Popen(
+        argv, env=_child_env(**extra_env), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _gateway(wh, db, spool) -> "tuple[subprocess.Popen, str]":
+    proc = _spawn([
+        sys.executable, "-m", "lakesoul_tpu.scanplane", "service",
+        "--warehouse", wh, "--db-path", db, "--spool", spool,
+        "--workers", "0",  # serve only: the autoscaler owns the fleet
+    ])
+    handle = proc.stdout.readline()
+    if not handle:
+        _, err = proc.communicate(timeout=10.0)
+        pytest.fail(f"gateway died before printing its handle: {err[-2000:]}")
+    return proc, json.loads(handle)["location"]
+
+
+def _trainer(wh, db, location, rank) -> subprocess.Popen:
+    return _spawn(
+        [
+            sys.executable, "-m", "lakesoul_tpu.fleet", "train",
+            "--warehouse", wh, "--db-path", db, "--table", "t",
+            "--batch-size", str(BATCH), "--location", location,
+        ],
+        LAKESOUL_FLEET_PROCESS_INDEX=str(rank),
+        LAKESOUL_FLEET_PROCESS_COUNT=str(WORLD),
+    )
+
+
+def _expected_sha(catalog, rank) -> "tuple[str, int]":
+    """The trainer role's collated-host-array hash, computed in-process
+    over a plain ``scan.shard(rank, world)`` — the exactly-once oracle."""
+    from lakesoul_tpu.fleet.multihost import digest_batch
+
+    digest = hashlib.sha256()
+    rows = 0
+    it = catalog.scan("t").batch_size(BATCH).shard(rank, WORLD).to_jax_iter(
+        device_put=False, drop_remainder=False
+    )
+    for batch in it:
+        rows += digest_batch(digest, batch)
+    return digest.hexdigest(), rows
+
+
+class TestKillAHost:
+    def test_surviving_rank_exactly_once_and_backfill(self, tmp_path):
+        wh, db = str(tmp_path / "wh"), str(tmp_path / "meta.db")
+        catalog = LakeSoulCatalog(wh, db_path=db)
+        t = catalog.create_table(
+            "t", SCHEMA, primary_keys=["id"], range_partitions=["p"],
+            hash_bucket_num=2,
+        )
+        rng = np.random.default_rng(3)
+        for _ in range(3):
+            for part, base in (("a", 0.0), ("b", 1000.0)):
+                ids = np.sort(
+                    rng.choice(40_000, 12_000, replace=False)
+                ).astype(np.int64)
+                t.upsert(pa.table({
+                    "id": ids,
+                    "v": base + rng.normal(size=len(ids)),
+                    "p": np.repeat(part, len(ids)),
+                }, schema=SCHEMA))
+        spool = str(tmp_path / "spool")
+        os.makedirs(spool)
+
+        events: list[dict] = []
+        procs: list[subprocess.Popen] = []
+        worker_pids: set[int] = set()
+        try:
+            gw_a, loc_a = _gateway(wh, db, spool)
+            procs.append(gw_a)
+            gw_b, loc_b = _gateway(wh, db, spool)
+            procs.append(gw_b)
+
+            scaler = _spawn([
+                sys.executable, "-m", "lakesoul_tpu.fleet", "autoscale",
+                "--warehouse", wh, "--db-path", db, "--spool", spool,
+                "--min-workers", "2", "--max-workers", "4",
+                "--lease-ttl-s", str(TTL_S), "--poll-s", "0.1",
+                "--worker-lease-ttl-s", str(TTL_S), "--worker-poll-s", "0.05",
+            ])
+            procs.append(scaler)
+
+            def pump():
+                for line in scaler.stdout:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "spawn":
+                        worker_pids.add(ev["pid"])
+                    events.append(ev)
+
+            threading.Thread(target=pump, daemon=True).start()
+
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and len(worker_pids) < 2:
+                if scaler.poll() is not None:
+                    _, err = scaler.communicate(timeout=10.0)
+                    pytest.fail(f"autoscaler exited early: {err[-2000:]}")
+                time.sleep(0.05)
+            assert len(worker_pids) >= 2, "autoscaler never reached min fleet"
+
+            # one trainer rank per emulated host, each on its own gateway
+            rank0 = _trainer(wh, db, loc_a, 0)
+            procs.append(rank0)
+            rank1 = _trainer(wh, db, loc_b, 1)
+            procs.append(rank1)
+
+            # let the run get properly underway (session published, some
+            # ranges in flight), then KILL host B: its gateway and one
+            # autoscaler-owned worker, the most destructive pair
+            time.sleep(1.0)
+            victim_pid = sorted(worker_pids)[0]
+            gw_b.send_signal(signal.SIGKILL)
+            os.kill(victim_pid, signal.SIGKILL)
+            killed_at = time.monotonic()
+
+            # the autoscaler notices the SIGKILLed child and backfills:
+            # a worker_exit for the victim followed by a fresh spawn
+            deadline = time.monotonic() + TTL_S + 30.0
+            backfilled_at = None
+            while time.monotonic() < deadline and backfilled_at is None:
+                snap = list(events)
+                for i, ev in enumerate(snap):
+                    if ev.get("event") == "worker_exit" \
+                            and ev.get("pid") == victim_pid:
+                        if any(e.get("event") == "spawn" for e in snap[i + 1:]):
+                            backfilled_at = time.monotonic()
+                            break
+                time.sleep(0.05)
+            assert backfilled_at is not None, (
+                "autoscaler never backfilled the SIGKILLed worker:"
+                f" {events[-10:]}"
+            )
+            # reap-and-respawn is one control tick; the TTL bounds even a
+            # worst-case controller that was itself mid-failover
+            assert backfilled_at - killed_at < TTL_S + 10.0
+
+            # the surviving rank completes exactly-once
+            out0, err0 = rank0.communicate(timeout=180.0)
+            assert rank0.returncode == 0, err0[-2000:]
+            doc0 = json.loads(out0.strip().splitlines()[-1])
+            want_sha0, want_rows0 = _expected_sha(catalog, 0)
+            assert doc0["rows"] == want_rows0
+            assert doc0["sha256"] == want_sha0
+            assert doc0["process_index"] == 0
+            assert doc0["process_count"] == WORLD
+
+            # the orphaned rank: its gateway is gone.  Whether it died
+            # mid-stream or never connected, relaunching it against the
+            # SURVIVING gateway must complete the same session
+            # exactly-once — delivered state lives in the spool fabric
+            try:
+                out1, _ = rank1.communicate(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                rank1.kill()
+                rank1.communicate(timeout=10.0)
+                out1 = ""
+            doc1 = None
+            if rank1.returncode == 0 and out1.strip():
+                doc1 = json.loads(out1.strip().splitlines()[-1])
+            if doc1 is None:
+                relaunched = _trainer(wh, db, loc_a, 1)
+                procs.append(relaunched)
+                out1, err1 = relaunched.communicate(timeout=180.0)
+                assert relaunched.returncode == 0, err1[-2000:]
+                doc1 = json.loads(out1.strip().splitlines()[-1])
+            want_sha1, want_rows1 = _expected_sha(catalog, 1)
+            assert doc1["rows"] == want_rows1
+            assert doc1["sha256"] == want_sha1
+            assert want_rows0 + want_rows1 == t.scan().count_rows()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(10.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            # the autoscaler's SIGTERM death skips stop_all: sweep its
+            # orphaned worker children directly
+            for pid in worker_pids:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
