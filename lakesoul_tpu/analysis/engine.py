@@ -147,6 +147,9 @@ class Project:
     # rules.races class-access index), same build-once contract
     _thread_roots: "object | None" = field(default=None, repr=False)
     _race_index: "object | None" = field(default=None, repr=False)
+    # the durability pack's per-function filesystem-op index
+    # (rules.durability._op_index), same build-once contract
+    _durability_index: "object | None" = field(default=None, repr=False)
 
     def callgraph(self):
         """The project call graph, built ONCE and shared by every

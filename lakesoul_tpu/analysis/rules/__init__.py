@@ -26,6 +26,11 @@ from lakesoul_tpu.analysis.rules.conventions import (
     UndocumentedEnvRule,
 )
 from lakesoul_tpu.analysis.rules.determinism import StageNondeterminismRule
+from lakesoul_tpu.analysis.rules.durability import (
+    BarrierOrderRule,
+    TornPublishRule,
+    UnfsyncedRenameRule,
+)
 from lakesoul_tpu.analysis.rules.endpoint import HardcodedEndpointRule
 from lakesoul_tpu.analysis.rules.identity import FleetIdentityLabelRule
 from lakesoul_tpu.analysis.rules.lifetime import (
@@ -95,6 +100,10 @@ def all_rules() -> list[Rule]:
         TpuDtypeWidthRule(),
         JitStaticArgShapeRule(),
         PallasBlockSpecRule(),
+        # durability pack (atomic-publication discipline)
+        TornPublishRule(),
+        UnfsyncedRenameRule(),
+        BarrierOrderRule(),
     ]
 
 

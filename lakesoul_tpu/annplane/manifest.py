@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 
 from lakesoul_tpu.io.object_store import ensure_dir, filesystem_for
+from lakesoul_tpu.runtime import atomicio
 from lakesoul_tpu.vector.manifest import _crc_unwrap, _crc_wrap
 
 POINTER = "PLANE"
@@ -39,8 +40,9 @@ class PlaneManifestStore:
         self._write_blob(POINTER, _crc_wrap(rel.encode()))
 
     def _write_blob(self, rel: str, data: bytes) -> None:
-        with self.fs.open(f"{self.root_path}/{rel}", "wb") as f:
-            f.write(data)
+        # the PLANE pointer is overwritten per progress record; atomicio
+        # keeps a crashed overwrite old-or-new instead of torn
+        atomicio.publish_bytes_fs(self.fs, f"{self.root_path}/{rel}", data)
 
     # ------------------------------------------------------------------- read
     def read(self) -> dict | None:

@@ -44,6 +44,7 @@ import pyarrow as pa
 
 from lakesoul_tpu.errors import ConfigError, IOError_
 from lakesoul_tpu.obs import registry
+from lakesoul_tpu.runtime import atomicio
 
 logger = logging.getLogger(__name__)
 
@@ -130,8 +131,9 @@ def write_spill_probe(prefix: str, session_id: str) -> dict:
     fs, p = _fs_for(path, write=True)
     if not fs.exists(p):
         fs.makedirs(posixpath.dirname(p) or "/", exist_ok=True)
-        with fs.open(p, "wb") as f:
-            f.write(json.dumps({"session": session_id}).encode())
+        atomicio.publish_bytes_fs(
+            fs, p, json.dumps({"session": session_id}).encode()
+        )
     return {"prefix": prefix, "probe": path, "token": session_id}
 
 
@@ -157,41 +159,16 @@ def spill_range(prefix: str, session_id: str, spool_session_dir: str, index: int
         payload = f.read()
     fs_seg, seg_p = _fs_for(seg, write=True)
     fs_seg.makedirs(posixpath.dirname(seg_p), exist_ok=True)
-    tmp = f"{seg_p}.tmp-{os.getpid()}"
-    with fs_seg.open(tmp, "wb") as f:
-        f.write(payload)
-        _fsync_best_effort(f)
-    _rename(fs_seg, tmp, seg_p)
+    atomicio.publish_bytes_fs(fs_seg, seg_p, payload)
     doc = {
         "path": seg,
         "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
         "nbytes": len(payload),
     }
-    tmp_crc = f"{crc_p}.tmp-{os.getpid()}"
-    with fs.open(tmp_crc, "wb") as f:
-        f.write(json.dumps(doc, sort_keys=True).encode())
-        _fsync_best_effort(f)
-    _rename(fs, tmp_crc, crc_p)
+    # the CRC doc is the barrier: published only after the segment bytes
+    # are durable above
+    atomicio.publish_bytes_fs(fs, crc_p, json.dumps(doc, sort_keys=True).encode())
     return doc
-
-
-def _fsync_best_effort(f) -> None:
-    # fsspec local files expose a real fileno; object-store writers flush
-    # on close (their PUT is the durability barrier)
-    try:
-        f.flush()
-        os.fsync(f.fileno())
-    except (AttributeError, OSError, NotImplementedError):
-        pass
-
-
-def _rename(fs, src: str, dst: str) -> None:
-    try:
-        fs.mv(src, dst)
-    except FileNotFoundError:
-        # a racing publisher renamed first; both wrote identical bytes
-        if not fs.exists(dst):
-            raise
 
 
 def prune_spill(prefix: str, live_sessions: "set[str]") -> int:

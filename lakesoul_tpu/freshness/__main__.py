@@ -131,12 +131,12 @@ def main(argv=None) -> int:
     oracle = run_writer(args)
     line = json.dumps(oracle, sort_keys=True)
     if args.oracle_out:
-        import os
+        # tmp→fsync→rename through the sanctioned seam — the bare
+        # tmp+replace this used to do could land an empty oracle doc
+        # after a host crash (rename without fsync)
+        from lakesoul_tpu.runtime import atomicio
 
-        tmp = args.oracle_out + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(line)
-        os.replace(tmp, args.oracle_out)
+        atomicio.publish_atomic(args.oracle_out, line)
     print(line, flush=True)
     return 0
 

@@ -278,19 +278,17 @@ def record_event(name: str, *, flush: bool = False, **attrs) -> None:
 
 
 def _write_atomic(path: str, doc: dict) -> None:
-    # same publication protocol as scanplane/spool.py: a reader sees the
+    # the sanctioned publication seam (runtime/atomicio): a reader sees the
     # whole file or the previous one, never a torn write; fsync before
-    # rename so a host crash can't replace good data with an empty inode
-    tmp = f"{path}.tmp-{os.getpid()}"
+    # rename so a host crash can't replace good data with an empty inode.
+    # lazy import — obs must stay importable before the runtime package
+    # (runtime.pipeline imports the obs registry back).
     # serialize first, write once: json.dump's many small stream writes
     # cost ~4x a single f.write on span-heavy recorder docs, and flush
     # cost is budgeted against scan wall time (obs_fleet bench leg)
-    body = json.dumps(doc)
-    with open(tmp, "w", encoding="utf-8") as f:
-        f.write(body)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    from lakesoul_tpu.runtime import atomicio
+
+    atomicio.publish_atomic(path, json.dumps(doc))
 
 
 def _read_json(path: str) -> dict | None:

@@ -17,6 +17,12 @@ Three pieces (see ARCHITECTURE.md "Runtime"):
   backoff + deadlines), :class:`CircuitBreaker`, and
   :class:`AdmissionController` (bounded in-flight + queue, typed
   ``OverloadedError`` shedding).
+- :mod:`lakesoul_tpu.runtime.atomicio` — the ONE sanctioned
+  atomic-publication seam (tmp → fsync → rename; opt-in parent-dir fsync
+  via ``LAKESOUL_FSYNC_DIR``) every cross-process artifact rides: spool
+  segments, session manifests, obs fleet docs, store pointers, the
+  CRC-sidecar spill rung.  The ``torn-publish`` lint rule keeps raw
+  publication writes out of every other module.
 
 Scan units decode through it in parallel with MOR merge overlapped
 (io/reader.py, catalog.py), the JAX loader prefetches through it

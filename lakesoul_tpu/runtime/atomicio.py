@@ -1,0 +1,191 @@
+"""One atomic-publication seam for every cross-process artifact.
+
+Every plane that publishes state another process reads — spool segments
+and session manifests (scanplane), ANN plane records (annplane), obs
+fleet docs (obs), the CRC-sidecar spill rung (fleet), freshness oracle
+docs — used to hand-roll its own tmp→fsync→rename sequence.  This module
+is the single sanctioned implementation; the ``torn-publish`` lint rule
+(analysis/rules/durability.py) flags any publication-path write that
+does not route through it.
+
+Protocol (local filesystems)::
+
+    stage   write ``<path>.tmp-<holder>``, flush, fsync
+    commit  ``os.replace`` tmp → final (atomic on POSIX)
+            + optional parent-directory fsync (``LAKESOUL_FSYNC_DIR=1``)
+
+The parent-dir fsync closes the last durability gap: ``os.replace`` is
+atomic against readers, but the *directory entry* itself only survives a
+host crash once the directory inode is fsynced.  It is opt-in because it
+costs one ``fsync`` per publication on the spool hot path; crash-prefix
+replay (analysis/fscheck.py) models renames as ordered either way.
+
+Object stores (``publish_bytes_fs`` on a non-local fsspec filesystem)
+get a single direct PUT — atomic by the store's own contract — through
+the resilient fs wrapper, so transient store failures retry underneath.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+import zlib
+
+ENV_FSYNC_DIR = "LAKESOUL_FSYNC_DIR"
+
+CRC_SUFFIX = ".crc"
+
+
+def fsync_dir_requested() -> bool:
+    """Whether ``LAKESOUL_FSYNC_DIR`` opts publications into fsyncing the
+    parent directory after each commit rename."""
+    return os.environ.get(ENV_FSYNC_DIR, "") not in ("", "0")
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` (or ``path`` itself when it
+    is a directory) — makes a just-renamed directory entry survive a host
+    crash.  Best-effort: filesystems that refuse directory fsync (some
+    network mounts) must not fail the publication."""
+    d = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class StagedFile:
+    """A written-and-fsynced tmp file awaiting its commit rename.
+
+    Two-phase publication exists for protocols whose barrier is a LATER
+    rename: the spool stages its segment, publishes the sidecar, then
+    commits the segment (the segment's rename is the publication
+    barrier)."""
+
+    def __init__(self, path: str, tmp: str):
+        self.path = path
+        self.tmp = tmp
+        self.nbytes = os.path.getsize(tmp)
+
+    def commit(self) -> None:
+        os.replace(self.tmp, self.path)
+        if fsync_dir_requested():
+            fsync_dir(self.path)
+
+    def abort(self) -> None:
+        try:
+            os.unlink(self.tmp)
+        except OSError:
+            pass
+
+
+def _tmp_name(path: str, holder: "str | None") -> str:
+    # keep the spool's ``<name>.tmp-<holder>`` debris convention: the
+    # holder's lease serializes sweepers, so a deterministic name per
+    # holder is both unique enough and sweepable; anonymous publishers
+    # get pid+uuid so concurrent threads never rename each other's tmp
+    suffix = holder if holder is not None else f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    return f"{path}.tmp-{suffix}"
+
+
+def stage_stream(path: str, write_fn, *, holder: "str | None" = None, mode: str = "wb") -> StagedFile:
+    """Stage a streaming producer: ``write_fn(f)`` writes to the open tmp
+    sink (e.g. an Arrow IPC writer), then the tmp is flushed + fsynced.
+    Returns the :class:`StagedFile`; nothing is visible until commit."""
+    tmp = _tmp_name(path, holder)
+    with open(tmp, mode) as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    return StagedFile(path, tmp)
+
+
+def publish_atomic(
+    path: str,
+    data: "bytes | str",
+    *,
+    holder: "str | None" = None,
+    crc_sidecar: bool = False,
+) -> "dict | None":
+    """Publish ``data`` at ``path`` atomically: tmp → fsync → rename.
+
+    With ``crc_sidecar=True`` a ``<path>.crc`` JSON doc
+    (``{path, crc32, nbytes}``) is published AFTER the data commit — the
+    sidecar is a barrier and must never name bytes that are not yet
+    durable.  Returns the sidecar doc when one was written."""
+    mode = "wb" if isinstance(data, bytes) else "w"
+    stage_stream(path, lambda f: f.write(data), holder=holder, mode=mode).commit()
+    if not crc_sidecar:
+        return None
+    payload = data if isinstance(data, bytes) else data.encode()
+    doc = {
+        "path": path,
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "nbytes": len(payload),
+    }
+    crc_path = path + CRC_SUFFIX
+    publish_atomic(crc_path, json.dumps(doc, sort_keys=True), holder=holder)
+    return doc
+
+
+# ----------------------------------------------------------- fsspec variant
+
+
+def _is_local(fs) -> bool:
+    # unwrap retry/cache layers (ResilientFileSystem, CachedReadFileSystem
+    # both keep the wrapped fs on an attribute) to classify the real store
+    seen = 0
+    while seen < 4:
+        inner = getattr(fs, "target", None) or getattr(fs, "inner", None)
+        if inner is None:
+            break
+        fs, seen = inner, seen + 1
+    proto = getattr(fs, "protocol", ())
+    if isinstance(proto, str):
+        proto = (proto,)
+    return bool({"file", "local"} & set(proto))
+
+
+def _fsync_best_effort(f) -> None:
+    # fsspec local files expose a real fileno; object-store writers flush
+    # on close (their PUT is the durability barrier)
+    try:
+        f.flush()
+        os.fsync(f.fileno())
+    except (AttributeError, OSError, NotImplementedError):
+        pass
+
+
+def _rename(fs, src: str, dst: str) -> None:
+    try:
+        fs.mv(src, dst)
+    except FileNotFoundError:
+        # a racing publisher renamed first; both wrote identical bytes
+        if not fs.exists(dst):
+            raise
+
+
+def publish_bytes_fs(fs, path: str, data: bytes, *, holder: "str | None" = None) -> None:
+    """Publish ``data`` through an fsspec filesystem (possibly wrapped by
+    the resilient retry layer).  Local filesystems get the full
+    tmp→fsync→rename discipline; object stores get one direct PUT, which
+    their own contract makes atomic — a tmp+server-side-rename there
+    would just double the request count without adding atomicity."""
+    if _is_local(fs):
+        tmp = _tmp_name(path, holder)
+        with fs.open(tmp, "wb") as f:
+            f.write(data)
+            _fsync_best_effort(f)
+        _rename(fs, tmp, path)
+        if fsync_dir_requested():
+            fsync_dir(path)
+        return
+    with fs.open(path, "wb") as f:
+        f.write(data)

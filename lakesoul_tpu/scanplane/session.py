@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 
 from lakesoul_tpu.errors import ConfigError
 from lakesoul_tpu.meta.client import ScanPlanPartition
+from lakesoul_tpu.runtime import atomicio
 
 MANIFEST_NAME = "manifest.json"
 
@@ -227,20 +228,14 @@ class ScanSession:
         (concurrent client exchanges resolving the same session) write
         identical bytes, so last-rename wins harmlessly.  Returns the
         session directory."""
-        import uuid
-
         sdir = self.dir(spool_dir)
         os.makedirs(sdir, exist_ok=True)
         path = os.path.join(sdir, MANIFEST_NAME)
         if not os.path.exists(path):
-            # unique tmp per publisher: concurrent threads of one process
-            # must not rename each other's tmp out from underneath
-            tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
-            with open(tmp, "w") as f:
-                f.write(self.to_json())
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            # atomicio's anonymous tmp name is pid+uuid unique: concurrent
+            # threads of one process must not rename each other's tmp out
+            # from underneath
+            atomicio.publish_atomic(path, self.to_json())
         return sdir
 
     @classmethod

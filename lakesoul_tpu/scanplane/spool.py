@@ -8,13 +8,14 @@ socket).  A JSON sidecar rides next to each segment with row/byte counts,
 the producing worker + fencing token, and the per-stage
 ``lakesoul_scan_stage_seconds`` deltas observed while producing it.
 
-Publication protocol (crash-safe without coordination):
+Publication protocol (crash-safe without coordination, routed through the
+sanctioned ``runtime/atomicio`` seam):
 
-1. write ``range-<k>.json.tmp-<holder>`` and ``range-<k>.arrow.tmp-<holder>``
-2. fsync both
-3. ``os.replace`` the sidecar, then the segment — the segment's rename is
-   the publication barrier: readers poll for the ``.arrow`` name and only
-   then read the sidecar, which is guaranteed present.
+1. stage ``range-<k>.arrow.tmp-<holder>`` (write + fsync, not yet visible)
+2. publish the sidecar atomically (tmp → fsync → replace)
+3. commit the staged segment — the segment's rename is the publication
+   barrier: readers poll for the ``.arrow`` name and only then read the
+   sidecar, which is guaranteed present.
 
 A worker SIGKILLed mid-write leaves only ``*.tmp-<holder>`` debris (swept
 by the next producer of that range); two producers racing the same range
@@ -28,6 +29,8 @@ import json
 import os
 
 import pyarrow as pa
+
+from lakesoul_tpu.runtime import atomicio
 
 SEGMENT_SUFFIX = ".arrow"
 SIDECAR_SUFFIX = ".json"
@@ -79,20 +82,20 @@ def write_range(
     and its dict is folded into the sidecar.  Returns the sidecar dict."""
     seg = segment_path(session_dir, index)
     side = sidecar_path(session_dir, index)
-    tmp_seg = f"{seg}.tmp-{holder}"
-    tmp_side = f"{side}.tmp-{holder}"
     rows = 0
     batch_rows: list[int] = []
-    # a plain python file, not pa.OSFile: the IPC writer's close must leave
-    # the sink open for the durability fsync below
-    with open(tmp_seg, "wb") as f:
+
+    def _produce(f):
+        # a plain python file, not pa.OSFile: the IPC writer's close must
+        # leave the sink open for atomicio's durability fsync
+        nonlocal rows
         with pa.ipc.new_file(f, schema) as w:
             for batch in batches:
                 w.write_batch(batch)
                 rows += batch.num_rows
                 batch_rows.append(batch.num_rows)
-        f.flush()
-        os.fsync(f.fileno())
+
+    staged = atomicio.stage_stream(seg, _produce, holder=holder)
     sidecar = {
         "range": index,
         "rows": rows,
@@ -100,18 +103,15 @@ def write_range(
         # per-batch row counts: resume metering and skip arithmetic stay
         # JSON math instead of re-reading the segment
         "batch_rows": batch_rows,
-        "nbytes": os.path.getsize(tmp_seg),
+        "nbytes": staged.nbytes,
         "holder": holder,
         **(meta or {}),
         **(meta_fn() if meta_fn is not None else {}),
     }
-    with open(tmp_side, "w") as f:
-        f.write(json.dumps(sidecar, sort_keys=True))
-        f.flush()
-        os.fsync(f.fileno())
     # sidecar first: once the segment name appears, its sidecar is readable
-    os.replace(tmp_side, side)
-    os.replace(tmp_seg, seg)
+    # — the segment's commit rename is the publication barrier
+    atomicio.publish_atomic(side, json.dumps(sidecar, sort_keys=True), holder=holder)
+    staged.commit()
     return sidecar
 
 

@@ -16,6 +16,7 @@ import numpy as np
 
 from lakesoul_tpu.errors import VectorIndexError
 from lakesoul_tpu.io.object_store import ensure_dir, filesystem_for
+from lakesoul_tpu.runtime import atomicio
 from lakesoul_tpu.vector.config import VectorIndexConfig
 from lakesoul_tpu.vector.index import IvfRabitqIndex, _Cluster
 
@@ -96,8 +97,10 @@ class ManifestStore:
         self._write_blob(name, _crc_wrap(buf.getvalue()))
 
     def _write_blob(self, rel: str, data: bytes) -> None:
-        with self.fs.open(f"{self.root_path}/{rel}", "wb") as f:
-            f.write(data)
+        # publication through the sanctioned seam: the LATEST pointer is
+        # overwritten on every write_index, and a torn in-place overwrite
+        # would make the WHOLE store unreadable (CRC error, not old-or-new)
+        atomicio.publish_bytes_fs(self.fs, f"{self.root_path}/{rel}", data)
 
     def _read_blob(self, rel: str) -> bytes:
         with self.fs.open(f"{self.root_path}/{rel}", "rb") as f:

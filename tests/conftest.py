@@ -148,3 +148,45 @@ def _lockcheck(request):
     assert not violations, "lockgraph violations:\n" + "\n\n".join(
         v.render() for v in violations
     )
+
+
+# ----------------------------------------------------------------- fscheck
+# LAKESOUL_FSCHECK=1 arms lakelint's crash-prefix replay detector
+# (lakesoul_tpu/analysis/fscheck.py) for the suites that publish
+# cross-process artifacts: the spool/session protocol (test_scanplane),
+# the spill rung + fleet docs (test_fleet), and the lease/topology docs
+# (test_topology).  Every traced publication is replayed at teardown — the
+# filesystem state after a crash at EVERY op prefix is materialized in a
+# scratch dir and the real readers must see old-complete or new-complete,
+# never torn; any violation fails the test with both stacks.
+
+_FSCHECK_MODULES = ("test_scanplane", "test_fleet", "test_topology")
+
+
+@pytest.fixture(autouse=True)
+def _fscheck(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rpartition(".")[2] not in _FSCHECK_MODULES:
+        yield
+        return
+    from lakesoul_tpu.analysis import fscheck
+
+    if not fscheck.env_requested() or fscheck.enabled():
+        # not armed, or something else already manages the detector
+        yield
+        return
+    fscheck.reset()
+    fscheck.enable()
+    try:
+        yield
+    finally:
+        try:
+            fscheck.replay()
+        finally:
+            violations = fscheck.violations()
+            fscheck.disable()
+            fscheck.reset()
+    assert not violations, "fscheck violations:\n" + "\n\n".join(
+        v.render() for v in violations
+    )

@@ -1,0 +1,91 @@
+"""Seeded durability violations: hand-rolled publications bypassing the
+runtime/atomicio seam (torn-publish), renames of never-fsynced bytes
+(unfsynced-rename), and barriers written before their data
+(barrier-order) — plus the legal shapes (atomicio-routed publication,
+read-mode opens, fsynced flows with the barrier last) that must stay
+silent."""
+
+import json
+import os
+
+from lakesoul_tpu.runtime import atomicio
+
+LATEST = "LATEST"
+
+
+def publish_in_place(path, doc):
+    # in-place overwrite: a crashed (or concurrent) reader sees a torn doc
+    with open(path, "w") as f:  # SEED: torn-publish
+        f.write(json.dumps(doc))
+
+
+def publish_hand_rolled(path, doc):
+    # hand-rolled tmp→fsync→rename: correct ordering, wrong seam — only
+    # atomicio may hold the raw ops (fsync keeps unfsynced-rename silent)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # SEED: torn-publish
+        f.write(json.dumps(doc))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def publish_without_fsync(path, doc):
+    # rename of bytes the flow never fsynced: a host crash can land the
+    # final name on an empty inode
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # SEED: torn-publish
+        f.write(json.dumps(doc))
+    os.replace(tmp, path)  # SEED: unfsynced-rename
+
+
+def _stage_doc(tmp, doc):
+    # the producing half of a publication split across functions — the
+    # write itself is a bare publication-path open
+    with open(tmp, "w") as f:  # SEED: torn-publish
+        f.write(json.dumps(doc))
+
+
+def publish_via_helper(path, doc):
+    # interprocedural: the caller renames what its callee wrote (and never
+    # fsynced) — both rules follow the 1-hop flow
+    tmp = path + ".tmp"
+    _stage_doc(tmp, doc)
+    os.replace(tmp, path)  # SEED: torn-publish SEED: unfsynced-rename
+
+
+def publish_crc_first(fs, seg_path, payload, crc_doc):
+    # the CRC sidecar is the barrier: writing it before the segment means
+    # a crash leaves a barrier naming bytes that never landed
+    crc_path = seg_path + ".crc"
+    atomicio.publish_bytes_fs(fs, crc_path, crc_doc)  # SEED: barrier-order
+    atomicio.publish_bytes_fs(fs, seg_path, payload)
+
+
+def swing_pointer_before_record(store, rel, record):
+    # LATEST must name an already-durable manifest, not a future one
+    store._write_blob(LATEST, rel.encode())  # SEED: barrier-order
+    store._write_blob(rel, record)
+
+
+def publish_sanctioned(path, doc):
+    # allowed: the sanctioned seam owns the raw ops
+    atomicio.publish_atomic(path, json.dumps(doc))
+
+
+def publish_data_then_barrier(fs, seg_path, payload, crc_doc):
+    # allowed: data first, barrier last — exactly the spill-rung ordering
+    crc_path = seg_path + ".crc"
+    atomicio.publish_bytes_fs(fs, seg_path, payload)
+    atomicio.publish_bytes_fs(fs, crc_path, crc_doc)
+
+
+def read_back(path):
+    # allowed: read-mode opens are not publications
+    with open(path) as f:
+        return json.loads(f.read())
+
+
+def move_untouched(src, dst):
+    # allowed: a pure move of bytes this flow never wrote (sweeper shape)
+    os.replace(src, dst)

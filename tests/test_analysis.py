@@ -117,6 +117,56 @@ def test_wall_clock_lease_rule_line_exact():
     assert lint_fixture("bad_wallclock.py") == []
 
 
+def test_durability_rules_line_exact():
+    """The durability pack: bare write-mode opens on publication paths
+    (torn-publish, including the interprocedural rename-of-callee-written
+    flow), renames whose flow never fsyncs (unfsynced-rename), and
+    barriers — CRC sidecars, LATEST pointers — published before their
+    data (barrier-order) are flagged line-exactly; the atomicio-routed,
+    fsynced, data-then-barrier shapes stay silent."""
+    from lakesoul_tpu.analysis.rules.durability import (
+        BarrierOrderRule,
+        TornPublishRule,
+        UnfsyncedRenameRule,
+    )
+
+    scope = ("bad_durability.py",)
+    rules = [
+        TornPublishRule(scope=scope),
+        UnfsyncedRenameRule(scope=scope),
+        BarrierOrderRule(scope=scope),
+    ]
+    found = lint_fixture("bad_durability.py", rules=rules)
+    assert len(found) == 9, found
+    assert_seed_lines(found, "bad_durability.py", "torn-publish")
+    assert_seed_lines(found, "bad_durability.py", "unfsynced-rename")
+    assert_seed_lines(found, "bad_durability.py", "barrier-order")
+    messages = " ".join(f.message for f in found)
+    assert "runtime/atomicio" in messages
+    assert "empty inode" in messages
+    assert "barrier" in messages
+    # the fixture is outside the default publication-module scope: the
+    # full default catalog stays silent on it
+    assert lint_fixture("bad_durability.py") == []
+
+
+def test_durability_sanctioned_seam_exempt_from_torn_publish():
+    """runtime/atomicio.py is the ONE module allowed to hold raw
+    write-mode opens — torn-publish skips it while unfsynced-rename and
+    barrier-order still apply (the seam itself fsyncs before renaming)."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules.durability import (
+        BarrierOrderRule,
+        TornPublishRule,
+        UnfsyncedRenameRule,
+    )
+
+    rules = [TornPublishRule(), UnfsyncedRenameRule(), BarrierOrderRule()]
+    findings, _ = run(rules=rules, baseline=Baseline([]))
+    atomicio = [f for f in findings if "atomicio" in f.path]
+    assert atomicio == [], "\n".join(f.render() for f in atomicio)
+
+
 def test_raw_process_rule_line_exact():
     """The 24th rule: ad-hoc subprocess spawning (dotted and from-imported),
     multiprocessing (import and calls), os.fork, and raw socket-server
@@ -638,7 +688,9 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 28 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 31 and "rbac-gate-reachability" in rule_ids
+    assert "torn-publish" in rule_ids and "unfsynced-rename" in rule_ids
+    assert "barrier-order" in rule_ids
     assert "raw-process" in rule_ids
     assert "unstoppable-loop" in rule_ids
     assert "replay-host-roundtrip" in rule_ids

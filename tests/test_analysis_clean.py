@@ -1,10 +1,11 @@
-"""CI gate: the repo must lint clean — under ALL 28 rules: the 15
+"""CI gate: the repo must lint clean — under ALL 31 rules: the 15
 per-function ones (incl. ad-hoc-retry, wall-clock-lease,
 hot-path-materialize, raw-process, unstoppable-loop,
 replay-host-roundtrip, fleet-identity-label and hardcoded-endpoint), the
 4 interprocedural ones (call graph + dataflow), the 5 device-pack ones
-(jit/pallas trace safety), and the 4 concurrency-pack ones (thread-root
-locksets + buffer lifetimes).
+(jit/pallas trace safety), the 4 concurrency-pack ones (thread-root
+locksets + buffer lifetimes), and the 3 durability-pack ones (atomic
+publication discipline over the runtime/atomicio seam).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -37,6 +38,9 @@ EXPECTED_RULES = {
     # concurrency pack (thread-root locksets + buffer lifetimes)
     "shared-state-race", "racy-check-then-act",
     "view-escapes-release", "ring-aliasing",
+    # durability pack (every publication rides runtime/atomicio; barriers
+    # land after the data they cover)
+    "torn-publish", "unfsynced-rename", "barrier-order",
 }
 
 DEVICE_RULES = {
@@ -49,14 +53,16 @@ CONCURRENCY_RULES = {
     "view-escapes-release", "ring-aliasing",
 }
 
+DURABILITY_RULES = {"torn-publish", "unfsynced-rename", "barrier-order"}
 
-def test_all_twenty_eight_rules_registered():
+
+def test_all_thirty_one_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 28
+    assert len(ids) == len(set(ids)) == 31
     assert set(ids) == EXPECTED_RULES
 
 
@@ -139,4 +145,19 @@ def test_concurrency_pack_clean_repo_wide_without_baseline():
     conc = [r for r in all_rules() if r.id in CONCURRENCY_RULES]
     assert len(conc) == 4
     findings, _ = run(rules=conc, baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_durability_pack_clean_repo_wide_without_baseline():
+    """The three durability rules hold with NO baseline entries at all —
+    the real findings this PR surfaced were FIXED by consolidating every
+    publication (obs fleet docs, spool segments + session manifests, the
+    spill rung, LATEST/PLANE store pointers, the freshness oracle doc)
+    onto the runtime/atomicio seam, not suppressed."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    dur = [r for r in all_rules() if r.id in DURABILITY_RULES]
+    assert len(dur) == 3
+    findings, _ = run(rules=dur, baseline=Baseline([]))
     assert findings == [], "\n".join(f.render() for f in findings)

@@ -1,0 +1,248 @@
+"""Crash-prefix replay (analysis/fscheck): the real publication protocols
+— spool range write, session manifest, obs fleet docs, the spill rung,
+the plane manifest store + ``AnnPlane.open`` — must replay torn-state
+free at EVERY op prefix, while seeded bad publications (in-place writes,
+unfsynced renames, CRC barriers before their data) are caught with the
+publishing stack and the offending prefix.  Also pins the opt-in
+``LAKESOUL_FSYNC_DIR`` parent-dir fsync and the detector's control
+surface (env gate, enable/disable restore, watch scoping)."""
+
+import builtins
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lakesoul_tpu.analysis import fscheck
+from lakesoul_tpu.runtime import atomicio
+
+SCHEMA = pa.schema([("x", pa.int64())])
+
+
+def one_batch(values=(1, 2, 3)):
+    return pa.record_batch([pa.array(list(values))], schema=SCHEMA)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_detector():
+    """Every test starts and ends with the real filesystem surface."""
+    assert not fscheck.enabled()
+    yield
+    fscheck.disable()
+    fscheck.reset()
+
+
+# ------------------------------------------------------------ control plane
+
+
+def test_env_gate(monkeypatch):
+    monkeypatch.delenv("LAKESOUL_FSCHECK", raising=False)
+    assert not fscheck.env_requested()
+    monkeypatch.setenv("LAKESOUL_FSCHECK", "1")
+    assert fscheck.env_requested()
+    monkeypatch.setenv("LAKESOUL_FSCHECK", "0")
+    assert not fscheck.env_requested()
+
+
+def test_enable_disable_restores_surface():
+    real_open, real_replace, real_fsync = builtins.open, os.replace, os.fsync
+    fscheck.enable()
+    fscheck.enable()  # idempotent
+    assert builtins.open is not real_open
+    assert os.replace is not real_replace
+    fscheck.disable()
+    fscheck.disable()
+    assert builtins.open is real_open
+    assert os.replace is real_replace
+    assert os.fsync is real_fsync
+
+
+def test_unrelated_paths_stay_untraced(tmp_path):
+    with fscheck.watch():
+        with open(tmp_path / "notes.txt", "w") as f:
+            f.write("scratch")
+        os.replace(tmp_path / "notes.txt", tmp_path / "notes2.txt")
+    assert fscheck.ops() == []
+    assert fscheck.replay() == []
+
+
+# ------------------------------------------------- real protocols stay clean
+
+
+def test_spool_session_obs_replay_clean(tmp_path):
+    from lakesoul_tpu.scanplane import spool
+
+    sess = tmp_path / "sess"
+    sess.mkdir()
+    with fscheck.watch() as w:
+        spool.write_range(str(sess), 0, SCHEMA, [one_batch()], holder="w1")
+        atomicio.publish_atomic(
+            str(sess / "manifest.json"),
+            json.dumps(
+                {
+                    "session": "s",
+                    "request": {},
+                    "version_digest": "v",
+                    "ranges": [],
+                    "created_ms": 1,
+                }
+            ),
+        )
+        atomicio.publish_atomic(
+            str(tmp_path / "member-abc.json"),
+            json.dumps({"service": "x", "heartbeat_ms": 1}),
+        )
+        fscheck.replay()
+    # the protocol stages, fsyncs, then renames — every prefix is
+    # old-complete or new-complete under every torn variant
+    assert w.violations == [], "\n\n".join(v.render() for v in w.violations)
+    kinds = [op.kind for op in fscheck.ops()]
+    assert "fsync" in kinds and "replace" in kinds
+
+
+def test_spill_rung_replay_clean(tmp_path):
+    from lakesoul_tpu.fleet import transport
+    from lakesoul_tpu.scanplane import spool
+
+    sess = tmp_path / "sess"
+    sess.mkdir()
+    spool.write_range(str(sess), 0, SCHEMA, [one_batch()], holder="w1")
+    with fscheck.watch() as w:
+        spill = transport.spill_range(
+            str(tmp_path / "spill"), "sessA", str(sess), 0
+        )
+        transport.write_spill_probe(str(tmp_path / "spill"), "sessA")
+        fscheck.replay()
+    assert w.violations == [], "\n\n".join(v.render() for v in w.violations)
+    # the round-trip still verifies after replay (nothing was mutated)
+    nbytes, batches = transport.fetch_spilled(spill)
+    assert nbytes == spill["nbytes"] and batches[0].num_rows == 3
+
+
+def test_plane_store_replay_clean(tmp_path):
+    from lakesoul_tpu.annplane import AnnPlane, AnnPlaneConfig, ShardedAnnBuilder
+    from lakesoul_tpu.vector.config import VectorIndexConfig
+
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(600, 16)).astype(np.float32)
+    ids = np.arange(600, dtype=np.uint64)
+    index = VectorIndexConfig(column="e", dim=16, nlist=4, total_bits=4)
+    probe = AnnPlaneConfig(
+        index=index, shard_budget_bytes=1 << 30, keep_raw=True
+    )
+    cfg = AnnPlaneConfig(
+        index=index,
+        shard_budget_bytes=300 * probe.bytes_per_vector(),
+        keep_raw=True,
+    )
+    root = str(tmp_path / "p")
+
+    def stream():
+        for lo in range(0, 600, 200):
+            yield vecs[lo : lo + 200], ids[lo : lo + 200]
+
+    with fscheck.watch() as w:
+        ShardedAnnBuilder(root, cfg).build(stream())
+        AnnPlane.open(root, use_pallas=False)
+        fscheck.replay()
+    # every PLANE pointer swing replays old-or-new: AnnPlane.open at each
+    # prefix sees the previous complete record, a mid-build record (a
+    # loud, typed refusal), or the finished plane — never a CRC error
+    assert w.violations == [], "\n\n".join(v.render() for v in w.violations)
+    assert any(
+        op.kind == "replace" and os.path.basename(op.dst) == "PLANE"
+        for op in fscheck.ops()
+    )
+
+
+# -------------------------------------------------- seeded torn publications
+
+
+def test_in_place_write_caught(tmp_path):
+    with fscheck.watch() as w:
+        with open(tmp_path / "member-bad.json", "w") as f:
+            f.write(json.dumps({"service": "y"}))
+        found = fscheck.replay()
+    assert found and all(v.kind == "torn-state" for v in found)
+    v = found[0]
+    assert v.prefix >= 1
+    assert "neither old-complete nor new-complete" in v.message
+    rendered = v.render()
+    assert "publishing op:" in rendered and "reader:" in rendered
+    assert "test_fscheck" in rendered  # the producing stack names this test
+    assert w.violations == found
+
+
+def test_unfsynced_rename_caught_online(tmp_path):
+    tmp = tmp_path / "recorder-bad.json.tmp-1"
+    with fscheck.watch() as w:
+        with open(tmp, "w") as f:
+            f.write("{}")
+        os.replace(tmp, tmp_path / "recorder-bad.json")
+    kinds = {v.kind for v in w.violations}
+    assert "unfsynced-rename" in kinds
+    (v,) = [v for v in w.violations if v.kind == "unfsynced-rename"]
+    assert "never" in v.message and "fsync" in v.message
+
+
+def test_crc_barrier_before_data_caught(tmp_path):
+    crc = tmp_path / "range-00007.arrow.crc"
+    tmp = str(crc) + ".tmp-x"
+    with fscheck.watch() as w:
+        with open(tmp, "w") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "path": str(tmp_path / "range-00007.arrow"),
+                        "crc32": 0,
+                        "nbytes": 3,
+                    }
+                )
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, crc)
+    assert "barrier-before-data" in {v.kind for v in w.violations}
+
+
+def test_data_then_crc_is_clean_online(tmp_path):
+    # the sanctioned spill ordering: segment durable first, CRC doc last
+    seg = tmp_path / "range-00008.arrow"
+    with fscheck.watch() as w:
+        for path, payload in (
+            (seg, b"segment-bytes"),
+            (str(seg) + ".crc", json.dumps({"path": str(seg)}).encode()),
+        ):
+            t = str(path) + ".tmp-x"
+            with open(t, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(t, path)
+    assert [v.kind for v in w.violations] == []
+
+
+# ----------------------------------------------------- LAKESOUL_FSYNC_DIR
+
+
+def test_fsync_dir_opt_in_records_fsyncdir(tmp_path, monkeypatch):
+    doc = str(tmp_path / "member-dir.json")
+    monkeypatch.delenv(atomicio.ENV_FSYNC_DIR, raising=False)
+    with fscheck.watch():
+        atomicio.publish_atomic(doc, "{}")
+    assert not any(op.kind == "fsyncdir" for op in fscheck.ops())
+    fscheck.reset()
+    monkeypatch.setenv(atomicio.ENV_FSYNC_DIR, "1")
+    with fscheck.watch() as w:
+        atomicio.publish_atomic(doc, "{}")
+        fscheck.replay()
+    ops = fscheck.ops()
+    kinds = [op.kind for op in ops]
+    assert "fsyncdir" in kinds, kinds
+    # the directory fsync lands AFTER the publication rename: it makes the
+    # new NAME durable, so it must follow the replace
+    assert kinds.index("fsyncdir") > kinds.index("replace")
+    assert ops[kinds.index("fsyncdir")].path == str(tmp_path)
+    assert w.violations == []
