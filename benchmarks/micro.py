@@ -560,11 +560,11 @@ def bench_chaos(n_rows: int = 400_000, n_files: int = 8, p: float = 0.3) -> None
 def bench_lint() -> None:
     """Analyzer wall-time over the whole package (CI-gate cost leg: the
     lint gate runs on every PR, so its cost is tracked next to the perf
-    legs; target < 10 s for all 31 rules INCLUDING the project call-graph
+    legs; target < 10 s for all 35 rules INCLUDING the project call-graph
     build the interprocedural rules share, the device-index/taint passes
     of the JAX/TPU pack, the thread-root/lockset passes of the
-    concurrency pack, and the filesystem-op index of the durability
-    pack).  Per-rule wall milliseconds ride along in the leg
+    concurrency pack, the filesystem-op index of the durability pack,
+    and the SQL-site/taint passes of the isolation pack).  Per-rule wall milliseconds ride along in the leg
     JSON so a future rule regression is attributable to ONE rule id — note
     a shared index (call graph, device index, thread roots) bills to the
     first rule that builds it."""

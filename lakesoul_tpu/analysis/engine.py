@@ -150,6 +150,9 @@ class Project:
     # the durability pack's per-function filesystem-op index
     # (rules.durability._op_index), same build-once contract
     _durability_index: "object | None" = field(default=None, repr=False)
+    # the isolation pack's per-module SQL/transaction index
+    # (rules.isolation._sql_index), same build-once contract
+    _isolation_index: "object | None" = field(default=None, repr=False)
 
     def callgraph(self):
         """The project call graph, built ONCE and shared by every

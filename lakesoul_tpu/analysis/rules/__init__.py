@@ -33,6 +33,12 @@ from lakesoul_tpu.analysis.rules.durability import (
 )
 from lakesoul_tpu.analysis.rules.endpoint import HardcodedEndpointRule
 from lakesoul_tpu.analysis.rules.identity import FleetIdentityLabelRule
+from lakesoul_tpu.analysis.rules.isolation import (
+    CasGuardRule,
+    ReadModifyWriteRule,
+    SqliteIsmRule,
+    TxnBoundaryRule,
+)
 from lakesoul_tpu.analysis.rules.lifetime import (
     RingAliasingRule,
     ViewEscapesReleaseRule,
@@ -104,6 +110,11 @@ def all_rules() -> list[Rule]:
         TornPublishRule(),
         UnfsyncedRenameRule(),
         BarrierOrderRule(),
+        # isolation pack (READ COMMITTED portability of the metadata path)
+        CasGuardRule(),
+        ReadModifyWriteRule(),
+        TxnBoundaryRule(),
+        SqliteIsmRule(),
     ]
 
 

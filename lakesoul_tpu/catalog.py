@@ -226,14 +226,21 @@ class LakeSoulTable:
             raise MetadataError(
                 f"properties {sorted(bad)} are structural and cannot change"
             )
-        merged = dict(self._info.properties or {})
-        for k, v in props.items():
-            if v is None:
-                merged.pop(k, None)
-            else:
-                merged[k] = str(v)
-        self.catalog.client.store.update_table_properties(
-            self._info.table_id, merged
+
+        def merge(current: dict) -> dict:
+            merged = dict(current or {})
+            for k, v in props.items():
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = str(v)
+            return merged
+
+        # the merge runs inside the store's locked transaction: merging
+        # against a cached self._info snapshot and writing the result back
+        # blind would drop a concurrent peer's property update
+        self.catalog.client.store.merge_table_properties(
+            self._info.table_id, merge
         )
         return self.refresh()
 

@@ -715,7 +715,11 @@ class MetaDataClient:
         )
         self._canonical_desc_cache[table_id] = (epoch, ok)
         if ok:
-            self.store.set_global_config(self._CANONICAL_FLAG + table_id, epoch)
+            # CAS, not a blind set_global_config: the store re-checks the
+            # epoch under the row lock, so a desc committed between our scan
+            # and this write invalidates the flag instead of being masked by
+            # it (the lakelint read-modify-write finding this replaced)
+            self.store.set_descs_verified(table_id, epoch)
         return ok
 
     def canonicalize_partition_descs(self, table_name: str, namespace: str = "default") -> int:

@@ -217,8 +217,9 @@ def translate_sql(sql: str, paramstyle: str) -> str:
 
 class SqlMetadataStore(MetadataStore):
     """Generic DB-API 2.0 implementation of the metadata store.  Subclasses
-    provide connections (`_conn`), transactions (`_txn`), the paramstyle, and
-    the driver's integrity-error types; every DAO method below is shared."""
+    provide connections (`_conn`), transactions (`transaction`), the
+    paramstyle, and the driver's integrity-error types; every DAO method
+    below is shared."""
 
     PARAMSTYLE = "qmark"
     # appended to partition_desc in range predicates; SQLite's default BINARY
@@ -241,15 +242,27 @@ class SqlMetadataStore(MetadataStore):
         raise NotImplementedError
 
     @contextlib.contextmanager
-    def _txn(self):
+    def transaction(self):
+        """THE write-transaction seam: every multi-statement store mutation
+        that must land atomically enters through here (enforced by lakelint's
+        ``txn-boundary`` rule), and the runtime interleaving detector
+        (``analysis/txncheck.py``) wraps exactly this boundary to record
+        per-transaction read/write sets.  Commit on success, rollback on
+        error; subclasses override with backend-appropriate BEGIN semantics
+        but keep the contract."""
         conn = self._conn()
         with conn:  # DB-API context manager: commit on success, rollback on error
             yield conn
 
+    def _txn(self):
+        """Deprecated spelling of :meth:`transaction` (dispatches through it
+        so subclass overrides and txncheck instrumentation still apply)."""
+        return self.transaction()
+
     # -- namespaces ----------------------------------------------------------
     def insert_namespace(self, ns: Namespace) -> None:
         try:
-            with self._txn() as conn:
+            with self.transaction() as conn:
                 self._exec(conn, 
                     "INSERT INTO namespace(namespace, properties, comment, domain) VALUES (?,?,?,?)",
                     (ns.namespace, ns.properties, ns.comment, ns.domain),
@@ -268,7 +281,7 @@ class SqlMetadataStore(MetadataStore):
         return [r[0] for r in self._exec(self._conn(), "SELECT namespace FROM namespace")]
 
     def delete_namespace(self, name: str) -> None:
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn, "DELETE FROM namespace WHERE namespace=?", (name,))
 
     # -- table info ----------------------------------------------------------
@@ -276,7 +289,7 @@ class SqlMetadataStore(MetadataStore):
         """Insert table_info + name/path mappings in one transaction
         (reference: create_table → TableInfo/TableNameId/TablePathId DAOs)."""
         try:
-            with self._txn() as conn:
+            with self.transaction() as conn:
                 self._exec(conn, 
                     "INSERT INTO table_info(table_id, table_namespace, table_name, table_path,"
                     " table_schema, table_schema_arrow_ipc, properties, partitions, domain)"
@@ -355,25 +368,48 @@ class SqlMetadataStore(MetadataStore):
         ]
 
     def update_table_properties(self, table_id: str, properties: dict) -> None:
-        with self._txn() as conn:
-            self._exec(conn, 
+        with self.transaction() as conn:
+            self._exec(conn,
                 "UPDATE table_info SET properties=? WHERE table_id=?",
                 (json.dumps(properties), table_id),
             )
 
+    def merge_table_properties(self, table_id: str, updater) -> dict:
+        """Atomic read-modify-write of ``table_info.properties``:
+        ``updater(current: dict) -> dict`` runs inside ONE write transaction
+        with the table row locked (``ROW_LOCK``), so two concurrent mergers
+        queue instead of both reading the old map and losing one update.
+        Callers that read properties, merge, and wrote back via
+        :meth:`update_table_properties` carried exactly that lost-update
+        race on a READ COMMITTED backend (the lakelint ``read-modify-write``
+        findings this method retired).  Returns the merged map."""
+        with self.transaction() as conn:
+            row = self._exec(conn,
+                f"SELECT properties FROM table_info WHERE table_id=?{self.ROW_LOCK}",
+                (table_id,),
+            ).fetchone()
+            if row is None:
+                raise MetadataError(f"no such table {table_id}")
+            merged = updater(json.loads(row[0] or "{}"))
+            self._exec(conn,
+                "UPDATE table_info SET properties=? WHERE table_id=?",
+                (json.dumps(merged), table_id),
+            )
+            return merged
+
     def update_table_schema(self, table_id: str, schema_json: str, schema_ipc: bytes) -> None:
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn, 
                 "UPDATE table_info SET table_schema=?, table_schema_arrow_ipc=? WHERE table_id=?",
                 (schema_json, schema_ipc, table_id),
             )
 
     def delete_table(self, table_id: str) -> None:
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn, "DELETE FROM table_name_id WHERE table_id=?", (table_id,))
             self._exec(conn, "DELETE FROM table_path_id WHERE table_id=?", (table_id,))
-            self._exec(conn, "DELETE FROM partition_info WHERE table_id=?", (table_id,))
-            self._exec(conn, "DELETE FROM data_commit_info WHERE table_id=?", (table_id,))
+            self._exec(conn, "DELETE FROM partition_info WHERE table_id=?", (table_id,))  # lakelint: ignore[cas-guard] drop-table removes every version by design; no CAS applies
+            self._exec(conn, "DELETE FROM data_commit_info WHERE table_id=?", (table_id,))  # lakelint: ignore[cas-guard] drop-table removes every commit row by design; no CAS applies
             self._exec(conn, "DELETE FROM table_info WHERE table_id=?", (table_id,))
             # per-table bookkeeping keys must not outlive the table
             self._exec(conn,
@@ -383,7 +419,7 @@ class SqlMetadataStore(MetadataStore):
 
     # -- data commit info ----------------------------------------------------
     def insert_data_commit_info(self, commits: list[DataCommitInfo]) -> int:
-        with self._txn() as conn:
+        with self.transaction() as conn:
             for c in commits:
                 self._exec(conn, 
                     # OR IGNORE: concurrent replays of the same commit id are
@@ -441,7 +477,7 @@ class SqlMetadataStore(MetadataStore):
         if not commit_ids:
             return
         qmarks = ",".join("?" for _ in commit_ids)
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn, 
                 f"UPDATE data_commit_info SET committed=1 WHERE table_id=? AND partition_desc=?"
                 f" AND commit_id IN ({qmarks})",
@@ -491,7 +527,7 @@ class SqlMetadataStore(MetadataStore):
         if not commit_ids:
             return
         qmarks = ",".join("?" for _ in commit_ids)
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn, 
                 f"DELETE FROM data_commit_info WHERE table_id=? AND partition_desc=? AND commit_id IN ({qmarks})",
                 (table_id, partition_desc, *commit_ids),
@@ -542,7 +578,7 @@ class SqlMetadataStore(MetadataStore):
         for p in live:  # sentinel Default rows (version<0) are skipped
             descs_by_table.setdefault(p.table_id, set()).add(p.partition_desc)
         try:
-            with self._txn() as conn:
+            with self.transaction() as conn:
                 if lease_guard is not None:
                     self._verify_lease_guard(conn, lease_guard, now_millis())
                 # one batched existence probe per table (not per partition):
@@ -714,7 +750,7 @@ class SqlMetadataStore(MetadataStore):
         unaffected."""
         if old_desc == new_desc:
             return
-        with self._txn() as conn:
+        with self.transaction() as conn:
             # refuse to merge two version chains: if the target desc already
             # has partition_info rows, the UPDATE would collide on the
             # (table_id, partition_desc, version) PK — and which chain wins
@@ -727,11 +763,11 @@ class SqlMetadataStore(MetadataStore):
                 raise MetadataError(
                     f"target desc {new_desc!r} already exists as its own partition"
                 )
-            self._exec(conn,
+            self._exec(conn,  # lakelint: ignore[cas-guard] desc rename moves the WHOLE version chain by design; the in-txn probe above refuses chain merges
                 "UPDATE partition_info SET partition_desc=? WHERE table_id=? AND partition_desc=?",
                 (new_desc, table_id, old_desc),
             )
-            self._exec(conn,
+            self._exec(conn,  # lakelint: ignore[cas-guard] desc rename moves every commit row of the chain by design (same txn as the probe)
                 "UPDATE data_commit_info SET partition_desc=? WHERE table_id=? AND partition_desc=?",
                 (new_desc, table_id, old_desc),
             )
@@ -771,7 +807,7 @@ class SqlMetadataStore(MetadataStore):
     ) -> list[PartitionInfo]:
         """Cleaner support: drop expired versions, returning them so the
         caller can delete orphaned data files."""
-        with self._txn() as conn:
+        with self.transaction() as conn:
             # SELECT and DELETE must share one transaction: a row inserted
             # between them would be deleted without being reported, orphaning
             # its data files forever
@@ -809,7 +845,7 @@ class SqlMetadataStore(MetadataStore):
         race reads as "held by a peer")."""
         now = self._lease_now_ms(now_ms)
         try:
-            with self._txn() as conn:
+            with self.transaction() as conn:
                 row = self._exec(conn,
                     "SELECT holder_id, fencing_token, expires_at_ms FROM lease WHERE lease_key=?",
                     (key,),
@@ -867,7 +903,7 @@ class SqlMetadataStore(MetadataStore):
         token), never silently revived: the renewal gap is exactly where a
         peer may have taken over."""
         now = self._lease_now_ms(now_ms)
-        with self._txn() as conn:
+        with self.transaction() as conn:
             # single compare-and-set: the full predicate rides in the WHERE
             # so a READ COMMITTED backend can't revive a lease a peer
             # re-acquired between a separate read and write
@@ -891,7 +927,7 @@ class SqlMetadataStore(MetadataStore):
         service id could then pass the commit guard with its stale token.
         Keeping the row keeps the token sequence monotonic per key for the
         table's lifetime."""
-        with self._txn() as conn:
+        with self.transaction() as conn:
             cur = self._exec(conn,
                 "UPDATE lease SET holder_id='', expires_at_ms=0"
                 " WHERE lease_key=? AND holder_id=? AND fencing_token=?",
@@ -908,17 +944,21 @@ class SqlMetadataStore(MetadataStore):
             return None
         return Lease(key, row[0], row[1], row[2])
 
-    # appended to the guard SELECT so backends with row-level concurrency
-    # (PG, READ COMMITTED) lock the lease row until the commit txn ends —
-    # without it a peer's takeover can interleave between guard and commit.
-    # SQLite's fully-serialized _txn needs (and supports) no FOR UPDATE.
-    LEASE_GUARD_LOCK = ""
+    # appended to in-transaction reads whose value feeds a dependent write,
+    # so backends with row-level concurrency (PG, READ COMMITTED) lock the
+    # row until the txn ends — without it a peer's committed write can
+    # interleave between the read and the write that depends on it.  SQLite's
+    # fully-serialized transaction() needs (and supports) no FOR UPDATE, so
+    # its spelling is a comment: a machine-visible marker that the read is
+    # lock-intended, which the txncheck interleaving replayer keys on when
+    # it decides whether a recorded read-then-write is splittable.
+    ROW_LOCK = " /*row-lock*/"
 
     def _verify_lease_guard(self, conn, guard: tuple, now: int) -> None:
         key, holder, token = guard
         row = self._exec(conn,
             "SELECT holder_id, fencing_token, expires_at_ms FROM lease"
-            f" WHERE lease_key=?{self.LEASE_GUARD_LOCK}",
+            f" WHERE lease_key=?{self.ROW_LOCK}",
             (key,),
         ).fetchone()
         if row is None or row[0] != holder or row[1] != token or row[2] <= now:
@@ -982,26 +1022,63 @@ class SqlMetadataStore(MetadataStore):
         return row[0] if row else default
 
     def set_global_config(self, key: str, value: str) -> None:
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn,
                 "INSERT INTO global_config(key, value) VALUES (?,?)"
                 " ON CONFLICT(key) DO UPDATE SET value=excluded.value",
                 (key, value),
             )
 
-    def update_global_config(self, key: str, updater) -> str:
-        """Atomic read-modify-write: ``updater(old_value_or_None) -> new``
-        runs inside ONE write transaction, so concurrent updates serialize
-        instead of losing each other's changes."""
-        with self._txn() as conn:
-            row = self._exec(
-                conn, "SELECT value FROM global_config WHERE key=?", (key,)
+    def set_descs_verified(self, table_id: str, epoch: str) -> bool:
+        """CAS write of the verified-canonical flag: the flag lands at
+        ``epoch`` only while the table's desc epoch still IS ``epoch``,
+        re-read under the row lock inside one transaction.  A blind
+        ``set_global_config`` here would let this interleaving through on a
+        READ COMMITTED backend: client verifies at epoch N → writer commits
+        a new desc and bumps to N+1 → client's stale flag lands — and if the
+        bump then moved the flag forward (descs_canonical attestation), the
+        stale write would clobber a flag that is CURRENT.  Returns whether
+        the flag was written.  (When the epoch row is still absent — epoch
+        "0", nothing committed yet — there is no row to lock and a racing
+        first bump can slip between; the flag then records epoch "0" which
+        no longer matches, forcing re-verification: the safe direction.)"""
+        with self.transaction() as conn:
+            row = self._exec(conn,
+                f"SELECT value FROM global_config WHERE key=?{self.ROW_LOCK}",
+                (DESC_EPOCH_KEY + table_id,),
             ).fetchone()
-            new = updater(row[0] if row else None)
+            if (row[0] if row else "0") != epoch:
+                return False
             self._exec(conn,
                 "INSERT INTO global_config(key, value) VALUES (?,?)"
                 " ON CONFLICT(key) DO UPDATE SET value=excluded.value",
-                (key, new),
+                (DESCS_VERIFIED_KEY + table_id, epoch),
+            )
+            return True
+
+    def update_global_config(self, key: str, updater) -> str:
+        """Atomic read-modify-write: ``updater(old_value_or_None) -> new``
+        runs inside ONE write transaction, so concurrent updates serialize
+        instead of losing each other's changes.
+
+        SQLite serializes whole transactions, but PG's READ COMMITTED lets a
+        peer commit between this SELECT and the write — so the row is
+        materialized first (FOR UPDATE cannot lock an absent row; a rollback
+        removes it again) and the read takes the row lock.  Two concurrent
+        updaters then queue on the lock instead of both reading the old
+        value and losing one update."""
+        with self.transaction() as conn:
+            self._exec(conn,
+                "INSERT OR IGNORE INTO global_config(key, value) VALUES (?, NULL)",
+                (key,),
+            )
+            row = self._exec(
+                conn, f"SELECT value FROM global_config WHERE key=?{self.ROW_LOCK}",
+                (key,),
+            ).fetchone()
+            new = updater(row[0] if row else None)
+            self._exec(conn,
+                "UPDATE global_config SET value=? WHERE key=?", (new, key),
             )
             return new
 
@@ -1010,7 +1087,7 @@ class SqlMetadataStore(MetadataStore):
         import datetime
 
         today = datetime.date.today().isoformat()
-        with self._txn() as conn:
+        with self.transaction() as conn:
             # portable upsert: delete+insert inside one transaction
             self._exec(conn,
                 "DELETE FROM discard_compressed_file_info WHERE file_path=?",
@@ -1038,7 +1115,7 @@ class SqlMetadataStore(MetadataStore):
         if not file_paths:
             return
         qmarks = ",".join("?" for _ in file_paths)
-        with self._txn() as conn:
+        with self.transaction() as conn:
             self._exec(conn, 
                 f"DELETE FROM discard_compressed_file_info WHERE file_path IN ({qmarks})",
                 tuple(file_paths),
@@ -1046,7 +1123,7 @@ class SqlMetadataStore(MetadataStore):
 
     # -- test support (reference: clean_meta_for_test) -----------------------
     def clean_all_for_test(self) -> None:
-        with self._txn() as conn:
+        with self.transaction() as conn:
             for t in (
                 "table_info",
                 "table_name_id",
@@ -1064,8 +1141,8 @@ class SqliteMetadataStore(SqlMetadataStore):
         super().__init__()
         self.db_path = str(db_path)
         self._local = threading.local()
-        # RLock: _txn holds it across a whole write transaction while the
-        # transaction body's own _exec calls re-enter it
+        # RLock: transaction() holds it across a whole write transaction
+        # while the transaction body's own _exec calls re-enter it
         self._lock = threading.RLock()
         conn = self._conn()
         with conn:
@@ -1097,12 +1174,16 @@ class SqliteMetadataStore(SqlMetadataStore):
 
     class _EagerCursor:
         """Pre-fetched result rows with the cursor surface the DAO layer
-        uses (fetchone/fetchall/iteration)."""
+        uses (fetchone/fetchall/iteration/rowcount).  ``rowcount`` must ride
+        along: every lease CAS checks it, and an eager cursor without it
+        made acquire/renew/release raise on shared :memory: stores — the
+        CAS contract silently held only on the file-backed path."""
 
-        __slots__ = ("_rows",)
+        __slots__ = ("_rows", "rowcount")
 
-        def __init__(self, rows):
+        def __init__(self, rows, rowcount=-1):
             self._rows = rows
+            self.rowcount = rowcount
 
         def fetchall(self):
             return self._rows
@@ -1128,11 +1209,11 @@ class SqliteMetadataStore(SqlMetadataStore):
                     rows = cur.fetchall()
                 except sqlite3.ProgrammingError:
                     rows = []  # statements with no result set
-                return self._EagerCursor(rows)
+                return self._EagerCursor(rows, cur.rowcount)
         return super()._exec(conn, sql, params)
 
     @contextlib.contextmanager
-    def _txn(self):
+    def transaction(self):
         """Write transaction.  In-memory stores share one connection across
         threads, so multi-statement transactions must be serialized by a lock
         to keep atomicity (file-backed stores get a connection per thread and
@@ -1171,9 +1252,11 @@ class PostgresMetadataStore(SqlMetadataStore):
     # a linguistic cluster collation (en_US.UTF-8) breaks the prefix-range
     # bound math; "C" is byte order and always present in PG
     DESC_RANGE_COLLATION = ' COLLATE "C"'
-    # READ COMMITTED: the commit-time fencing check must hold the lease row
-    # against a concurrent takeover UPDATE until the commit txn ends
-    LEASE_GUARD_LOCK = " FOR UPDATE"
+    # READ COMMITTED: in-transaction reads that feed dependent writes (the
+    # commit-time fencing check, CAS helpers) must hold their row against a
+    # concurrent committed UPDATE until the txn ends — a real row lock here,
+    # where the base class's serialized sqlite spelling is just a marker
+    ROW_LOCK = " FOR UPDATE"
 
     _PG_SCHEMA = re.sub(
         r"timestamp(\s+)INTEGER", r"timestamp\1BIGINT",
@@ -1214,7 +1297,7 @@ class PostgresMetadataStore(SqlMetadataStore):
         return conn
 
     @contextlib.contextmanager
-    def _txn(self):
+    def transaction(self):
         conn = self._conn()
         conn.autocommit = False
         try:

@@ -251,13 +251,18 @@ def build_table_vector_index(table, column: str, *, config: VectorIndexConfig | 
     total = 0
     for unit in table.scan().scan_plan():
         total += builder.build(unit, info.arrow_schema, incremental=incremental)
-    # record the index config on the table for readers
-    props = dict(info.properties)
-    configs = [c for c in props.get("vector_index_columns", "").split(";") if c]
-    configs = [c for c in configs if not c.startswith(column + ":")]
-    configs.append(config.encode())
-    props["vector_index_columns"] = ";".join(configs)
-    table.catalog.client.store.update_table_properties(info.table_id, props)
+    # record the index config on the table for readers — merged inside the
+    # store's locked transaction, so a peer indexing a DIFFERENT column
+    # concurrently cannot have its config entry clobbered by this write
+    def record(props: dict) -> dict:
+        props = dict(props)
+        configs = [c for c in props.get("vector_index_columns", "").split(";") if c]
+        configs = [c for c in configs if not c.startswith(column + ":")]
+        configs.append(config.encode())
+        props["vector_index_columns"] = ";".join(configs)
+        return props
+
+    table.catalog.client.store.merge_table_properties(info.table_id, record)
     table.refresh()
     return total
 

@@ -190,3 +190,44 @@ def _fscheck(request):
     assert not violations, "fscheck violations:\n" + "\n\n".join(
         v.render() for v in violations
     )
+
+
+# ---------------------------------------------------------------- txncheck
+# LAKESOUL_TXNCHECK=1 arms lakelint's transaction-interleaving replayer
+# (lakesoul_tpu/analysis/txncheck.py) for the suites that drive the
+# metadata store's concurrent protocols.  Every committed transaction's
+# statement trace is recorded at the store seam; teardown replays the
+# history under READ COMMITTED interleavings and fails the test on any
+# lost-update window or fencing-token regression, with both transactions'
+# statement stacks.
+
+_TXNCHECK_MODULES = ("test_metadata", "test_lease", "test_topology")
+
+
+@pytest.fixture(autouse=True)
+def _txncheck(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rpartition(".")[2] not in _TXNCHECK_MODULES:
+        yield
+        return
+    from lakesoul_tpu.analysis import txncheck
+
+    if not txncheck.env_requested() or txncheck.enabled():
+        # not armed, or something else already manages the detector
+        yield
+        return
+    txncheck.reset()
+    txncheck.enable()
+    try:
+        yield
+    finally:
+        try:
+            txncheck.replay()
+        finally:
+            violations = txncheck.violations()
+            txncheck.disable()
+            txncheck.reset()
+    assert not violations, "txncheck violations:\n" + "\n\n".join(
+        v.render() for v in violations
+    )

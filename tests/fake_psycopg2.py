@@ -20,9 +20,12 @@ connections really do contend through the storage engine:
 from __future__ import annotations
 
 
+import re
 import sqlite3
 import tempfile
 import threading
+
+_FOR_UPDATE_RE = re.compile(r"\s+FOR\s+UPDATE\b", re.IGNORECASE)
 
 
 class Error(Exception):
@@ -83,6 +86,10 @@ class Cursor:
         if self._conn.closed:
             raise InterfaceError("connection already closed")
         sql_q = sql.replace("%s", "?")
+        # PG row locks have no sqlite spelling — BEGIN IMMEDIATE already
+        # serializes writers in the backing database, so dropping the
+        # clause preserves the store's locking semantics here
+        sql_q = _FOR_UPDATE_RE.sub("", sql_q)
         try:
             self._conn._begin_if_needed(sql_q)
             self._cur.execute(sql_q, tuple(params or ()))

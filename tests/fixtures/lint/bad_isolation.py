@@ -1,0 +1,148 @@
+"""Seeded isolation-portability violations: blind coordination-table
+writes (cas-guard), store reads flowing into dependent blind writes
+(read-modify-write, including a flow split across a helper), writes
+outside any transaction context plus seam reach-arounds (txn-boundary),
+and sqlite-only SQL headed for the backend seam (sqlite-ism) — plus the
+legal shapes (full CAS with rowcount consumed, transaction()-wrapped
+writes, conn-routed helpers, the sqlite backend class speaking sqlite)
+that must stay silent."""
+
+
+# ------------------------------------------------------------- cas-guard
+
+
+def blind_lease_touch(conn):
+    # re-checks only the primary key: a racing takeover's commit between
+    # read and write is silently overwritten
+    with conn:
+        conn.execute("UPDATE lease SET expires_at_ms=5 WHERE lease_key='k'")  # SEED: cas-guard
+
+
+def unchecked_lease_cas(conn):
+    # the CAS predicate is right but nobody reads .rowcount — losing the
+    # race is indistinguishable from winning it
+    with conn:
+        conn.execute(  # SEED: cas-guard
+            "UPDATE lease SET holder_id='' WHERE lease_key='k' "
+            "AND holder_id='h' AND fencing_token=3"
+        )
+
+
+def drop_lease_row(conn):
+    # lease rows are tombstoned, never deleted: deleting restarts fencing
+    # tokens and re-arms a zombie ex-holder's stale token
+    with conn:
+        conn.execute("DELETE FROM lease WHERE lease_key='k'")  # SEED: cas-guard
+
+
+def clobber_partition_versions(conn):
+    # missing the version column: the write spans the whole version chain
+    with conn:
+        conn.execute(  # SEED: cas-guard
+            "UPDATE partition_info SET expression='merge' "
+            "WHERE table_id='t' AND partition_desc='d'"
+        )
+
+
+def cas_with_rowcount(conn):
+    # allowed: full CAS predicate and the result is consumed
+    with conn:
+        cur = conn.execute(
+            "UPDATE lease SET holder_id='', expires_at_ms=0 "
+            "WHERE lease_key='k' AND holder_id='h' AND fencing_token=3"
+        )
+        return cur.rowcount > 0
+
+
+# ----------------------------------------------------- read-modify-write
+
+
+def rmw_direct(store):
+    # classic lost update: read, then write the derived value blind
+    current = store.get_global_config("flags")
+    store.set_global_config("flags", current)  # SEED: read-modify-write
+
+
+def _publish(store, key, value):
+    # the writing half of a flow split across functions
+    store.set_global_config(key, value)  # SEED: read-modify-write
+
+
+def rmw_via_helper(store, key):
+    # interprocedural: the helper writes what this function read
+    current = store.get_global_config(key)
+    _publish(store, key, current)
+
+
+def rmw_sanctioned(store):
+    # allowed: read and write inside one transaction — the seam (plus a
+    # ROW_LOCK read) makes the pair unsplittable
+    with store.transaction() as conn:
+        current = store.get_global_config("flags")
+        store.set_global_config("flags", current)
+
+
+# ---------------------------------------------------------- txn-boundary
+
+
+def autocommit_writes(conn):
+    # each statement commits alone: the pair's invariant straddles a
+    # commit point under READ COMMITTED
+    conn.execute("UPDATE global_config SET value='v' WHERE key='k'")  # SEED: txn-boundary
+    conn.execute("INSERT INTO global_config (key, value) VALUES ('a', 'b')")  # SEED: txn-boundary
+
+
+def reach_around_seam(store):
+    # transaction internals on a store receiver outside meta/store.py —
+    # subclass overrides and txncheck instrumentation no longer apply
+    with store._txn() as conn:  # SEED: txn-boundary
+        store._exec(conn, "SELECT value FROM global_config WHERE key='k'")  # SEED: txn-boundary
+
+
+def steal_raw_connection(store):
+    return store._conn()  # SEED: txn-boundary
+
+
+def sanctioned_txn_write(store):
+    # allowed: the named seam owns the transaction
+    with store.transaction() as conn:
+        conn.execute("UPDATE global_config SET value='v2' WHERE key='k'")
+        conn.execute("INSERT INTO global_config (key, value) VALUES ('c', 'd')")
+
+
+class StoreShim:
+    def _exec(self, conn, sql, params=()):
+        raise NotImplementedError
+
+    def _apply(self, conn, value):
+        # allowed: a helper writing on the transaction's conn it received
+        self._exec(conn, "UPDATE global_config SET value='x' WHERE key='q'")
+
+
+# ------------------------------------------------------------ sqlite-ism
+
+
+def sqlite_only_sql(conn, key):
+    with conn:
+        conn.execute(  # SEED: sqlite-ism
+            "INSERT OR REPLACE INTO global_config (key, value) "
+            "VALUES ('k', 'v')"
+        )
+        conn.execute("SELECT datetime('now')")  # SEED: sqlite-ism
+        conn.execute("SELECT rowid FROM global_config")  # SEED: sqlite-ism
+        conn.execute("PRAGMA synchronous=OFF")  # SEED: sqlite-ism
+        conn.execute(  # SEED: sqlite-ism
+            "CREATE TABLE audit (id INTEGER PRIMARY KEY AUTOINCREMENT)"
+        )
+        conn.execute(  # SEED: sqlite-ism
+            "INSERT OR IGNORE INTO global_config (key, value) "
+            "VALUES ('k', 'v')"
+        )
+        conn.execute("SELECT value FROM global_config WHERE key=?", (key,))  # SEED: sqlite-ism
+
+
+class SqliteBackendShim:
+    # allowed: the sqlite backend class speaks sqlite by definition
+    def tune(self, conn):
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("SELECT rowid FROM global_config")

@@ -150,6 +150,85 @@ def test_durability_rules_line_exact():
     assert lint_fixture("bad_durability.py") == []
 
 
+def test_isolation_cas_guard_line_exact():
+    """Blind coordination-table writes: PK-only lease updates, CAS whose
+    rowcount is never read, DELETE FROM lease (tombstone invariant), and
+    partition writes missing the version column are flagged line-exactly;
+    the full-CAS-with-rowcount shape stays silent."""
+    from lakesoul_tpu.analysis.rules.isolation import CasGuardRule
+
+    found = lint_fixture(
+        "bad_isolation.py", rules=[CasGuardRule(scope=("bad_isolation.py",))]
+    )
+    assert len(found) == 4, found
+    assert_seed_lines(found, "bad_isolation.py", "cas-guard")
+    messages = " ".join(f.message for f in found)
+    assert "tombstoned" in messages
+    assert ".rowcount" in messages
+    assert "READ COMMITTED" in messages
+
+
+def test_isolation_read_modify_write_line_exact():
+    """Store reads flowing into dependent blind writes — direct and split
+    across a helper — are flagged at the sink; the same pair inside a
+    ``with store.transaction()`` block is sanctioned."""
+    from lakesoul_tpu.analysis.rules.isolation import ReadModifyWriteRule
+
+    found = lint_fixture(
+        "bad_isolation.py",
+        rules=[ReadModifyWriteRule(scope=("bad_isolation.py",))],
+    )
+    assert len(found) == 2, found
+    assert_seed_lines(found, "bad_isolation.py", "read-modify-write")
+    # the interprocedural flow names both hops
+    chains = " ".join(f.message for f in found)
+    assert "rmw_via_helper" in chains and "_publish" in chains
+
+
+def test_isolation_txn_boundary_line_exact():
+    """Autocommit write statements and seam reach-arounds
+    (store._exec/_txn/_conn outside meta/store.py) are flagged
+    line-exactly; transaction()-wrapped writes and conn-routed helpers
+    stay silent."""
+    from lakesoul_tpu.analysis.rules.isolation import TxnBoundaryRule
+
+    found = lint_fixture(
+        "bad_isolation.py",
+        rules=[TxnBoundaryRule(scope=("bad_isolation.py",))],
+    )
+    assert len(found) == 5, found
+    assert_seed_lines(found, "bad_isolation.py", "txn-boundary")
+
+
+def test_isolation_sqlite_ism_line_exact():
+    """sqlite-only SQL outside the sqlite backend class — OR REPLACE,
+    datetime('now'), rowid, AUTOINCREMENT, PRAGMA, and qmark/OR-IGNORE
+    bound past translate_sql via a raw execute — is flagged line-exactly;
+    the Sqlite* class speaks sqlite freely."""
+    from lakesoul_tpu.analysis.rules.isolation import SqliteIsmRule
+
+    found = lint_fixture(
+        "bad_isolation.py", rules=[SqliteIsmRule(scope=("bad_isolation.py",))]
+    )
+    assert len(found) == 7, found
+    assert_seed_lines(found, "bad_isolation.py", "sqlite-ism")
+
+
+def test_isolation_default_scope_is_the_metadata_path():
+    """The per-module isolation rules default to meta/ (and txn-boundary
+    to the package): the fixture sits outside all of them, so the
+    default-scoped instances stay silent even with violations present.
+    (read-modify-write is repo-wide by design — flows START anywhere.)"""
+    from lakesoul_tpu.analysis.rules.isolation import (
+        CasGuardRule,
+        SqliteIsmRule,
+        TxnBoundaryRule,
+    )
+
+    rules = [CasGuardRule(), TxnBoundaryRule(), SqliteIsmRule()]
+    assert lint_fixture("bad_isolation.py", rules=rules) == []
+
+
 def test_durability_sanctioned_seam_exempt_from_torn_publish():
     """runtime/atomicio.py is the ONE module allowed to hold raw
     write-mode opens — torn-publish skips it while unfsynced-rename and
@@ -688,7 +767,9 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 31 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 35 and "rbac-gate-reachability" in rule_ids
+    assert "cas-guard" in rule_ids and "read-modify-write" in rule_ids
+    assert "txn-boundary" in rule_ids and "sqlite-ism" in rule_ids
     assert "torn-publish" in rule_ids and "unfsynced-rename" in rule_ids
     assert "barrier-order" in rule_ids
     assert "raw-process" in rule_ids

@@ -1,11 +1,12 @@
-"""CI gate: the repo must lint clean — under ALL 31 rules: the 15
+"""CI gate: the repo must lint clean — under ALL 35 rules: the 15
 per-function ones (incl. ad-hoc-retry, wall-clock-lease,
 hot-path-materialize, raw-process, unstoppable-loop,
 replay-host-roundtrip, fleet-identity-label and hardcoded-endpoint), the
 4 interprocedural ones (call graph + dataflow), the 5 device-pack ones
 (jit/pallas trace safety), the 4 concurrency-pack ones (thread-root
-locksets + buffer lifetimes), and the 3 durability-pack ones (atomic
-publication discipline over the runtime/atomicio seam).
+locksets + buffer lifetimes), the 3 durability-pack ones (atomic
+publication discipline over the runtime/atomicio seam), and the 4
+isolation-pack ones (READ COMMITTED portability of the metadata path).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -41,6 +42,8 @@ EXPECTED_RULES = {
     # durability pack (every publication rides runtime/atomicio; barriers
     # land after the data they cover)
     "torn-publish", "unfsynced-rename", "barrier-order",
+    # isolation pack (the metadata path must survive PG at READ COMMITTED)
+    "cas-guard", "read-modify-write", "txn-boundary", "sqlite-ism",
 }
 
 DEVICE_RULES = {
@@ -55,14 +58,16 @@ CONCURRENCY_RULES = {
 
 DURABILITY_RULES = {"torn-publish", "unfsynced-rename", "barrier-order"}
 
+ISOLATION_RULES = {"cas-guard", "read-modify-write", "txn-boundary", "sqlite-ism"}
 
-def test_all_thirty_one_rules_registered():
+
+def test_all_thirty_five_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 31
+    assert len(ids) == len(set(ids)) == 35
     assert set(ids) == EXPECTED_RULES
 
 
@@ -160,4 +165,20 @@ def test_durability_pack_clean_repo_wide_without_baseline():
     dur = [r for r in all_rules() if r.id in DURABILITY_RULES]
     assert len(dur) == 3
     findings, _ = run(rules=dur, baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_isolation_pack_clean_repo_wide_without_baseline():
+    """The four isolation rules hold with NO baseline entries at all — the
+    real findings this PR surfaced were FIXED (client-side lease CAS,
+    merge helpers made transactional, update_global_config's read locked,
+    the :memory: cursor growing .rowcount), the four store call sites
+    whose CAS shape the parser cannot see carry inline pragmas naming the
+    predicate, and everything else holds by construction."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    iso = [r for r in all_rules() if r.id in ISOLATION_RULES]
+    assert len(iso) == 4
+    findings, _ = run(rules=iso, baseline=Baseline([]))
     assert findings == [], "\n".join(f.render() for f in findings)

@@ -545,3 +545,83 @@ class TestDropNamespace:
         client.create_table("t_in_ns", "/tmp/wh/busy/t", SCHEMA, namespace="busy")
         with pytest.raises(MetadataError, match="not empty"):
             client.drop_namespace("busy")
+
+
+class TestCasHelpers:
+    """The CAS/merge helpers the isolation lint pack retired the blind
+    read-modify-write shapes onto — and the :memory: eager-cursor rowcount
+    the lease CAS consumers depend on."""
+
+    def test_memory_store_lease_cas_rowcount_paths(self):
+        # the shared-connection :memory: store fetches eagerly through
+        # _EagerCursor, which must still expose the CAS .rowcount — the
+        # whole lease protocol reads it on every refresh/renew/release
+        store = SqliteMetadataStore(":memory:")
+        got = store.acquire_lease("p", "a", ttl_ms=10_000, now_ms=1_000)
+        assert got is not None and got.fencing_token == 1
+        # holder refresh: the CAS UPDATE path with rowcount consumed
+        again = store.acquire_lease("p", "a", ttl_ms=10_000, now_ms=2_000)
+        assert again is not None and again.fencing_token == 1
+        assert store.renew_lease("p", "a", 1, ttl_ms=10_000, now_ms=3_000)
+        assert store.release_lease("p", "a", 1)
+        # tombstone re-acquire bumps the token (expired row takeover CAS)
+        fresh = store.acquire_lease("p", "b", ttl_ms=10_000, now_ms=4_000)
+        assert fresh is not None and fresh.fencing_token == 2
+
+    def test_merge_table_properties_concurrent_merges_all_land(self, tmp_path):
+        store = SqliteMetadataStore(str(tmp_path / "merge.db"))
+        client = MetaDataClient(store=store)
+        info = make_table(client, name="merge_t")
+        errs: list = []
+
+        def merger(i):
+            try:
+                store.merge_table_properties(
+                    info.table_id, lambda cur: {**cur, f"k{i}": str(i)}
+                )
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=merger, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        merged = store.get_table_info_by_id(info.table_id).properties
+        # every merger's key survived: the row-locked transaction means no
+        # update was lost to a concurrent read-merge-write
+        assert {f"k{i}": str(i) for i in range(8)}.items() <= merged.items()
+        with pytest.raises(MetadataError, match="no such table"):
+            store.merge_table_properties("ghost-id", lambda cur: cur)
+
+    def test_set_descs_verified_cas_rejects_stale_epoch(self, tmp_path):
+        from lakesoul_tpu.meta.store import DESC_EPOCH_KEY, DESCS_VERIFIED_KEY
+
+        store = SqliteMetadataStore(str(tmp_path / "cas.db"))
+        tid = "tbl-1"
+        store.set_global_config(DESC_EPOCH_KEY + tid, "3")
+        # stale epoch: the re-read under the row lock no longer matches
+        assert store.set_descs_verified(tid, "2") is False
+        assert store.get_global_config(DESCS_VERIFIED_KEY + tid) is None
+        # current epoch: the flag lands at exactly that epoch
+        assert store.set_descs_verified(tid, "3") is True
+        assert store.get_global_config(DESCS_VERIFIED_KEY + tid) == "3"
+
+    def test_update_global_config_concurrent_increments_serialize(self, tmp_path):
+        store = SqliteMetadataStore(str(tmp_path / "rmw.db"))
+        store.set_global_config("counter", "0")
+
+        def bump():
+            for _ in range(5):
+                store.update_global_config(
+                    "counter", lambda old: str(int(old or "0") + 1)
+                )
+
+        threads = [threading.Thread(target=bump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 4 threads x 5 increments: a lost update would leave a lower count
+        assert store.get_global_config("counter") == "20"

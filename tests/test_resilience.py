@@ -602,7 +602,7 @@ class TestKillMidCommit:
         }, schema=SCHEMA))
         client = catalog.client
         # simulate the crash window by un-flipping the flag
-        with client.store._txn() as conn:
+        with client.store.transaction() as conn:
             client.store._exec(conn, "UPDATE data_commit_info SET committed=0")
         counts = client.recover_incomplete_commits(min_age_ms=0)
         assert counts["flag_repaired"] == 1
