@@ -1570,6 +1570,56 @@ def main():
         cost_s=240,
     )
 
+    # ---- soak leg (resource-boundedness: flat fd/thread/heap slopes) -----
+    def soak_leg():
+        """Run benchmarks/micro.py soak in a fresh subprocess (repeated
+        open→scan→serve→close lifecycles; see bench_soak) — a fresh
+        runtime matters MORE here than elsewhere, the leg gates on this
+        process's own fd/thread/heap slopes — and commit its published
+        figures as BENCH_soak.json."""
+        import subprocess as sp
+
+        out = sp.run(
+            [sys.executable, os.path.join(REPO, "benchmarks", "micro.py"),
+             "soak"],
+            capture_output=True, text=True,
+            timeout=max(60.0, _remaining()),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        lines = [
+            json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")
+        ]
+        legs = [d for d in lines if d.get("bench") == "soak_cycles" and "value" in d]
+        if out.returncode != 0 or not legs:
+            sys.stderr.write(out.stderr[-2000:])
+            raise RuntimeError(
+                f"soak leg failed (rc={out.returncode})"
+            )
+        with open(os.path.join(REPO, "BENCH_soak.json"), "w") as f:
+            f.write(json.dumps(legs[-1]) + "\n")
+        return legs[-1]
+
+    emit.leg(
+        "soak", soak_leg,
+        lambda out: {
+            "soak_cycles_per_s": out["value"],
+            "soak_cycles": out["cycles"],
+            "soak_slopes": {
+                "fd": out["fd_slope"],
+                "thread": out["thread_slope"],
+                "heap_bytes": out["heap_slope_bytes"],
+            },
+            "soak_high_water": {
+                "fd": out["fd_high_water"],
+                "thread": out["thread_high_water"],
+                "heap_bytes": out["heap_high_water"],
+            },
+            "soak_heap_budget": out["heap_budget"],
+        },
+        cost_s=60,
+    )
+
     emit.record["complete"] = True
     emit._emit()
 

@@ -20,6 +20,7 @@ so regressions are visible run-to-run.
     python benchmarks/micro.py tensor_replay # epoch-1 stream vs epoch-2 device replay (8-dev mesh)
     python benchmarks/micro.py obs_fleet  # fleet obs: 3-role chaos, 1 snapshot, traces, postmortems
     python benchmarks/micro.py fleet      # multi-host trainers: 1→2→4 emulated hosts + kill-a-host
+    python benchmarks/micro.py soak       # repeated open→scan→serve→close: flat fd/thread/heap gate
     python benchmarks/micro.py all
 """
 
@@ -560,11 +561,12 @@ def bench_chaos(n_rows: int = 400_000, n_files: int = 8, p: float = 0.3) -> None
 def bench_lint() -> None:
     """Analyzer wall-time over the whole package (CI-gate cost leg: the
     lint gate runs on every PR, so its cost is tracked next to the perf
-    legs; target < 10 s for all 35 rules INCLUDING the project call-graph
+    legs; target < 10 s for all 40 rules INCLUDING the project call-graph
     build the interprocedural rules share, the device-index/taint passes
     of the JAX/TPU pack, the thread-root/lockset passes of the
     concurrency pack, the filesystem-op index of the durability pack,
-    and the SQL-site/taint passes of the isolation pack).  Per-rule wall milliseconds ride along in the leg
+    the SQL-site/taint passes of the isolation pack, and the shared
+    container/thread/child lifecycle index of the boundedness pack).  Per-rule wall milliseconds ride along in the leg
     JSON so a future rule regression is attributable to ONE rule id — note
     a shared index (call graph, device index, thread roots) bills to the
     first rule that builds it."""
@@ -2368,6 +2370,112 @@ def bench_fleet(
         )
 
 
+# soak leak-slope gate: over repeated open→scan→serve→close cycles the
+# traced-heap high-water may climb at most this many bytes between the
+# first-third and last-third cycle averages.  Steady state measures ~0
+# (caches warm during the first third); a per-cycle retention of even one
+# scanned table (~0.6 MB at the default leg shape) blows the budget, so
+# this is an O(cycles) leak tripwire, not a formality.
+SOAK_HEAP_BUDGET = float(os.environ.get("LAKESOUL_SOAK_HEAP_BUDGET", 4_000_000))
+
+
+def bench_soak(cycles: int = 12, n_rows: int = 40_000) -> None:
+    """Resource-boundedness replay (the runtime half of lakelint's
+    boundedness pack): run ``cycles`` full open→scan→serve→close lifecycles
+    — open a catalog over a seeded warehouse, scan the table through the
+    loader path, serve one real ``/metrics`` scrape from the Prometheus
+    exporter, shut everything down — sampling ``leakcheck.snapshot()``
+    (fds + live threads) and the tracemalloc heap after every cycle.
+
+    The gate is the SLOPE, not the absolute: first-third vs last-third
+    cycle averages must be flat (fds within 2, threads within 1, heap
+    within ``SOAK_HEAP_BUDGET`` bytes).  A lifecycle that leaks one fd,
+    thread, or table per cycle fails the leg outright — the same
+    fail-don't-shave contract as ``scan_stages``."""
+    import gc
+    import tracemalloc
+    import urllib.request
+
+    from lakesoul_tpu import LakeSoulCatalog
+    from lakesoul_tpu.analysis import leakcheck
+    from lakesoul_tpu.obs.exporter import serve_prometheus
+
+    wh = tempfile.mkdtemp(prefix="lakesoul-soak-")
+    try:
+        rng = np.random.default_rng(0)
+        seed_cat = LakeSoulCatalog(wh)
+        table = seed_cat.create_table(
+            "soak",
+            pa.schema([("id", pa.int64()), ("v", pa.float64())]),
+        )
+        table.write_arrow(pa.table({
+            "id": np.arange(n_rows, dtype=np.int64),
+            "v": rng.normal(size=n_rows),
+        }))
+        del table, seed_cat
+        gc.collect()
+
+        tracemalloc.start()
+        samples = []
+        start = time.perf_counter()
+        for _ in range(cycles):
+            cat = LakeSoulCatalog(wh)  # open
+            rows = len(cat.table("soak").to_arrow())  # scan
+            assert rows == n_rows
+            srv = serve_prometheus(port=0, host="127.0.0.1")  # serve
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                assert resp.status == 200 and resp.read()
+            srv.shutdown()  # close
+            srv.server_close()
+            del cat, srv
+            gc.collect()
+            snap = leakcheck.snapshot()
+            samples.append((
+                snap.fd_count,
+                snap.thread_count,
+                tracemalloc.get_traced_memory()[0],
+            ))
+        dt = time.perf_counter() - start
+        tracemalloc.stop()
+
+        third = max(1, cycles // 3)
+
+        def slope(idx: int) -> float:
+            first = [s[idx] for s in samples[:third]]
+            last = [s[idx] for s in samples[-third:]]
+            return sum(last) / len(last) - sum(first) / len(first)
+
+        fd_slope, thread_slope, heap_slope = slope(0), slope(1), slope(2)
+        _emit(
+            "soak_cycles", cycles / dt, "cycles/s",
+            cycles=cycles, rows_per_cycle=n_rows,
+            fd_slope=round(fd_slope, 2),
+            thread_slope=round(thread_slope, 2),
+            heap_slope_bytes=round(heap_slope, 1),
+            fd_high_water=max(s[0] for s in samples),
+            thread_high_water=max(s[1] for s in samples),
+            heap_high_water=max(s[2] for s in samples),
+            heap_budget=SOAK_HEAP_BUDGET,
+        )
+        assert fd_slope <= 2.0, (
+            f"soak fd high-water climbs {fd_slope:.2f}/third — an fd leaks"
+            " somewhere in the open→scan→serve→close lifecycle"
+        )
+        assert thread_slope <= 1.0, (
+            f"soak thread count climbs {thread_slope:.2f}/third — a thread"
+            " outlives its cycle (nothing joined or stopped it)"
+        )
+        assert heap_slope <= SOAK_HEAP_BUDGET, (
+            f"soak heap climbs {heap_slope:.0f} bytes/third — budget"
+            f" {SOAK_HEAP_BUDGET:.0f} (LAKESOUL_SOAK_HEAP_BUDGET)"
+        )
+    finally:
+        shutil.rmtree(wh, ignore_errors=True)
+
+
 LEGS = {
     "merge": bench_merge,
     "scan_stages": bench_scan_stages,
@@ -2386,6 +2494,7 @@ LEGS = {
     "tensor_replay": bench_tensor_replay,
     "obs_fleet": bench_obs_fleet,
     "fleet": bench_fleet,
+    "soak": bench_soak,
 }
 
 

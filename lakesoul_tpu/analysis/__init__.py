@@ -29,16 +29,29 @@ Four complementary layers:
   feeding Eraser-style static locksets (``shared-state-race``,
   ``racy-check-then-act``) and the zero-copy buffer-lifetime rules
   (``view-escapes-release``, ``ring-aliasing``).
-- :mod:`lockgraph` / :mod:`tracecheck` / :mod:`racecheck` — the opt-in
-  runtime detectors: ``LAKESOUL_LOCKCHECK=1`` instruments
-  ``Lock``/``RLock`` to record the per-thread acquisition graph
-  (lock-order cycles, lock-held-across-``pool.submit``);
-  ``LAKESOUL_TRACECHECK=1`` wraps jit entry points to count distinct
-  abstract signatures per function and flags functions that recompile
-  beyond their budget; ``LAKESOUL_RACECHECK=1`` runs Eraser lockset
-  tracking on the instrumented hot classes' field writes and arms the
-  collate ring's canary/poison mode.  All are wired into the test suite
-  via conftest fixtures.
+- :mod:`rules.boundedness` + :mod:`leakcheck` — the resource-boundedness
+  pack: five lifecycle rules over the shared thread-root/call-graph
+  indexes (``unbounded-queue``, ``unbounded-growth``,
+  ``thread-lifecycle``, ``child-reap``, ``shm-debris``) paired with the
+  runtime leak detector — ``LAKESOUL_LEAKCHECK=1`` patches the creation
+  seams (``Thread.start``, ``Popen``, ``mkdtemp``, atomicio staging) and
+  diffs per-scope fd/thread/child/artifact/heap inventories, reporting
+  each leak with its creation stack; the ``benchmarks/micro.py soak``
+  leg gates on flat slopes over repeated open→scan→serve→close cycles.
+- :mod:`lockgraph` / :mod:`tracecheck` / :mod:`racecheck` /
+  :mod:`fscheck` / :mod:`txncheck` — the opt-in runtime detectors:
+  ``LAKESOUL_LOCKCHECK=1`` instruments ``Lock``/``RLock`` to record the
+  per-thread acquisition graph (lock-order cycles,
+  lock-held-across-``pool.submit``); ``LAKESOUL_TRACECHECK=1`` wraps jit
+  entry points to count distinct abstract signatures per function and
+  flags functions that recompile beyond their budget;
+  ``LAKESOUL_RACECHECK=1`` runs Eraser lockset tracking on the
+  instrumented hot classes' field writes and arms the collate ring's
+  canary/poison mode; ``LAKESOUL_FSCHECK=1`` replays every publication's
+  crash prefixes ALICE-style at teardown; ``LAKESOUL_TXNCHECK=1``
+  replays committed metadata transactions under READ COMMITTED
+  interleavings.  All are wired into the test suite via conftest
+  fixtures, and all record violations rather than raise.
 """
 
 from lakesoul_tpu.analysis.engine import (

@@ -148,6 +148,19 @@ class TaintAnalysis:
         # qname → {id(call node): edge} — resolved per function ONCE; a
         # linear edge scan per lookup would make the walk O(calls²)
         self._edges_by_node: dict[str, dict[int, object]] = {}
+        # id(expr) → [ast.Call] in source order — the sink scan visits the
+        # same statement expressions once per fixpoint pass AND once per
+        # memoized call-site summary; re-walking the subtree each time
+        # dominated the read-modify-write rule's wall time (the AST nodes
+        # live as long as the Project, so id() keys are stable)
+        self._calls_cache: dict[int, list] = {}
+        # qname → whether the function body lexically contains a raw taint
+        # source (a source_self_attrs read or a source_call_predicate hit).
+        # With no tainted parameters, taint can ONLY enter through one of
+        # those (a call with clean arguments never returns taint — see
+        # _call_tainted), so source-free functions are skipped by both the
+        # attr fixpoint and the entry pass.
+        self._has_source: dict[str, bool] = {}
 
     # ------------------------------------------------------------- entry
 
@@ -160,6 +173,13 @@ class TaintAnalysis:
         self._summaries.clear()
         hits: list[SinkHit] = []
         for fn in self.graph.functions_in(scope):
+            # a function with no tainted params acquires taint only from a
+            # lexical source or a tainted attr of its own class — everything
+            # else is summary-clean by construction and need not be walked
+            if not self._raw_source_in(fn) and not self._tainted_attrs(
+                fn.class_qname
+            ):
+                continue
             _, fn_hits = self._analyze(fn, frozenset(), depth=0)
             hits.extend(fn_hits)
         # dedupe: the same sink inside a shared helper is reported once per
@@ -196,6 +216,12 @@ class TaintAnalysis:
             f for f in self.graph.functions.values()
             if f.class_qname == class_qname
         ]
+        if not any(self._raw_source_in(f) for f in methods):
+            # attr taint must START at a lexical source in SOME method of
+            # the class (the fixpoint begins with zero tainted attrs and a
+            # clean-arg call never returns taint) — a source-free class
+            # converges to ∅ without the 8-pass walk
+            return frozenset()
         attrs: set[str] = set()
         for _ in range(8):  # fixpoint: attr taint can chain attr→attr
             before = set(attrs)
@@ -210,6 +236,36 @@ class TaintAnalysis:
                 break
         self._class_attrs[class_qname] = frozenset(attrs)
         return self._class_attrs[class_qname]
+
+    def _raw_source_in(self, fn: FuncInfo) -> bool:
+        """Whether ``fn``'s body lexically contains a raw taint source.
+        Conservative over-approximation (nested defs are included even
+        though the walkers skip them) — used only to SKIP provably clean
+        work, never to report."""
+        hit = self._has_source.get(fn.qname)
+        if hit is not None:
+            return hit
+        cfg = self.config
+        found = False
+        for node in ast.walk(fn.node):
+            if (
+                cfg.source_call_predicate is not None
+                and isinstance(node, ast.Call)
+                and cfg.source_call_predicate(node, dotted_name(node.func))
+            ):
+                found = True
+                break
+            if (
+                cfg.source_self_attrs
+                and isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in cfg.source_self_attrs
+            ):
+                found = True
+                break
+        self._has_source[fn.qname] = found
+        return found
 
     # ------------------------------------------------------ function bodies
 
@@ -503,10 +559,22 @@ class TaintAnalysis:
 
     # ---------------------------------------------------------------- sinks
 
+    def _calls_in(self, expr: ast.expr) -> list:
+        calls = self._calls_cache.get(id(expr))
+        if calls is None:
+            calls = list(iter_calls_in_order([ast.Expr(value=expr)]))
+            self._calls_cache[id(expr)] = calls
+        return calls
+
     def _check_expr(self, expr: ast.expr, fn: FuncInfo, state: _FuncState,
                     hits: list[SinkHit], depth: int) -> None:
+        if state.attr_sink is not None:
+            # attr-fixpoint pass: its hits are discarded and sink scanning
+            # has no effect on taint state — only the checking pass pays
+            # for the per-call-site descent
+            return
         cfg = self.config
-        for call in iter_calls_in_order([ast.Expr(value=expr)]):
+        for call in self._calls_in(expr):
             name = dotted_name(call.func)
             terminal = (name or "").rsplit(".", 1)[-1]
             if (

@@ -153,6 +153,9 @@ class Project:
     # the isolation pack's per-module SQL/transaction index
     # (rules.isolation._sql_index), same build-once contract
     _isolation_index: "object | None" = field(default=None, repr=False)
+    # the boundedness pack's per-class resource-lifecycle index
+    # (rules.boundedness._class_index), same build-once contract
+    _boundedness_index: "object | None" = field(default=None, repr=False)
 
     def callgraph(self):
         """The project call graph, built ONCE and shared by every

@@ -8,7 +8,11 @@ ARCHITECTURE.md §Analysis; adding a rule = subclass
 
 Two generations: the PR 3 per-function rules (``check(module)`` over one
 file's shared AST) and the interprocedural rules (``finalize(project)``
-over the shared project call graph — ``Project.callgraph()``).
+over the shared project call graph — ``Project.callgraph()``).  On top of
+those ride the themed packs — device (jit/pallas trace safety),
+concurrency (thread-root locksets + buffer lifetimes), durability (atomic
+publication), isolation (READ COMMITTED portability), and boundedness
+(resource budgets + thread/child/scratch lifecycles) — 40 rules total.
 """
 
 from __future__ import annotations
@@ -30,6 +34,13 @@ from lakesoul_tpu.analysis.rules.durability import (
     BarrierOrderRule,
     TornPublishRule,
     UnfsyncedRenameRule,
+)
+from lakesoul_tpu.analysis.rules.boundedness import (
+    ChildReapRule,
+    ShmDebrisRule,
+    ThreadLifecycleRule,
+    UnboundedGrowthRule,
+    UnboundedQueueRule,
 )
 from lakesoul_tpu.analysis.rules.endpoint import HardcodedEndpointRule
 from lakesoul_tpu.analysis.rules.identity import FleetIdentityLabelRule
@@ -115,6 +126,12 @@ def all_rules() -> list[Rule]:
         ReadModifyWriteRule(),
         TxnBoundaryRule(),
         SqliteIsmRule(),
+        # boundedness pack (resource budgets + lifecycles for soak runs)
+        UnboundedQueueRule(),
+        UnboundedGrowthRule(),
+        ThreadLifecycleRule(),
+        ChildReapRule(),
+        ShmDebrisRule(),
     ]
 
 

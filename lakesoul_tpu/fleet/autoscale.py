@@ -185,6 +185,10 @@ class WorkerSpawner:
         self.poll_s = poll_s
         self.tag = tag
         self._children: list[subprocess.Popen] = []
+        # terminated-but-not-yet-exited children, reaped by reap(): a
+        # retired worker that never got waited would stay a zombie until
+        # the controller itself exits
+        self._retiring: list[subprocess.Popen] = []
         self._seq = 0
 
     @property
@@ -222,25 +226,33 @@ class WorkerSpawner:
             return None
         proc = self._children.pop()
         proc.terminate()
+        # hand the exiting child to reap() instead of wait()ing here —
+        # blocking the control tick on a worker's shutdown grace would
+        # stall every other scaling decision behind one slow drain
+        self._retiring.append(proc)
         return {"pid": proc.pid}
 
     def reap(self) -> list[dict]:
         """Drop children that exited (crashed or SIGKILLed); the reported
-        deficit is what the next control tick backfills."""
+        deficit is what the next control tick backfills.  Retired children
+        are reaped here too (poll() collects the exit status) but are NOT
+        a deficit — the controller asked them to leave."""
         dead = [p for p in self._children if p.poll() is not None]
         self._children = [p for p in self._children if p.poll() is None]
+        self._retiring = [p for p in self._retiring if p.poll() is None]
         return [{"pid": p.pid, "returncode": p.returncode} for p in dead]
 
     def stop_all(self, timeout: float = 10.0) -> None:
         for p in self._children:
             if p.poll() is None:
                 p.terminate()
-        for p in self._children:
+        for p in self._children + self._retiring:
             try:
                 p.wait(timeout)
             except subprocess.TimeoutExpired:
                 p.kill()
         self._children = []
+        self._retiring = []
 
 
 # --------------------------------------------------------------- controller

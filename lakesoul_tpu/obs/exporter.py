@@ -87,5 +87,19 @@ def serve_prometheus(source=None, port: int = 0, host: str = "0.0.0.0"):
             self._reply(200, body, ctype)
 
     srv = ThreadingHTTPServer((host, port), Handler)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    thread = threading.Thread(
+        target=srv.serve_forever, name="lakesoul-metrics-exporter", daemon=True
+    )
+    srv._serve_thread = thread
+    real_shutdown = srv.shutdown
+
+    def _shutdown() -> None:
+        # the documented stop path also retires the serve thread — without
+        # the join, shutdown() returns while serve_forever is still draining
+        # and the thread races whatever teardown the caller does next
+        real_shutdown()
+        thread.join(timeout=5.0)
+
+    srv.shutdown = _shutdown
+    thread.start()
     return srv

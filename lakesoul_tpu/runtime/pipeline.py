@@ -325,7 +325,8 @@ class PipelineIterator:
             yield from self._gen_map(upstream, st)
             return
         inflight = st.workers + 1
-        futs: deque = deque()
+        # bounded by the `len(futs) < inflight` admission gate below
+        futs: deque = deque()  # lakelint: ignore[unbounded-queue] inflight-windowed
         it = iter(upstream)
         exhausted = False
         try:
@@ -408,7 +409,8 @@ class PipelineIterator:
                 self._q_put(q, e)
 
         it = iter(upstream)
-        slots: deque = deque()  # bounded window of per-item output queues
+        # bounded window of per-item output queues (spawn() admission gate)
+        slots: deque = deque()  # lakelint: ignore[unbounded-queue] spawn-windowed
         exhausted = False
 
         def spawn() -> bool:

@@ -53,6 +53,9 @@ class WorkerPool:
     shaped).  Workers spawn lazily on first submit; ``in_worker()`` is true
     on pool threads so callers can avoid nested blocking submits."""
 
+    # the default worker-thread name prefix is a contract: the process-wide
+    # pool outlives every test/scope by design, so leakcheck exempts threads
+    # named "lakesoul-rt*" — a renamed pool loses that sanction
     def __init__(self, size: int | None = None, *, name: str = "lakesoul-rt"):
         self.size = size or default_pool_size()
         self.name = name

@@ -19,7 +19,10 @@ Flight gateway role; Deep Lake's streaming dataloader, arxiv 2209.10785):
   ranges over Flight, admission-gated and RBAC-checked like every other
   verb; same-host clients negotiate the shared-memory fast path and read
   the spool segments zero-copy (``pa.memory_map``) — only control messages
-  cross the socket.
+  cross the socket.  Default spool dirs are pid-stamped (``.spool-owner``)
+  and atexit-swept; :func:`.delivery.prune_stale_spools` reclaims dirs
+  whose owner died without atexit (SIGKILL), so tmpfs never accretes
+  debris across restarts.
 - **Clients** (:mod:`.client`): :class:`~.client.ScanPlaneClient` is a
   drop-in batch source for ``scan.to_jax_iter()`` / the torch and ray
   adapters (``scan.via_scanplane(...)``), with mid-stream reconnect resume

@@ -319,13 +319,85 @@ class ScanPlaneDelivery:
         return rows
 
 
-def default_spool_dir() -> str:
-    """A fresh spool location: tmpfs when available (the shared-memory
-    fast path is then literal shared memory), else the system tempdir."""
+# default-allocated spool dirs are pid-stamped so a later process can tell
+# a live neighbour's spool from a SIGKILLed one's debris
+_SPOOL_PREFIX = "lakesoul-scanplane-"
+_OWNER_MARKER = ".spool-owner"
+
+
+def _spool_base() -> str:
     import tempfile
 
-    base = "/dev/shm" if os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK) else None
-    return tempfile.mkdtemp(prefix="lakesoul-scanplane-", dir=base)
+    if os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK):
+        return "/dev/shm"
+    return tempfile.gettempdir()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+def prune_stale_spools(base: "str | None" = None) -> list[str]:
+    """Remove default-allocated spool dirs whose owning process is gone.
+
+    atexit covers clean exits; a SIGKILLed service leaves its tmpfs spool
+    behind with nobody left to sweep it — so every fresh
+    :func:`default_spool_dir` call sweeps predecessors' debris first.
+    Only dirs this module allocated are candidates (prefix + owner
+    marker); an operator-provided spool path is never touched."""
+    import shutil
+
+    base = base or _spool_base()
+    removed: list[str] = []
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return removed
+    for name in names:
+        if not name.startswith(_SPOOL_PREFIX):
+            continue
+        path = os.path.join(base, name)
+        try:
+            with open(os.path.join(path, _OWNER_MARKER)) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            continue  # no readable marker: ownership unknown, leave it
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
+
+
+def default_spool_dir() -> str:
+    """A fresh spool location: tmpfs when available (the shared-memory
+    fast path is then literal shared memory), else the system tempdir.
+
+    The dir is pid-stamped and registered for pruning: atexit removes it
+    on clean exit, and :func:`prune_stale_spools` (run here before every
+    allocation) removes dirs whose owner died without one."""
+    import atexit
+    import shutil
+    import tempfile
+
+    from lakesoul_tpu.runtime import atomicio
+
+    base = _spool_base()
+    prune_stale_spools(base)
+    d = tempfile.mkdtemp(prefix=_SPOOL_PREFIX, dir=base)
+    # the marker is read cross-process by prune_stale_spools — publish it
+    # atomically so a concurrent pruner never sees a torn pid
+    atomicio.publish_atomic(os.path.join(d, _OWNER_MARKER), str(os.getpid()))
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    return d
 
 
 def probe_matches(offer: dict | None) -> bool:

@@ -231,3 +231,51 @@ def _txncheck(request):
     assert not violations, "txncheck violations:\n" + "\n\n".join(
         v.render() for v in violations
     )
+
+
+# --------------------------------------------------------------- leakcheck
+# LAKESOUL_LEAKCHECK=1 arms lakelint's resource-leak detector
+# (lakesoul_tpu/analysis/leakcheck.py) for the suites that open, serve,
+# spawn, and spool the hardest: the pipeline/pool machinery
+# (test_runtime), the spool/session protocol (test_scanplane), the worker
+# autoscaler (test_fleet), the serving surfaces (test_resilience), and
+# the follower plane (test_freshness).  Each test runs inside a resource
+# scope — /proc/self/fd, live threads, tracked children, and tracked
+# scratch artifacts are snapshotted before and diffed after; any thread,
+# child, tmpfs fd, or staged tmp that outlives the test fails it at
+# teardown with its creation stack.
+
+_LEAKCHECK_MODULES = (
+    "test_runtime",
+    "test_scanplane",
+    "test_fleet",
+    "test_resilience",
+    "test_freshness",
+)
+
+
+@pytest.fixture(autouse=True)
+def _leakcheck(request):
+    mod = getattr(request.node, "module", None)
+    name = getattr(mod, "__name__", "") or ""
+    if name.rpartition(".")[2] not in _LEAKCHECK_MODULES:
+        yield
+        return
+    from lakesoul_tpu.analysis import leakcheck
+
+    if not leakcheck.env_requested() or leakcheck.enabled():
+        # not armed, or something else already manages the detector
+        yield
+        return
+    leakcheck.reset()
+    leakcheck.enable()
+    try:
+        with leakcheck.scope(request.node.nodeid):
+            yield
+    finally:
+        violations = leakcheck.violations()
+        leakcheck.disable()
+        leakcheck.reset()
+    assert not violations, "leakcheck violations:\n" + "\n\n".join(
+        v.render() for v in violations
+    )

@@ -1,0 +1,260 @@
+"""Seeded resource-boundedness & lifecycle violations: unbounded queue and
+deque constructions on the data path (unbounded-queue), a self-container
+growing inside a background service loop with no eviction anywhere in the
+class (unbounded-growth), started threads nothing can join or stop
+(thread-lifecycle), spawned children that never reach wait/poll/kill
+(child-reap), and tmpfs/tempdir scratch with no prune seam (shm-debris) —
+plus the legal shapes (bounded queues, evicting services, joined and
+stop-event-wired threads, reaped registries, atexit-pruned scratch) that
+must stay silent."""
+
+import os
+import subprocess
+import tempfile
+import threading
+from collections import deque
+from queue import Queue, SimpleQueue
+
+
+# ---------------------------------------------------------- unbounded-queue
+
+
+def build_buffers():
+    inbox = Queue()  # SEED: unbounded-queue
+    backlog = deque()  # SEED: unbounded-queue
+    chute = SimpleQueue()  # SEED: unbounded-queue
+    return inbox, backlog, chute
+
+
+def build_bounded_buffers(depth):
+    # allowed: every buffer carries a structural capacity
+    inbox = Queue(maxsize=16)
+    ring = deque(maxlen=128)
+    window = deque((), depth)
+    sized = Queue(depth)
+    return inbox, ring, window, sized
+
+
+# --------------------------------------------------------- unbounded-growth
+
+
+class LeakyCollector:
+    """Background loop appends forever; nothing in the class evicts."""
+
+    def __init__(self):
+        self._events = []
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._events.append(self._stop.wait(0.01))  # SEED: unbounded-growth
+
+
+class DrainingCollector:
+    """Same loop shape, but drain() evicts — allowed."""
+
+    def __init__(self):
+        self._events = []
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._pump, daemon=True)
+        self._worker.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def drain(self):
+        out = list(self._events)
+        self._events.clear()
+        return out
+
+    def _pump(self):
+        while not self._stop.is_set():
+            self._events.append(self._stop.wait(0.01))
+
+
+class RingCollector:
+    """Growth into a bounded deque — the bound IS the eviction; allowed."""
+
+    def __init__(self):
+        self._ring = deque(maxlen=64)
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._tick, daemon=True)
+        self._worker.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _tick(self):
+        while not self._stop.is_set():
+            self._ring.append(self._stop.wait(0.01))
+
+
+# --------------------------------------------------------- thread-lifecycle
+
+
+def fire_and_forget(work):
+    threading.Thread(target=work, daemon=True).start()  # SEED: thread-lifecycle
+
+
+def escaped_handle(work):
+    pump = threading.Thread(target=work)  # SEED: thread-lifecycle
+    pump.start()
+    return pump
+
+
+class UnjoinedPump:
+    """Handle kept on self but no join and no stop-event wiring.  (The
+    attr name must differ from JoinedPump's — join detection is
+    deliberately name-based across the module.)"""
+
+    def __init__(self):
+        self._pump_t = None
+
+    def start(self, work):
+        self._pump_t = threading.Thread(target=work)  # SEED: thread-lifecycle
+        self._pump_t.start()
+
+
+class JoinedPump:
+    """Allowed: close() joins the handle."""
+
+    def __init__(self):
+        self._t = None
+
+    def start(self, work):
+        self._t = threading.Thread(target=work)
+        self._t.start()
+
+    def close(self):
+        if self._t is not None:
+            self._t.join(timeout=2.0)
+
+
+def joined_locally(work):
+    # allowed: the creating function joins its own handle
+    runner = threading.Thread(target=work)
+    runner.start()
+    runner.join()
+
+
+# --------------------------------------------------------------- child-reap
+
+
+def orphan_spawn(argv):
+    subprocess.Popen(argv)  # SEED: child-reap
+
+
+class NeverReaped:
+    """Registry that no method ever waits, polls, or kills."""
+
+    def __init__(self):
+        self._procs = []
+
+    def spawn(self, argv):
+        p = subprocess.Popen(argv)  # SEED: child-reap
+        self._procs.append(p)
+        return p.pid
+
+
+class ZombieRetirer:
+    """Terminates the popped child but never collects its exit status."""
+
+    def __init__(self):
+        self._kids = []
+
+    def retire(self):
+        if not self._kids:
+            return None
+        victim = self._kids.pop()
+        victim.terminate()  # SEED: child-reap
+        return victim.pid
+
+
+class ReapedSpawner:
+    """Allowed: reap() polls the registry, stop_all() waits with a kill
+    fallback, and retire() waits the child it terminated."""
+
+    def __init__(self):
+        self._children = []
+
+    def spawn(self, argv):
+        child = subprocess.Popen(argv)
+        self._children.append(child)
+        return child.pid
+
+    def retire(self):
+        if not self._children:
+            return None
+        child = self._children.pop()
+        child.terminate()
+        child.wait(5.0)
+        return child.pid
+
+    def reap(self):
+        gone = [c for c in self._children if c.poll() is not None]
+        self._children = [c for c in self._children if c.poll() is None]
+        return [c.pid for c in gone]
+
+    def stop_all(self):
+        for c in self._children:
+            c.terminate()
+        for c in self._children:
+            try:
+                c.wait(5.0)
+            except Exception:
+                c.kill()
+        self._children = []
+
+
+# --------------------------------------------------------------- shm-debris
+
+
+def bare_scratch():
+    return tempfile.mkdtemp(prefix="fixture-")  # SEED: shm-debris
+
+
+def bare_shm_dir(name):
+    os.makedirs("/dev/shm/" + name, exist_ok=True)  # SEED: shm-debris
+    return "/dev/shm/" + name
+
+
+def pruned_scratch():
+    # allowed: the creating function registers the prune seam
+    import atexit
+    import shutil
+
+    d = tempfile.mkdtemp(prefix="fixture-")
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    return d
+
+
+class OwnedScratch:
+    """Allowed: the owning class's close() prunes what open() created."""
+
+    def __init__(self):
+        self._dir = None
+
+    def open(self):
+        self._dir = tempfile.mkdtemp(prefix="fixture-")
+        return self._dir
+
+    def close(self):
+        import shutil
+
+        if self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None

@@ -750,6 +750,104 @@ def test_interproc_rules_silent_on_real_gateways():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+# ------------------------------------------- boundedness pack (rules 36-40)
+
+
+def _boundedness_rules():
+    from lakesoul_tpu.analysis.rules.boundedness import (
+        ChildReapRule,
+        ShmDebrisRule,
+        ThreadLifecycleRule,
+        UnboundedGrowthRule,
+        UnboundedQueueRule,
+    )
+
+    scope = ("bad_leaks.py",)
+    return {
+        "unbounded-queue": UnboundedQueueRule(scope=scope),
+        "unbounded-growth": UnboundedGrowthRule(scope=scope),
+        "thread-lifecycle": ThreadLifecycleRule(scope=scope),
+        "child-reap": ChildReapRule(scope=scope),
+        "shm-debris": ShmDebrisRule(scope=scope),
+    }
+
+
+def test_unbounded_queue_line_exact():
+    """Queue()/deque()/SimpleQueue() without a bound are flagged
+    line-exactly; every capacity-carrying construction stays silent."""
+    found = lint_fixture(
+        "bad_leaks.py", rules=[_boundedness_rules()["unbounded-queue"]]
+    )
+    assert len(found) == 3, found
+    assert_seed_lines(found, "bad_leaks.py", "unbounded-queue")
+    messages = " ".join(f.message for f in found)
+    assert "SimpleQueue" in messages and "maxlen" in messages
+
+
+def test_unbounded_growth_line_exact():
+    """The background service loop appending to an unevicted self-list is
+    flagged; the draining and ring-bounded variants stay silent."""
+    found = lint_fixture(
+        "bad_leaks.py", rules=[_boundedness_rules()["unbounded-growth"]]
+    )
+    assert len(found) == 1, found
+    assert_seed_lines(found, "bad_leaks.py", "unbounded-growth")
+    (f,) = found
+    assert "_events" in f.message and "LeakyCollector" in f.message
+    # the report names the background root that reaches the loop
+    assert "thread:" in f.message
+
+
+def test_thread_lifecycle_line_exact():
+    """Anonymous, escaped-local, and unjoined-attr thread starts are each
+    flagged; joined handles and stop-event-wired publishers stay silent."""
+    found = lint_fixture(
+        "bad_leaks.py", rules=[_boundedness_rules()["thread-lifecycle"]]
+    )
+    assert len(found) == 3, found
+    assert_seed_lines(found, "bad_leaks.py", "thread-lifecycle")
+    messages = " ".join(f.message for f in found)
+    assert "without keeping the handle" in messages
+    assert "_pump_t" in messages
+
+
+def test_child_reap_line_exact():
+    """The bare spawn, the never-reaped registry, and the
+    terminate-without-wait zombie are flagged; the reaped spawner with
+    poll()-based reap and wait-with-kill-fallback stays silent."""
+    found = lint_fixture(
+        "bad_leaks.py", rules=[_boundedness_rules()["child-reap"]]
+    )
+    assert len(found) == 3, found
+    assert_seed_lines(found, "bad_leaks.py", "child-reap")
+    messages = " ".join(f.message for f in found)
+    assert "zombie" in messages and "_procs" in messages
+
+
+def test_shm_debris_line_exact():
+    """mkdtemp and /dev/shm makedirs with no prune seam are flagged; the
+    atexit-registered and class-owned cleanup shapes stay silent."""
+    found = lint_fixture(
+        "bad_leaks.py", rules=[_boundedness_rules()["shm-debris"]]
+    )
+    assert len(found) == 2, found
+    assert_seed_lines(found, "bad_leaks.py", "shm-debris")
+
+
+def test_boundedness_pack_all_rules_together():
+    """One run with all five rules reproduces exactly the union of the
+    fixture's SEED lines — the shared per-class index serves every rule."""
+    found = lint_fixture("bad_leaks.py", rules=list(_boundedness_rules().values()))
+    src = (LINT / "bad_leaks.py").read_text().splitlines()
+    seeded = {
+        (line.split("SEED: ")[1].strip(), i + 1)
+        for i, line in enumerate(src)
+        if "SEED: " in line
+    }
+    got = {(f.rule, f.line) for f in found}
+    assert got == seeded, (sorted(got - seeded), sorted(seeded - got))
+
+
 # ------------------------------------------------------------------- sarif
 
 
@@ -767,7 +865,10 @@ def test_sarif_output_shape():
     driver = run_["tool"]["driver"]
     assert driver["name"] == "lakesoul-lint"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert len(rule_ids) == 35 and "rbac-gate-reachability" in rule_ids
+    assert len(rule_ids) == 40 and "rbac-gate-reachability" in rule_ids
+    assert "unbounded-queue" in rule_ids and "unbounded-growth" in rule_ids
+    assert "thread-lifecycle" in rule_ids and "child-reap" in rule_ids
+    assert "shm-debris" in rule_ids
     assert "cas-guard" in rule_ids and "read-modify-write" in rule_ids
     assert "txn-boundary" in rule_ids and "sqlite-ism" in rule_ids
     assert "torn-publish" in rule_ids and "unfsynced-rename" in rule_ids

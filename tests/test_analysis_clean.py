@@ -1,12 +1,14 @@
-"""CI gate: the repo must lint clean — under ALL 35 rules: the 15
+"""CI gate: the repo must lint clean — under ALL 40 rules: the 15
 per-function ones (incl. ad-hoc-retry, wall-clock-lease,
 hot-path-materialize, raw-process, unstoppable-loop,
 replay-host-roundtrip, fleet-identity-label and hardcoded-endpoint), the
 4 interprocedural ones (call graph + dataflow), the 5 device-pack ones
 (jit/pallas trace safety), the 4 concurrency-pack ones (thread-root
 locksets + buffer lifetimes), the 3 durability-pack ones (atomic
-publication discipline over the runtime/atomicio seam), and the 4
-isolation-pack ones (READ COMMITTED portability of the metadata path).
+publication discipline over the runtime/atomicio seam), the 4
+isolation-pack ones (READ COMMITTED portability of the metadata path),
+and the 5 boundedness-pack ones (resource budgets + lifecycles — what a
+soak run dies of).
 
 ``python -m lakesoul_tpu.analysis`` must exit 0 — zero unsuppressed
 findings over the whole package — and the checked-in baseline must stay
@@ -44,6 +46,9 @@ EXPECTED_RULES = {
     "torn-publish", "unfsynced-rename", "barrier-order",
     # isolation pack (the metadata path must survive PG at READ COMMITTED)
     "cas-guard", "read-modify-write", "txn-boundary", "sqlite-ism",
+    # boundedness pack (bounded memory + clean resource lifecycles)
+    "unbounded-queue", "unbounded-growth", "thread-lifecycle",
+    "child-reap", "shm-debris",
 }
 
 DEVICE_RULES = {
@@ -60,14 +65,19 @@ DURABILITY_RULES = {"torn-publish", "unfsynced-rename", "barrier-order"}
 
 ISOLATION_RULES = {"cas-guard", "read-modify-write", "txn-boundary", "sqlite-ism"}
 
+BOUNDEDNESS_RULES = {
+    "unbounded-queue", "unbounded-growth", "thread-lifecycle",
+    "child-reap", "shm-debris",
+}
 
-def test_all_thirty_five_rules_registered():
+
+def test_all_forty_rules_registered():
     """run_repo runs the full catalog — a rule silently dropped from the
     registry would turn this gate into a no-op for its invariant."""
     from lakesoul_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == len(set(ids)) == 35
+    assert len(ids) == len(set(ids)) == 40
     assert set(ids) == EXPECTED_RULES
 
 
@@ -181,4 +191,21 @@ def test_isolation_pack_clean_repo_wide_without_baseline():
     iso = [r for r in all_rules() if r.id in ISOLATION_RULES]
     assert len(iso) == 4
     findings, _ = run(rules=iso, baseline=Baseline([]))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_boundedness_pack_clean_repo_wide_without_baseline():
+    """The five boundedness rules hold with NO baseline entries at all —
+    the real findings this PR surfaced were FIXED (the exporter's serve
+    thread joined on the shutdown path, the autoscaler's retire() handing
+    terminated children to a reaped retiring list, default spool dirs
+    pid-stamped + atexit-swept + prune_stale_spools for SIGKILLed owners),
+    and the two window-bounded pipeline deques carry inline pragmas naming
+    their structural bound."""
+    from lakesoul_tpu.analysis import Baseline, run
+    from lakesoul_tpu.analysis.rules import all_rules
+
+    bound = [r for r in all_rules() if r.id in BOUNDEDNESS_RULES]
+    assert len(bound) == 5
+    findings, _ = run(rules=bound, baseline=Baseline([]))
     assert findings == [], "\n".join(f.render() for f in findings)

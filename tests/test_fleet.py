@@ -517,6 +517,29 @@ class TestWorkerSpawner:
         assert "--lease-ttl-s" in argv and "2.0" in argv
         assert "--db-path" in argv
 
+    def test_retire_parks_child_for_reap_not_zombie(self, tmp_path):
+        """PIN (boundedness pack): retire() must not drop the terminated
+        handle — the child goes to _retiring, reap() collects the exit
+        status (no zombie), and a retired exit is never a deficit."""
+        import sys as _sys
+        import time as _time
+
+        sp = WorkerSpawner(str(tmp_path / "wh"), str(tmp_path / "spool"))
+        sp.worker_argv = lambda worker_id: [
+            _sys.executable, "-c", "import time; time.sleep(60)",
+        ]
+        sp.spawn()
+        child = sp._children[0]
+        assert sp.retire() == {"pid": child.pid}
+        assert sp._retiring == [child] and sp.count == 0
+        deadline = _time.monotonic() + 10.0
+        deficit: list = []
+        while sp._retiring and _time.monotonic() < deadline:
+            deficit += sp.reap()
+            _time.sleep(0.05)
+        assert sp._retiring == [] and child.returncode is not None
+        assert deficit == []  # the controller asked it to leave
+
 
 # ------------------------------------------------------------- multihost
 

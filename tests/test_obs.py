@@ -312,6 +312,10 @@ class TestUnifiedMetricsEndpoint:
             srv.shutdown()
             server.shutdown()
             fs.rm("/obs", recursive=True)
+        # PIN (boundedness pack): the exporter's serve thread is named and
+        # shutdown() joins it — not an anonymous daemon nothing can reap
+        assert srv._serve_thread.name == "lakesoul-metrics-exporter"
+        assert not srv._serve_thread.is_alive()
 
     def test_obs_stats_console_command(self, catalog):
         from lakesoul_tpu.service.console import Console
